@@ -1,0 +1,217 @@
+"""JAX adapter — the primary framework adapter.
+
+The TPU-native analogue of the reference's TF adapter
+(`horovod/tensorflow/__init__.py`): wrap an optimizer so gradients are
+allreduce-averaged across the data-parallel mesh before being applied
+(`DistributedOptimizer`, reference `:127-186`), and broadcast initial
+parameters from a root rank so all workers start identically
+(`broadcast_global_variables`, reference `:82-124`).
+
+Where the reference intercepts `compute_gradients` on a
+`tf.train.Optimizer`, here we wrap an `optax.GradientTransformation`:
+its `update()` first performs a *fused* (bucketed) `psum` of the incoming
+gradients over the mesh axis — tensor fusion riding ICI — then delegates
+to the wrapped transformation. Sparse `IndexedSlices` leaves take the
+allgather path (reference `:61-72`).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.ops import eager
+from horovod_tpu.ops.fusion import fused_allreduce_tree
+from horovod_tpu.ops.sparse import IndexedSlices
+from horovod_tpu.runtime import state as _state
+from horovod_tpu.runtime.config import config
+
+
+def _axis_in_scope(axis_name: str) -> bool:
+    """True when `axis_name` is bound by an enclosing shard_map/pmap trace."""
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def allreduce_gradients(grads: Any, *, axis_name: Optional[str] = None,
+                        average: bool = True,
+                        threshold: Optional[int] = None,
+                        reduce_dtype: Optional[Any] = None) -> Any:
+    """Fused allreduce of a gradient pytree.
+
+    Inside shard_map (axis bound): bucketed `psum` per SURVEY §7 step 3,
+    semantics of the reference's per-gradient `hvd.allreduce`
+    (`horovod/tensorflow/__init__.py:164-186`) plus tensor fusion
+    (`docs/tensor-fusion.md`). Outside any SPMD context it is the
+    size()==1 no-op the reference also short-circuits (`:174`).
+    Sparse `IndexedSlices` leaves dispatch to the allgather path.
+    """
+    axis = axis_name or config.mesh_axis_name
+    if reduce_dtype is None and config.allreduce_dtype:
+        reduce_dtype = jnp.dtype(config.allreduce_dtype)
+
+    sparse_leaves = {}
+
+    def _is_leaf(x):
+        return isinstance(x, IndexedSlices)
+
+    leaves, treedef = jax.tree.flatten(grads, is_leaf=_is_leaf)
+    dense_idx = [i for i, l in enumerate(leaves)
+                 if not isinstance(l, IndexedSlices)]
+
+    if not _axis_in_scope(axis):
+        return grads  # single-program / size-1 path
+
+    dense = [leaves[i] for i in dense_idx]
+    reduced_dense = fused_allreduce_tree(
+        dense, axis_name=axis, average=average,
+        threshold=threshold, reduce_dtype=reduce_dtype)
+    out = list(leaves)
+    for i, r in zip(dense_idx, reduced_dense):
+        out[i] = r
+    for i, l in enumerate(leaves):
+        if isinstance(l, IndexedSlices):
+            vals = lax.all_gather(l.values, axis, axis=0, tiled=True)
+            idxs = lax.all_gather(l.indices, axis, axis=0, tiled=True)
+            if average:
+                vals = vals / lax.psum(jnp.ones((), vals.dtype), axis)
+            out[i] = IndexedSlices(vals, idxs, l.dense_shape)
+    return jax.tree.unflatten(treedef, out)
+
+
+def DistributedOptimizer(optimizer: optax.GradientTransformation,
+                         *, average: bool = True,
+                         axis_name: Optional[str] = None,
+                         fusion_threshold: Optional[int] = None,
+                         reduce_dtype: Optional[Any] = None
+                         ) -> optax.GradientTransformation:
+    """Wrap an optax transformation with gradient allreduce.
+
+    Parity: `hvd.DistributedOptimizer` (`horovod/tensorflow/__init__.py:
+    127-186`) — same contract (allreduce-average gradients, then delegate
+    every other behavior to the wrapped optimizer), SPMD mechanics.
+    """
+    def init_fn(params):
+        return optimizer.init(params)
+
+    def update_fn(updates, opt_state, params=None, **extra):
+        updates = allreduce_gradients(
+            updates, axis_name=axis_name, average=average,
+            threshold=fusion_threshold, reduce_dtype=reduce_dtype)
+        return optimizer.update(updates, opt_state, params, **extra)
+
+    return _DistributedTransformation(init_fn, update_fn)
+
+
+class _DistributedTransformation(optax.GradientTransformation):
+    """Typed marker so make_train_step can tell an already-distributed
+    transformation apart and not allreduce twice."""
+
+
+class DistributedGradientTape:
+    """Convenience value-and-grad wrapper (API familiarity with later
+    Horovod's `hvd.DistributedGradientTape`): computes grads and
+    allreduces them in one call."""
+
+    def __init__(self, loss_fn: Callable, *, axis_name: Optional[str] = None,
+                 average: bool = True):
+        self._vg = jax.value_and_grad(loss_fn)
+        self._axis = axis_name
+        self._avg = average
+
+    def __call__(self, params, *args, **kwargs):
+        loss, grads = self._vg(params, *args, **kwargs)
+        grads = allreduce_gradients(
+            grads, axis_name=self._axis, average=self._avg)
+        return loss, grads
+
+
+def broadcast_global_variables(params: Any, root_rank: int = 0) -> Any:
+    """Broadcast a parameter pytree from `root_rank` to all ranks.
+
+    Parity: `broadcast_global_variables` (`horovod/tensorflow/__init__.py:
+    82-90`). Single-controller: parameters are already globally consistent
+    (one copy), so this replicates them over the mesh; multi-controller:
+    a true cross-process broadcast so restored/initialized rank-0 weights
+    win (the checkpoint/restore contract, SURVEY §5.4).
+    """
+    return jax.tree.map(
+        lambda x: eager.broadcast(x, root_rank), params)
+
+
+# Aliases matching later-Horovod naming (broadcast_parameters /
+# broadcast_optimizer_state are the torch-API names for the same contract).
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    return broadcast_global_variables(params, root_rank)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
+    return broadcast_global_variables(opt_state, root_rank)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
+    """Broadcast an arbitrary picklable object from root_rank (parity with
+    later Horovod's `hvd.broadcast_object`; used for epoch counters etc.).
+    """
+    st = _state.check_initialized()
+    if st.num_processes <= 1:
+        return obj
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # Length exchange then payload broadcast.
+    n = int(np.asarray(eager.broadcast(np.int64(payload.size), root_rank)))
+    buf = np.zeros(n, np.uint8)
+    if st.process_rank == root_rank:
+        buf[:] = payload
+    out = np.asarray(eager.broadcast(buf, root_rank))
+    return pickle.loads(out.tobytes())
+
+
+def make_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
+                    *, mesh=None, axis_name: Optional[str] = None,
+                    fusion_threshold: Optional[int] = None,
+                    reduce_dtype: Optional[Any] = None,
+                    donate: bool = True) -> Callable:
+    """Build the jitted SPMD data-parallel train step — the hot path
+    (reference SURVEY §3.2), compiled once.
+
+    loss_fn(params, batch) -> scalar loss over the *per-device* microbatch.
+    Returns step(params, opt_state, batch) -> (params, opt_state, loss)
+    where `batch` is sharded over the data axis and params/opt_state are
+    replicated. Backprop and the fused psum overlap under XLA's async
+    collectives — the latency hiding the reference builds by hand with
+    its background thread + fusion buffer.
+    """
+    st = _state.check_initialized()
+    mesh = mesh or st.mesh
+    axis = axis_name or st.axis_name
+    already_distributed = isinstance(tx, _DistributedTransformation)
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        if not already_distributed:
+            grads = allreduce_gradients(
+                grads, axis_name=axis, threshold=fusion_threshold,
+                reduce_dtype=reduce_dtype)
+        loss = lax.pmean(loss, axis)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt_state, loss
+
+    sharded = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P(axis)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(sharded, donate_argnums=donate_argnums)

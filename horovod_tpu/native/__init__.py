@@ -1,0 +1,15 @@
+"""Native (C++) control plane loader.
+
+Mirrors the reference's dual-load pattern (`horovod/tensorflow/mpi_ops.py:
+43-77`): the compiled library is loaded via ctypes and exposes the C
+control API. Built lazily with g++ on first use; a build failure degrades
+gracefully to the pure-Python implementations (validation, timeline,
+stall detection) so the framework never hard-fails on a missing toolchain.
+"""
+
+from __future__ import annotations
+
+
+def load_native():
+    from horovod_tpu.native.bindings import NativeControlPlane
+    return NativeControlPlane.load()

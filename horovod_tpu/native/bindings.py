@@ -1,0 +1,13 @@
+"""ctypes bindings for the native control plane (built in-tree).
+
+Placeholder until the C++ library lands; `load()` raising keeps
+`hvd.init()` on the pure-Python fallback path.
+"""
+
+from __future__ import annotations
+
+
+class NativeControlPlane:
+    @classmethod
+    def load(cls):
+        raise ImportError("native control plane not built yet")

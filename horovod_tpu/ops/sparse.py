@@ -1,0 +1,59 @@
+"""Sparse (embedding) gradient path.
+
+Parity with the reference's `tf.IndexedSlices` dispatch
+(`horovod/tensorflow/__init__.py:61-72`, exercised by
+`examples/tensorflow_word2vec.py`): instead of densifying an embedding
+gradient and allreducing it, allgather the (values, indices) pair — an
+allreduce of the *represented* dense tensor at a fraction of the bytes.
+
+On TPU the gathered slices ride a single `all_gather` over ICI; consumers
+either keep the slices (optax-style sparse apply) or scatter-add them into
+the dense table (`to_dense`), which XLA lowers to an efficient
+one-hot-matmul/scatter on the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class IndexedSlices:
+    """A sparse slice-set: `dense[indices[i]] += values[i]`.
+
+    Mirror of `tf.IndexedSlices` for the JAX world.
+    """
+    values: jax.Array    # [nnz, ...]
+    indices: jax.Array   # [nnz]
+    dense_shape: Optional[Tuple[int, ...]] = None
+
+    def to_dense(self) -> jax.Array:
+        if self.dense_shape is None:
+            raise ValueError("IndexedSlices.to_dense requires dense_shape")
+        out = jnp.zeros(self.dense_shape,
+                        dtype=jnp.asarray(self.values).dtype)
+        return out.at[jnp.asarray(self.indices)].add(self.values)
+
+
+def allreduce_indexed_slices(ts: IndexedSlices, average: bool = True,
+                             name: Optional[str] = None) -> IndexedSlices:
+    """Allreduce an IndexedSlices by allgathering values and indices.
+
+    Parity: `horovod/tensorflow/__init__.py:61-72` — two allgathers, then
+    divide gathered values by size when averaging.
+    """
+    from horovod_tpu.ops import eager
+    from horovod_tpu.runtime import state as _state
+    st = _state.check_initialized()
+    values = eager.allgather(
+        ts.values, name=None if name is None else name + "_values")
+    indices = eager.allgather(
+        ts.indices, name=None if name is None else name + "_indices")
+    if average:
+        values = values / jnp.asarray(st.size, dtype=values.dtype)
+    return IndexedSlices(values, indices, ts.dense_shape)

@@ -1,0 +1,73 @@
+"""Cross-rank request validation.
+
+The contract of the reference coordinator's `ConstructMPIResponse`
+(`horovod/tensorflow/mpi_ops.cc:266-474`): before running a collective,
+every rank's request for a given tensor name must agree on op type, dtype,
+shape (allgather: every dim but 0) and root rank; disagreement fails the
+op with a precondition error instead of hanging — the behavior the
+reference's negative tests assert (`mpi_ops_test.py:284-356, 429-539`).
+
+Under single-controller SPMD a disagreement cannot happen inside one traced
+program, but the eager per-rank path and the multi-controller path can
+disagree, so the check is real. When the native control plane is loaded the
+check runs in C++ (`horovod_tpu/native/control_plane.cc`); this module is
+the pure-Python fallback and the common entry point.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+
+class CollectiveMismatchError(ValueError):
+    """Raised when ranks disagree on collective metadata.
+
+    The TPU analogue of the reference surfacing
+    `tf.errors.FailedPreconditionError` from coordinator validation.
+    """
+
+
+def validate_requests(name: str, op: str,
+                      dtypes: Sequence[str],
+                      shapes: Sequence[Tuple[int, ...]],
+                      root_ranks: Optional[Sequence[int]] = None,
+                      allow_dim0_mismatch: bool = False,
+                      native=None) -> None:
+    if native is not None:
+        err = native.validate(name, op, list(dtypes), list(shapes),
+                              list(root_ranks) if root_ranks else None,
+                              allow_dim0_mismatch)
+        if err:
+            raise CollectiveMismatchError(err)
+        return
+
+    # Pure-Python fallback — same checks, same message shapes as
+    # ConstructMPIResponse (mpi_ops.cc:290-340, 345-405, 409-430).
+    first_dtype = dtypes[0]
+    for r, dt in enumerate(dtypes):
+        if dt != first_dtype:
+            raise CollectiveMismatchError(
+                f"Mismatched data types: One or more ranks submitted "
+                f"tensor {name} with dtype {dt}, but rank 0 submitted "
+                f"dtype {first_dtype}.")
+    if root_ranks is not None:
+        first_root = root_ranks[0]
+        for r, rr in enumerate(root_ranks):
+            if rr != first_root:
+                raise CollectiveMismatchError(
+                    f"Mismatched root ranks: One or more ranks submitted "
+                    f"tensor {name} with root rank {rr}, but rank 0 "
+                    f"submitted root rank {first_root}.")
+    first_shape = shapes[0]
+    for r, sh in enumerate(shapes):
+        if len(sh) != len(first_shape):
+            raise CollectiveMismatchError(
+                f"Mismatched tensor ranks: tensor {name} has rank "
+                f"{len(sh)} on rank {r} but {len(first_shape)} on rank 0.")
+        start = 1 if allow_dim0_mismatch else 0
+        if tuple(sh[start:]) != tuple(first_shape[start:]):
+            what = ("non-first dimensions" if allow_dim0_mismatch
+                    else "shapes")
+            raise CollectiveMismatchError(
+                f"Mismatched {what}: tensor {name} has shape {sh} on "
+                f"rank {r} but {first_shape} on rank 0.")

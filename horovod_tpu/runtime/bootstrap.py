@@ -1,0 +1,186 @@
+"""init()/shutdown() and membership queries.
+
+TPU-native equivalent of the reference's `hvd.init()` call stack
+(SURVEY §3.1, `horovod/tensorflow/mpi_ops.cc:1513-1563`): where the
+reference spawns a background MPI thread and calls `MPI_Init`, the TPU
+build attaches to the JAX runtime — `jax.distributed.initialize` when
+launched multi-process (by `hvdrun` or a TPU pod runtime) — and builds a
+1-D ``data`` mesh over every participating device. There is no background
+thread because under SPMD the collective schedule is decided at compile
+time, not negotiated at runtime (SURVEY §7).
+
+Launcher contract (set by ``hvdrun``, horovod_tpu/runner):
+  HOROVOD_RANK / HOROVOD_SIZE          process rank / world process count
+  HOROVOD_LOCAL_RANK / HOROVOD_LOCAL_SIZE   within-host process placement
+  HOROVOD_COORDINATOR                  host:port of the rank-0 coordinator
+Standard OMPI/PMI vars are honored as fallbacks so `mpirun`-style launches
+also work (parity with `mpi_ops_test.py:31-63`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from horovod_tpu.runtime import state as _state
+from horovod_tpu.runtime.config import config
+
+
+def _detect_process_env():
+    """Read launcher-provided rank/size env vars.
+
+    Returns (process_rank, num_processes, local_rank, local_size,
+    coordinator) or None when not launched multi-process.
+    """
+    env = os.environ
+    for rank_var, size_var in (
+        ("HOROVOD_RANK", "HOROVOD_SIZE"),
+        ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
+        ("PMI_RANK", "PMI_SIZE"),
+    ):
+        if rank_var in env and size_var in env:
+            prank = int(env[rank_var])
+            psize = int(env[size_var])
+            lrank = int(env.get("HOROVOD_LOCAL_RANK",
+                                env.get("OMPI_COMM_WORLD_LOCAL_RANK", prank)))
+            lsize = int(env.get("HOROVOD_LOCAL_SIZE",
+                                env.get("OMPI_COMM_WORLD_LOCAL_SIZE", psize)))
+            coord = env.get("HOROVOD_COORDINATOR", "")
+            return prank, psize, lrank, lsize, coord
+    return None
+
+
+def init(devices: Optional[Sequence] = None,
+         axis_name: Optional[str] = None) -> int:
+    """Initialize horovod_tpu.
+
+    Idempotent, like the reference's atomic-flag-guarded
+    `InitializeHorovodOnce` (`mpi_ops.cc:1513-1524`).
+
+    Args:
+      devices: optional explicit device list for the mesh (defaults to
+        `jax.devices()`).
+      axis_name: name of the data-parallel mesh axis (default "data",
+        overridable via HOROVOD_MESH_AXIS).
+
+    Returns:
+      0 on success (parity with the C `horovod_tensorflow_init`).
+    """
+    st = _state.global_state()
+    with st.lock:
+        if st.initialized:
+            return 0
+        config.refresh()
+
+        import jax
+
+        proc_env = _detect_process_env()
+        if proc_env is not None:
+            try:
+                already = jax.distributed.is_initialized()
+            except AttributeError:  # older jax without is_initialized
+                already = False
+            prank, psize, lrank, lsize, coord = proc_env
+            if psize > 1 and coord and not already:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=psize,
+                    process_id=prank,
+                )
+
+        devs = list(devices) if devices is not None else list(jax.devices())
+        axis = axis_name or config.mesh_axis_name
+
+        from jax.sharding import Mesh
+        import numpy as np
+        st.mesh = Mesh(np.asarray(devs), (axis,))
+        st.axis_name = axis
+        st.devices = devs
+        st.size = len(devs)
+
+        if proc_env is not None:
+            prank, psize, lrank, lsize, _ = proc_env
+            st.process_rank = prank
+            st.num_processes = psize
+            st.local_rank = lrank
+            st.local_size = lsize
+        else:
+            st.process_rank = jax.process_index()
+            st.num_processes = jax.process_count()
+            st.local_rank = 0
+            st.local_size = 1
+
+        # rank == global index of this process's first addressable device:
+        # equals the process rank in the launcher's one-device-per-process
+        # mode, matching the reference's MPI rank semantics.
+        local_set = set(jax.local_devices())
+        local_devs = [d for d in devs if d in local_set]
+        if local_devs:
+            st.rank = devs.index(local_devs[0])
+        else:
+            st.rank = st.process_rank
+
+        # Native control plane (timeline, stall detection, validation).
+        if config.use_native:
+            try:
+                from horovod_tpu.native import load_native
+                st.native = load_native()
+            except Exception:
+                st.native = None  # graceful pure-Python degradation
+
+        if config.timeline_path:
+            from horovod_tpu.utils.timeline import Timeline
+            st.timeline = Timeline(config.timeline_path)
+
+        from horovod_tpu.utils.stall import StallMonitor
+        st.stall_monitor = StallMonitor(config.stall_warning_time)
+
+        st.initialized = True
+        return 0
+
+
+def shutdown() -> None:
+    """Graceful shutdown (parity with `mpi_ops.cc:207-215`, SURVEY §5.3)."""
+    st = _state.global_state()
+    with st.lock:
+        if not st.initialized:
+            return
+        if st.timeline is not None:
+            st.timeline.close()
+        if st.stall_monitor is not None:
+            st.stall_monitor.stop()
+        st.reset()
+        st.shut_down = True  # observable until the next init()
+
+
+def is_initialized() -> bool:
+    return _state.global_state().initialized
+
+
+def rank() -> int:
+    return _state.check_initialized().rank
+
+
+def size() -> int:
+    return _state.check_initialized().size
+
+
+def local_rank() -> int:
+    return _state.check_initialized().local_rank
+
+
+def local_size() -> int:
+    return _state.check_initialized().local_size
+
+
+def process_rank() -> int:
+    return _state.check_initialized().process_rank
+
+
+def num_processes() -> int:
+    return _state.check_initialized().num_processes
+
+
+def mesh():
+    """The framework-owned `jax.sharding.Mesh` (1-D `data` axis)."""
+    return _state.check_initialized().mesh

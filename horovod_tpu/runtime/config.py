@@ -1,0 +1,70 @@
+"""Environment-variable configuration surface.
+
+Parity with the reference's env-var config system (SURVEY §5.6): the reference
+reads `HOROVOD_FUSION_THRESHOLD` (bytes, 0 disables, default 64 MB;
+`horovod/tensorflow/mpi_ops.cc:165,1278-1281`) and `HOROVOD_TIMELINE`
+(`mpi_ops.cc:1272-1275`), plus a 60 s stall-warning threshold
+(`mpi_ops.cc:228`) and 5 ms background tick (`mpi_ops.cc:1292`). The TPU
+build keeps the same variable names so existing Horovod deployment recipes
+carry over, and adds TPU-specific knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024  # bytes, mpi_ops.cc:165
+DEFAULT_STALL_WARNING_TIME = 60.0            # seconds, mpi_ops.cc:228
+DEFAULT_CYCLE_TIME_MS = 5.0                  # mpi_ops.cc:1292 (latency floor)
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name, "")
+    try:
+        return int(v) if v else default
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "")
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class Config:
+    """Runtime configuration, resolved from the environment at init() time.
+
+    Attributes mirror the reference's knobs; `refresh()` re-reads the
+    environment (used by tests and by `hvd.init()`).
+    """
+
+    fusion_threshold: int = DEFAULT_FUSION_THRESHOLD
+    timeline_path: str = ""
+    stall_warning_time: float = DEFAULT_STALL_WARNING_TIME
+    cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    # TPU-specific additions
+    allreduce_dtype: str = ""          # e.g. "bfloat16" to reduce in bf16
+    mesh_axis_name: str = "data"       # default 1-D data-parallel axis
+    use_native: bool = True            # load the C++ control plane
+
+    def refresh(self) -> "Config":
+        self.fusion_threshold = _env_int(
+            "HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD)
+        self.timeline_path = os.environ.get("HOROVOD_TIMELINE", "")
+        self.stall_warning_time = _env_float(
+            "HOROVOD_STALL_CHECK_TIME", DEFAULT_STALL_WARNING_TIME)
+        self.cycle_time_ms = _env_float(
+            "HOROVOD_CYCLE_TIME", DEFAULT_CYCLE_TIME_MS)
+        self.allreduce_dtype = os.environ.get("HOROVOD_ALLREDUCE_DTYPE", "")
+        self.mesh_axis_name = os.environ.get("HOROVOD_MESH_AXIS", "data")
+        self.use_native = os.environ.get("HOROVOD_NO_NATIVE", "") == ""
+        return self
+
+
+config = Config()
+config.refresh()

@@ -1,0 +1,70 @@
+"""Stall detection.
+
+Parity with the reference's `CheckForStalledTensors`
+(`horovod/tensorflow/mpi_ops.cc:1150-1193`, invoked every 60 s from the
+background loop at `:1446-1451`, threshold `STALL_WARNING_TIME = 60 s`,
+`:228`): warn — don't kill — when a collective has been pending longer
+than the threshold, naming the op. In the reference a stall means some
+ranks never submitted a tensor (deadlock across ranks); in the TPU build
+it means a dispatched collective (or a multi-controller rendezvous) has
+not completed — e.g. a peer process died, which on TPU pods otherwise
+surfaces only as a hang.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+
+class StallMonitor:
+    def __init__(self, warning_time_s: float = 60.0, check_every_s: float = 10.0):
+        self._warning_time = warning_time_s
+        self._check_every = check_every_s
+        self._lock = threading.Lock()
+        self._pending = {}   # name -> start timestamp
+        self._warned = set()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-stall-monitor", daemon=True)
+        self._thread.start()
+
+    def begin(self, name: str):
+        with self._lock:
+            self._pending[name] = time.time()
+
+    def end(self, name: str):
+        with self._lock:
+            self._pending.pop(name, None)
+            self._warned.discard(name)
+
+    def check_once(self, now=None):
+        """One stall sweep; returns the list of stalled op names
+        (exposed for tests)."""
+        now = now if now is not None else time.time()
+        stalled = []
+        with self._lock:
+            for name, t0 in self._pending.items():
+                if now - t0 > self._warning_time and name not in self._warned:
+                    stalled.append(name)
+                    self._warned.add(name)
+        if stalled:
+            # Message shape follows mpi_ops.cc:1166-1186.
+            sys.stderr.write(
+                "WARNING: One or more tensors were submitted to be reduced, "
+                "gathered or broadcasted by subset of ranks and are waiting "
+                "for remainder of ranks for more than %d seconds. This may "
+                "indicate that different ranks are trying to submit "
+                "different tensors or that only subset of ranks is "
+                "submitting tensors, which will cause deadlock.\n"
+                "Stalled ops: %s\n" % (int(self._warning_time),
+                                       ", ".join(stalled)))
+        return stalled
+
+    def _loop(self):
+        while not self._stop.wait(self._check_every):
+            self.check_once()
+
+    def stop(self):
+        self._stop.set()

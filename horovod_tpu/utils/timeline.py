@@ -1,0 +1,148 @@
+"""Horovod Timeline — Chrome-trace (chrome://tracing) profiler.
+
+Parity with the reference timeline (SURVEY §5.1; `timeline.h`/`timeline.cc`):
+tensors are modeled as trace *processes* (pid = interned tensor index,
+`timeline.cc:59-76`); events are `B`/`E` duration pairs and `X` instants
+(`timeline.cc:78-92`); a per-tensor state machine
+{UNKNOWN, NEGOTIATING, TOP_LEVEL, ACTIVITY} guards transitions
+(`timeline.h:37-42`); writes flush on a ~1 s cadence (`timeline.h:35`).
+Enabled via `HOROVOD_TIMELINE=/path/file.json` (`mpi_ops.cc:1272-1275`).
+
+Device-side profiling is deferred to `jax.profiler` (the XLA/TPU
+profiler); this timeline covers the host-side schedule — negotiation is
+compile-time under SPMD, so NEGOTIATING brackets validation + dispatch.
+
+When the native control plane is available the same format is written by
+the C++ writer (`horovod_tpu/native/control_plane.cc`); this Python
+implementation is the in-process default and fallback.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+UNKNOWN, NEGOTIATING, TOP_LEVEL, ACTIVITY = range(4)
+
+FLUSH_INTERVAL_S = 1.0  # timeline.h:35
+
+
+class Timeline:
+    def __init__(self, path: str):
+        self._path = path
+        self._lock = threading.Lock()
+        self._pids = {}           # tensor name -> pid
+        self._states = {}         # tensor name -> state
+        self._events = []
+        self._last_flush = time.time()
+        self._start = time.time()
+        self._closed = False
+        # Truncate/create the file with the JSON array opener.
+        with open(self._path, "w") as f:
+            f.write("[\n")
+
+    def _ts_us(self) -> int:
+        return int((time.time() - self._start) * 1e6)
+
+    def _pid(self, name: str) -> int:
+        pid = self._pids.get(name)
+        if pid is None:
+            pid = len(self._pids)
+            self._pids[name] = pid
+            self._events.append({
+                "name": "process_name", "ph": "M", "pid": pid,
+                "args": {"name": name},
+            })
+        return pid
+
+    def _emit(self, ph: str, name: str, pid: int, **kw):
+        ev = {"ph": ph, "name": name, "pid": pid, "ts": self._ts_us()}
+        ev.update(kw)
+        self._events.append(ev)
+
+    def record(self, tensor: str, phase: str, activity: Optional[str] = None):
+        """Record a phase transition for `tensor`.
+
+        phase ∈ {NEGOTIATING, TOP_LEVEL, DONE}; `activity` opens a nested
+        activity span (the reference's ACTIVITY_START_ALL vocabulary:
+        ALLREDUCE, ALLGATHER, BCAST, MEMCPY_IN_FUSION_BUFFER, ...).
+        """
+        with self._lock:
+            if self._closed:
+                return
+            pid = self._pid(tensor)
+            state = self._states.get(tensor, UNKNOWN)
+            if phase == "NEGOTIATING":
+                self._emit("B", "NEGOTIATE", pid)
+                self._states[tensor] = NEGOTIATING
+            elif phase == "TOP_LEVEL":
+                if state == NEGOTIATING:
+                    self._emit("E", "NEGOTIATE", pid)
+                self._emit("B", tensor, pid)
+                self._states[tensor] = TOP_LEVEL
+                if activity:
+                    self._emit("B", activity, pid)
+                    self._states[tensor] = ACTIVITY
+            elif phase == "DONE":
+                if state == ACTIVITY:
+                    self._emit("E", "", pid)
+                if state in (TOP_LEVEL, ACTIVITY):
+                    self._emit("E", tensor, pid)
+                elif state == NEGOTIATING:
+                    self._emit("E", "NEGOTIATE", pid)
+                self._states[tensor] = UNKNOWN
+            self._maybe_flush()
+
+    def mark(self, tensor: str, name: str):
+        """Instant event (`X`, timeline.cc:78-92)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._emit("X", name, self._pid(tensor), dur=0)
+            self._maybe_flush()
+
+    def _maybe_flush(self):
+        if time.time() - self._last_flush >= FLUSH_INTERVAL_S:
+            self._flush_locked()
+
+    def _flush_locked(self):
+        if not self._events:
+            return
+        with open(self._path, "a") as f:
+            for ev in self._events:
+                f.write(json.dumps(ev) + ",\n")
+        self._events = []
+        self._last_flush = time.time()
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._flush_locked()
+            # Chrome tolerates a trailing comma without a closing bracket
+            # (the reference also streams without closing, timeline.cc);
+            # write a terminator for strict parsers.
+            with open(self._path, "a") as f:
+                f.write("{}]\n")
+            self._closed = True
+
+
+def start_timeline(path: str):
+    """Programmatic timeline start (env-var HOROVOD_TIMELINE also works)."""
+    from horovod_tpu.runtime import state as _state
+    st = _state.check_initialized()
+    if st.timeline is not None:
+        st.timeline.close()
+    st.timeline = Timeline(path)
+    return st.timeline
+
+
+def stop_timeline():
+    from horovod_tpu.runtime import state as _state
+    st = _state.check_initialized()
+    if st.timeline is not None:
+        st.timeline.close()
+        st.timeline = None

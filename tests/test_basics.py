@@ -1,0 +1,41 @@
+"""Membership / init semantics.
+
+Mirrors the reference's rank/size oracle tests (`mpi_ops_test.py:31-83`)
+and the uninitialized -1 → ValueError contract
+(`horovod/tensorflow/mpi_ops.py:86-124`).
+"""
+
+import pytest
+
+
+def test_rank_size_local_rank(hvd):
+    assert hvd.size() == 8            # virtual 8-device CPU mesh
+    assert hvd.rank() == 0            # single controller owns device 0
+    assert hvd.local_rank() == 0
+    assert hvd.num_processes() == 1
+    assert hvd.process_rank() == 0
+
+
+def test_init_idempotent(hvd):
+    assert hvd.init() == 0
+    assert hvd.init() == 0
+    assert hvd.size() == 8
+
+
+def test_uninitialized_raises(hvd):
+    hvd.shutdown()
+    try:
+        with pytest.raises(ValueError):
+            hvd.rank()
+        with pytest.raises(ValueError):
+            hvd.size()
+        with pytest.raises(ValueError):
+            hvd.local_rank()
+    finally:
+        hvd.init()
+
+
+def test_mesh_exists(hvd):
+    m = hvd.mesh()
+    assert m.devices.size == 8
+    assert m.axis_names == ("data",)
