@@ -1,0 +1,116 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Flagship benchmark: ResNet-101 data-parallel training throughput in
+images/sec/chip, the metric family of BASELINE.md (the reference's
+headline chart is ResNet-101/Inception-V3/VGG-16 scaling on 128×P100,
+`README.md:27-32`). Runs on whatever devices are visible (the driver
+provides one real TPU chip); the full framework path is exercised —
+mesh init, shard_map train step, fused gradient allreduce, optimizer.
+
+vs_baseline: ratio against the Horovod-paper-era single-P100 fp32
+ResNet-101 throughput (~138 img/s, tf_cnn_benchmarks as used in
+arXiv:1802.05799's setup) — i.e. per-chip speed relative to the
+hardware the reference published on.
+
+Usage: python bench.py [--model resnet101] [--batch 64] [--steps 10]
+"""
+
+import argparse
+import json
+import sys
+import time
+
+P100_RESNET101_IMG_S = 138.0  # per-GPU fp32 baseline (paper-era setup)
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet101",
+                    choices=["resnet50", "resnet101", "vgg16", "mnist"])
+    ap.add_argument("--batch", type=int, default=64,
+                    help="per-chip batch size")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--fusion-threshold", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import models
+    from horovod_tpu.models import make_cnn_train_step
+    from horovod_tpu.models.train import init_cnn_state
+
+    hvd.init()
+    n_chips = hvd.size()
+    platform = jax.devices()[0].platform
+    log(f"devices: {jax.devices()} (platform={platform}, world={n_chips})")
+
+    if args.model == "mnist":
+        model = models.MnistConvNet(dtype=jnp.float32)
+        shape = (1, 28, 28, 1)
+        num_classes = 10
+    elif args.model == "vgg16":
+        model = models.VGG16(num_classes=1000)
+        shape = (1, args.image_size, args.image_size, 3)
+        num_classes = 1000
+    else:
+        cls = models.ResNet50 if args.model == "resnet50" else models.ResNet101
+        model = cls(num_classes=1000)
+        shape = (1, args.image_size, args.image_size, 3)
+        num_classes = 1000
+
+    tx = optax.sgd(0.1, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    log("initializing params...")
+    state = init_cnn_state(model, tx, rng, jnp.zeros(shape, jnp.bfloat16))
+
+    global_batch = args.batch * n_chips
+    x = np.random.RandomState(0).randn(
+        global_batch, *shape[1:]).astype(np.float32)
+    y = np.random.RandomState(1).randint(
+        0, num_classes, size=(global_batch,))
+    x = jnp.asarray(x, jnp.bfloat16)
+    y = jnp.asarray(y)
+
+    step = make_cnn_train_step(model, tx,
+                               fusion_threshold=args.fusion_threshold)
+
+    log("compiling + warmup...")
+    t0 = time.time()
+    for _ in range(max(1, args.warmup)):  # >=1 so compile stays untimed
+        state, loss = step(state, (x, y), rng)
+    jax.block_until_ready(loss)
+    log(f"warmup done in {time.time() - t0:.1f}s (loss={float(loss):.3f})")
+
+    t0 = time.time()
+    for _ in range(args.steps):
+        state, loss = step(state, (x, y), rng)
+    jax.block_until_ready(loss)
+    dt = time.time() - t0
+
+    img_s = args.steps * global_batch / dt
+    img_s_chip = img_s / n_chips
+    log(f"{args.model}: {img_s:.1f} img/s total, "
+        f"{img_s_chip:.1f} img/s/chip, step {dt / args.steps * 1e3:.1f} ms")
+
+    result = {
+        "metric": f"{args.model}_images_per_sec_per_chip",
+        "value": round(img_s_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s_chip / P100_RESNET101_IMG_S, 3)
+        if args.model == "resnet101" else None,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Training-loop callbacks (framework-neutral core).
+
+JAX-side equivalents of the reference's Keras callbacks
+(`horovod/keras/callbacks.py`); the Keras adapter re-exports thin
+wrappers around these.
+
+* `lr_warmup_schedule` — gradual LR warmup per Goyal et al. 2017
+  (`callbacks.py:89-178`): lr'(epoch) = lr * (epoch*(size-1)/warmup + 1),
+  so lr'(0)=lr and lr'(warmup)=size*lr. Returned as an optax schedule
+  (step-indexed), the idiomatic JAX home for LR policy.
+* `MetricAverager` — allreduce-averages a metrics dict across workers at
+  epoch end, sorted by name for deterministic collective order
+  (`callbacks.py:37-86`).
+* `broadcast_on_train_begin` — the BroadcastGlobalVariablesCallback
+  contract (`callbacks.py:8-34`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from horovod_tpu.runtime import state as _state
+
+
+def lr_warmup_schedule(base_lr: float, warmup_epochs: int = 5,
+                       steps_per_epoch: int = 1,
+                       size: Optional[int] = None):
+    """optax-compatible schedule implementing the reference warmup math
+    (`horovod/keras/callbacks.py:96-104`). After `warmup_epochs` the LR
+    stays at size*base_lr (compose with any decay schedule after)."""
+    st = _state.check_initialized()
+    n = size if size is not None else st.size
+
+    def schedule(step):
+        import jax.numpy as jnp
+        epoch = step / steps_per_epoch
+        scale = jnp.minimum(epoch, warmup_epochs) * (n - 1) / warmup_epochs + 1
+        return base_lr * scale
+
+    return schedule
+
+
+class MetricAverager:
+    """Average metric values across workers at epoch end
+    (`horovod/keras/callbacks.py:37-86`)."""
+
+    def __init__(self):
+        self._st = _state.check_initialized()
+
+    def __call__(self, logs: Dict[str, float]) -> Dict[str, float]:
+        from horovod_tpu.ops import eager
+        out = dict(logs)
+        # Sorted for deterministic collective order across ranks
+        # (callbacks.py:71-72).
+        for k in sorted(logs):
+            v = np.asarray(logs[k], np.float64)
+            out[k] = float(np.asarray(eager.allreduce(v, average=True)))
+        return out
+
+
+def broadcast_on_train_begin(params, root_rank: int = 0):
+    """Alias for broadcast_global_variables with callback naming."""
+    from horovod_tpu.jax import broadcast_global_variables
+    return broadcast_global_variables(params, root_rank)

@@ -166,14 +166,11 @@ def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
     st = _state.check_initialized()
     if st.num_processes <= 1:
         return obj
-    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-    # Length exchange then payload broadcast.
-    n = int(np.asarray(eager.broadcast(np.int64(payload.size), root_rank)))
-    buf = np.zeros(n, np.uint8)
-    if st.process_rank == root_rank:
-        buf[:] = payload
-    out = np.asarray(eager.broadcast(buf, root_rank))
-    return pickle.loads(out.tobytes())
+    # Length exchange + payload broadcast over the multi-controller
+    # collective path; lands with the hvdrun launcher.
+    raise NotImplementedError(
+        "broadcast_object across processes requires the hvdrun "
+        "multi-controller collective path (not built yet)")
 
 
 def make_train_step(loss_fn: Callable, tx: optax.GradientTransformation,

@@ -1,0 +1,21 @@
+"""Model zoo backing the reference's examples and benchmarks.
+
+The reference itself ships no model library — its examples and README
+benchmarks use MNIST convnets, word2vec, and tf_cnn_benchmarks'
+ResNet-101 / Inception V3 / VGG-16 (`README.md:27-32`, SURVEY §6). These
+TPU-first implementations (flax.linen, NHWC, bfloat16-friendly) back
+`examples/`, `bench.py`, and the scaling-efficiency targets in
+BASELINE.md.
+"""
+
+from horovod_tpu.models.mnist import MnistConvNet
+from horovod_tpu.models.resnet import ResNet, ResNet50, ResNet101, ResNet152
+from horovod_tpu.models.vgg import VGG16
+from horovod_tpu.models.inception import InceptionV3
+from horovod_tpu.models.word2vec import Word2Vec
+from horovod_tpu.models.train import make_cnn_train_step
+
+__all__ = [
+    "MnistConvNet", "ResNet", "ResNet50", "ResNet101", "ResNet152",
+    "VGG16", "InceptionV3", "Word2Vec", "make_cnn_train_step",
+]

@@ -1,0 +1,129 @@
+"""Inception V3 — the second headline benchmark model.
+
+The reference reports 90 % scaling efficiency for Inception V3 at 128
+GPUs (`README.md:27-32`); BASELINE.md carries images/sec/chip for it as
+a target metric. Structure follows Szegedy et al. 2015 (the
+tf_cnn_benchmarks version the reference benchmarked): stem → 3×
+InceptionA → InceptionB → 4× InceptionC → InceptionD → 2× InceptionE →
+global pool → logits. Aux head omitted (benchmarks run without it).
+
+TPU notes: every branch is 1x1/3x3/5x5(as double-3x3)/pool convs in
+NHWC — all MXU-friendly; branch concat on the channel axis fuses cleanly
+under XLA.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: jnp.dtype = jnp.bfloat16
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not self.train,
+                         momentum=0.9, epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = partial(ConvBN, dtype=self.dtype, train=train)
+        x = x.astype(self.dtype)
+        # Stem: 299x299x3 -> 35x35x192
+        x = conv(32, (3, 3), (2, 2), padding="VALID")(x)
+        x = conv(32, (3, 3), padding="VALID")(x)
+        x = conv(64, (3, 3))(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = conv(80, (1, 1), padding="VALID")(x)
+        x = conv(192, (3, 3), padding="VALID")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+
+        def inception_a(x, pool_features):
+            b1 = conv(64, (1, 1))(x)
+            b2 = conv(48, (1, 1))(x)
+            b2 = conv(64, (5, 5))(b2)
+            b3 = conv(64, (1, 1))(x)
+            b3 = conv(96, (3, 3))(b3)
+            b3 = conv(96, (3, 3))(b3)
+            b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            b4 = conv(pool_features, (1, 1))(b4)
+            return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+        def inception_b(x):
+            b1 = conv(384, (3, 3), (2, 2), padding="VALID")(x)
+            b2 = conv(64, (1, 1))(x)
+            b2 = conv(96, (3, 3))(b2)
+            b2 = conv(96, (3, 3), (2, 2), padding="VALID")(b2)
+            b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+            return jnp.concatenate([b1, b2, b3], axis=-1)
+
+        def inception_c(x, c7):
+            b1 = conv(192, (1, 1))(x)
+            b2 = conv(c7, (1, 1))(x)
+            b2 = conv(c7, (1, 7))(b2)
+            b2 = conv(192, (7, 1))(b2)
+            b3 = conv(c7, (1, 1))(x)
+            b3 = conv(c7, (7, 1))(b3)
+            b3 = conv(c7, (1, 7))(b3)
+            b3 = conv(c7, (7, 1))(b3)
+            b3 = conv(192, (1, 7))(b3)
+            b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            b4 = conv(192, (1, 1))(b4)
+            return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+        def inception_d(x):
+            b1 = conv(192, (1, 1))(x)
+            b1 = conv(320, (3, 3), (2, 2), padding="VALID")(b1)
+            b2 = conv(192, (1, 1))(x)
+            b2 = conv(192, (1, 7))(b2)
+            b2 = conv(192, (7, 1))(b2)
+            b2 = conv(192, (3, 3), (2, 2), padding="VALID")(b2)
+            b3 = nn.max_pool(x, (3, 3), strides=(2, 2))
+            return jnp.concatenate([b1, b2, b3], axis=-1)
+
+        def inception_e(x):
+            b1 = conv(320, (1, 1))(x)
+            b2 = conv(384, (1, 1))(x)
+            b2 = jnp.concatenate([conv(384, (1, 3))(b2),
+                                  conv(384, (3, 1))(b2)], axis=-1)
+            b3 = conv(448, (1, 1))(x)
+            b3 = conv(384, (3, 3))(b3)
+            b3 = jnp.concatenate([conv(384, (1, 3))(b3),
+                                  conv(384, (3, 1))(b3)], axis=-1)
+            b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+            b4 = conv(192, (1, 1))(b4)
+            return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+        x = inception_a(x, 32)
+        x = inception_a(x, 64)
+        x = inception_a(x, 64)
+        x = inception_b(x)
+        x = inception_c(x, 128)
+        x = inception_c(x, 160)
+        x = inception_c(x, 160)
+        x = inception_c(x, 192)
+        x = inception_d(x)
+        x = inception_e(x)
+        x = inception_e(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

@@ -1,0 +1,35 @@
+"""MNIST convnet.
+
+Same architecture as the reference examples' model
+(`examples/tensorflow_mnist.py:24-48` / `examples/keras_mnist.py:54-64`:
+conv5x5(32) → maxpool → conv5x5(64) → maxpool → dense → dropout →
+dense(10)), expressed TPU-first: NHWC, channels padded to MXU-friendly
+sizes by XLA, bfloat16 compute optional.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistConvNet(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        # x: [B, 28, 28, 1]
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

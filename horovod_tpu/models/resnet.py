@@ -1,0 +1,92 @@
+"""ResNet-50/101/152 — the flagship benchmark family.
+
+The reference's headline number is 90 % scaling efficiency for ResNet-101
+data-parallel training on 128 GPUs (`README.md:27-32`, BASELINE.md); this
+is the TPU-first implementation used by `bench.py` and
+`__graft_entry__.py`.
+
+TPU design notes:
+* NHWC layout, 3x3/1x1 convs — XLA tiles these directly onto the MXU.
+* bfloat16 activations/weights with float32 BatchNorm statistics and
+  float32 final logits: the standard TPU mixed-precision recipe.
+* Per-replica (local) BatchNorm, matching the reference's pure-DP
+  semantics (no cross-replica stat sync in Horovod v0.10); a `sync_bn`
+  flag adds cross-replica mean/var psum as a TPU-native extension
+  (axis name "data") for small per-device batches.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # Zero-init the last BN scale per the bag-of-tricks recipe: the
+        # block starts as identity, which also speeds large-batch DP
+        # training (Goyal et al. 2017 — the same paper the reference's
+        # LR-warmup callback implements, horovod/keras/callbacks.py:89).
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 self.strides, name="proj_conv")(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+    sync_bn: bool = False
+    axis_name: str = "data"
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, padding="SAME",
+                       dtype=self.dtype)
+        bn_axis = self.axis_name if (self.sync_bn and train) else None
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       axis_name=bn_axis)
+
+        x = x.astype(self.dtype)
+        x = conv(self.width, (7, 7), (2, 2), name="stem_conv")(x)
+        x = norm(name="stem_bn")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(self.width * 2 ** i, strides,
+                                    conv=conv, norm=norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])

@@ -1,0 +1,93 @@
+"""SPMD training-step builder for flax CNN models (BatchNorm state).
+
+The CNN analogue of `horovod_tpu.jax.make_train_step` for models with
+mutable `batch_stats` and dropout RNG — the training loop shape of the
+reference's `examples/tensorflow_mnist.py` / tf_cnn_benchmarks runs,
+built the TPU way: one jitted shard_map over the `data` axis with fused
+gradient psum (tensor fusion) and donated state.
+
+BatchNorm stats stay per-replica-local and are then allreduce-averaged
+like the reference's effective behavior under checkpoint-on-rank-0 (each
+GPU keeps local stats; averaging keeps replicas consistent so the
+rank-0 checkpoint contract of SURVEY §5.4 holds).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.ops.fusion import fused_allreduce_tree
+from horovod_tpu.runtime import state as _state
+
+
+def make_cnn_train_step(model, tx: optax.GradientTransformation,
+                        *, mesh=None, axis_name: Optional[str] = None,
+                        fusion_threshold: Optional[int] = None,
+                        reduce_dtype: Optional[Any] = None,
+                        donate: bool = True,
+                        remat: bool = False) -> Callable:
+    """Returns step(train_state, batch, rng) -> (train_state, loss) where
+    train_state = {params, batch_stats, opt_state} (a plain dict pytree,
+    replicated) and batch = (images, labels) sharded on dim 0.
+
+    remat=True wraps the forward pass in jax.checkpoint, trading FLOPs
+    for HBM — the standard TPU recipe for deep CNNs at large batch.
+    """
+    st = _state.check_initialized()
+    mesh = mesh or st.mesh
+    axis = axis_name or st.axis_name
+
+    def loss_fn(params, batch_stats, images, labels, rng):
+        def fwd(p, imgs):
+            return model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                imgs, train=True, mutable=["batch_stats"],
+                rngs={"dropout": rng})
+        if remat:
+            fwd = jax.checkpoint(fwd)
+        logits, mutated = fwd(params, images)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+        return loss, mutated["batch_stats"]
+
+    def step(state, batch, rng):
+        images, labels = batch
+        rng = jax.random.fold_in(rng, lax.axis_index(axis))
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state["params"], state["batch_stats"],
+                                   images, labels, rng)
+        grads = fused_allreduce_tree(
+            grads, axis_name=axis, average=True,
+            threshold=fusion_threshold, reduce_dtype=reduce_dtype)
+        loss = lax.pmean(loss, axis)
+        new_stats = jax.tree.map(lambda x: lax.pmean(x, axis), new_stats)
+        updates, new_opt = tx.update(grads, state["opt_state"],
+                                     state["params"])
+        new_params = optax.apply_updates(state["params"], updates)
+        return ({"params": new_params, "batch_stats": new_stats,
+                 "opt_state": new_opt}, loss)
+
+    sharded = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(sharded, donate_argnums=donate_argnums)
+
+
+def init_cnn_state(model, tx: optax.GradientTransformation, rng,
+                   sample_input) -> dict:
+    """Initialize {params, batch_stats, opt_state} for a CNN model."""
+    variables = model.init(rng, sample_input, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return {"params": params, "batch_stats": batch_stats,
+            "opt_state": tx.init(params)}

@@ -1,0 +1,67 @@
+"""Word2vec skip-gram — the sparse-gradient exercise.
+
+The reference's `examples/tensorflow_word2vec.py` exists to exercise the
+IndexedSlices → allgather path (`horovod/tensorflow/__init__.py:61-72`,
+SURVEY §3.4): embedding-lookup gradients touch only the looked-up rows,
+so allreducing them densely wastes bandwidth. This model reproduces that
+shape: skip-gram with NCE-style sampled logits; `sparse_grads()` returns
+the embedding gradient as `IndexedSlices` for the sparse collective path.
+
+TPU note: the lookup is `take(..., axis=0)` (gather) and the sparse
+update is a `scatter-add`; both lower to efficient TPU HLOs, and the
+gathered (values, indices) ride one `all_gather` over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.ops.sparse import IndexedSlices
+
+
+class Word2Vec(nn.Module):
+    vocab_size: int = 50000
+    embed_dim: int = 128
+
+    @nn.compact
+    def __call__(self, center: jax.Array, context: jax.Array,
+                 negative: jax.Array):
+        """center/context: [B] int ids; negative: [B, K] sampled ids.
+        Returns the NCE-style loss."""
+        emb = self.param("embeddings",
+                         nn.initializers.uniform(scale=1.0),
+                         (self.vocab_size, self.embed_dim))
+        out = self.param("nce_weights",
+                         nn.initializers.truncated_normal(
+                             stddev=1.0 / self.embed_dim ** 0.5),
+                         (self.vocab_size, self.embed_dim))
+        v = jnp.take(emb, center, axis=0)               # [B, D]
+        u_pos = jnp.take(out, context, axis=0)          # [B, D]
+        u_neg = jnp.take(out, negative, axis=0)         # [B, K, D]
+        pos_logit = jnp.sum(v * u_pos, axis=-1)         # [B]
+        neg_logit = jnp.einsum("bd,bkd->bk", v, u_neg)  # [B, K]
+        loss = (-jax.nn.log_sigmoid(pos_logit)
+                - jax.nn.log_sigmoid(-neg_logit).sum(axis=-1))
+        return loss.mean()
+
+
+def embedding_grad_as_slices(dense_grad: jax.Array,
+                             touched_ids: jax.Array) -> IndexedSlices:
+    """Convert a dense embedding-table gradient into IndexedSlices over
+    the touched rows — the JAX analogue of TF returning IndexedSlices
+    from an embedding lookup's backward pass."""
+    # Pad slots must not duplicate a real row's gradient: mark them with
+    # -1, gather through a safe index, and zero their values so
+    # to_dense()'s scatter-add is exact even with duplicate ids.
+    ids = jnp.unique(touched_ids.ravel(), size=touched_ids.size,
+                     fill_value=-1)
+    valid = ids >= 0
+    safe_ids = jnp.where(valid, ids, 0)
+    values = jnp.take(dense_grad, safe_ids, axis=0)
+    values = values * valid[:, None].astype(values.dtype)
+    return IndexedSlices(values, safe_ids,
+                         dense_shape=tuple(dense_grad.shape))

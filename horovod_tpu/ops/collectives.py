@@ -33,8 +33,15 @@ def allreduce(x: jax.Array, *, average: bool = True,
     `average=True` matches the reference exactly. Lowers to a single
     all-reduce HLO — bandwidth-optimal on the ICI ring by construction
     (the reference delegates the ring algorithm to NCCL/OpenMPI).
+    Integer inputs with `average=True` floor-divide and keep their dtype,
+    matching the reference's `tf.div` semantics.
     """
-    return lax.pmean(x, axis_name) if average else lax.psum(x, axis_name)
+    if not average:
+        return lax.psum(x, axis_name)
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        return lax.psum(x, axis_name) // lax.psum(
+            jnp.ones((), x.dtype), axis_name)
+    return lax.pmean(x, axis_name)
 
 
 def allgather(x: jax.Array, *, axis_name: str = "data") -> jax.Array:

@@ -296,6 +296,10 @@ def reducescatter(tensor, average: bool = False, name: Optional[str] = None):
     if isinstance(tensor, PerRank):
         vals = tensor.values
         stacked = np.stack(vals)
+        if stacked.shape[1] % st.size:
+            raise ValueError(
+                f"reducescatter dim 0 ({stacked.shape[1]}) must be "
+                f"divisible by world size {st.size}")
 
         def _kernel(x):
             return C.reducescatter(x[0], average=average,
@@ -316,7 +320,7 @@ def reducescatter(tensor, average: bool = False, name: Optional[str] = None):
     x = jnp.asarray(tensor)
     if x.shape[0] % st.size:
         raise ValueError(
-            f"reducescatter dim 0 ({x.shape[0]}) must divide world size "
-            f"{st.size}")
+            f"reducescatter dim 0 ({x.shape[0]}) must be divisible by "
+            f"world size {st.size}")
     reduced = x if average else x * st.size
     return reduced.reshape((st.size, x.shape[0] // st.size) + x.shape[1:])

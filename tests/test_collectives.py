@@ -49,6 +49,16 @@ def test_allreduce_average(hvd, dtype):
                                rtol=1e-5)
 
 
+def test_allreduce_integer_average_keeps_dtype(hvd):
+    """Integer average floor-divides and preserves dtype (tf.div parity,
+    `horovod/tensorflow/__init__.py:75-78`)."""
+    vals = [np.full((4,), r + 1, np.int32) for r in range(hvd.size())]
+    out = np.asarray(hvd.allreduce(hvd.per_rank(vals), average=True))
+    assert out.dtype == np.int32
+    total = sum(r + 1 for r in range(hvd.size()))
+    np.testing.assert_array_equal(out, total // hvd.size())
+
+
 def test_allreduce_replicated_value(hvd):
     """A plain (replicated) tensor behaves as N identical ranks."""
     x = np.arange(6, dtype=np.float32).reshape(2, 3)
