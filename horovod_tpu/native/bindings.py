@@ -1,13 +1,159 @@
-"""ctypes bindings for the native control plane (built in-tree).
+"""ctypes bindings for the native control plane.
 
-Placeholder until the C++ library lands; `load()` raising keeps
-`hvd.init()` on the pure-Python fallback path.
+Mirrors the reference's ctypes load of its compiled extension
+(`horovod/tensorflow/mpi_ops.py:68-77`): one shared library, C ABI,
+loaded RTLD_GLOBAL. Each wrapper converts to/from Python types; error
+strings come back through caller-provided buffers.
 """
 
 from __future__ import annotations
 
+import ctypes
+from typing import List, Optional, Sequence, Tuple
+
+_ERR_CAP = 4096
+
 
 class NativeControlPlane:
+    def __init__(self, lib: ctypes.CDLL):
+        self.lib = lib
+        lib.hvd_native_rank.restype = ctypes.c_int
+        lib.hvd_native_size.restype = ctypes.c_int
+        lib.hvd_native_local_rank.restype = ctypes.c_int
+        lib.hvd_native_local_size.restype = ctypes.c_int
+        lib.hvd_native_validate.restype = ctypes.c_int
+        lib.hvd_native_kv_get.restype = ctypes.c_int
+        lib.hvd_native_rendezvous_serve.restype = ctypes.c_int
+        lib.hvd_native_client_connect.restype = ctypes.c_int
+        lib.hvd_native_client_connect.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_double]
+        lib.hvd_native_stall_configure.argtypes = [
+            ctypes.c_double, ctypes.c_double]
+        lib.hvd_native_kv_get.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_int]
+        lib.hvd_native_barrier.argtypes = [ctypes.c_char_p, ctypes.c_long]
+
     @classmethod
-    def load(cls):
-        raise ImportError("native control plane not built yet")
+    def load(cls) -> "NativeControlPlane":
+        from horovod_tpu.native.build import build_if_needed
+        path = build_if_needed()
+        return cls(ctypes.CDLL(path, mode=ctypes.RTLD_GLOBAL))
+
+    # --- membership ---
+
+    def init(self, rank: int, size: int, local_rank: int,
+             local_size: int) -> int:
+        return self.lib.hvd_native_init(rank, size, local_rank, local_size)
+
+    def rank(self) -> int:
+        return self.lib.hvd_native_rank()
+
+    def size(self) -> int:
+        return self.lib.hvd_native_size()
+
+    def local_rank(self) -> int:
+        return self.lib.hvd_native_local_rank()
+
+    def shutdown(self) -> int:
+        return self.lib.hvd_native_shutdown()
+
+    # --- validation (ConstructMPIResponse parity) ---
+
+    def validate(self, name: str, op: str, dtypes: Sequence[str],
+                 shapes: Sequence[Tuple[int, ...]],
+                 root_ranks: Optional[Sequence[int]],
+                 allow_dim0_mismatch: bool) -> Optional[str]:
+        n = len(dtypes)
+        c_dtypes = (ctypes.c_char_p * n)(
+            *[d.encode() for d in dtypes])
+        ndims = (ctypes.c_int * n)(*[len(s) for s in shapes])
+        flat = [d for s in shapes for d in s]
+        c_shapes = (ctypes.c_longlong * len(flat))(*flat)
+        c_roots = ((ctypes.c_int * n)(*root_ranks)
+                   if root_ranks is not None else None)
+        err = ctypes.create_string_buffer(_ERR_CAP)
+        rc = self.lib.hvd_native_validate(
+            name.encode(), op.encode(), n, c_dtypes, ndims, c_shapes,
+            c_roots, int(allow_dim0_mismatch), err, _ERR_CAP)
+        return err.value.decode() if rc else None
+
+    # --- timeline ---
+
+    def timeline_start(self, path: str) -> int:
+        return self.lib.hvd_native_timeline_start(path.encode())
+
+    def timeline_record(self, tensor: str, phase: str,
+                        activity: Optional[str] = None) -> None:
+        self.lib.hvd_native_timeline_record(
+            tensor.encode(), phase.encode(),
+            activity.encode() if activity else None)
+
+    def timeline_mark(self, tensor: str, name: str) -> None:
+        self.lib.hvd_native_timeline_mark(tensor.encode(), name.encode())
+
+    def timeline_stop(self) -> None:
+        self.lib.hvd_native_timeline_stop()
+
+    # --- stall detector ---
+
+    def stall_configure(self, warning_s: float,
+                        check_every_s: float = 10.0) -> None:
+        self.lib.hvd_native_stall_configure(warning_s, check_every_s)
+
+    def stall_start_thread(self) -> None:
+        self.lib.hvd_native_stall_start_thread()
+
+    def stall_stop_thread(self) -> None:
+        self.lib.hvd_native_stall_stop_thread()
+
+    def stall_begin(self, name: str) -> None:
+        self.lib.hvd_native_stall_begin(name.encode())
+
+    def stall_end(self, name: str) -> None:
+        self.lib.hvd_native_stall_end(name.encode())
+
+    def stall_check(self) -> List[str]:
+        out = ctypes.create_string_buffer(_ERR_CAP)
+        n = self.lib.hvd_native_stall_check(out, _ERR_CAP)
+        if n == 0:
+            return []
+        return out.value.decode().split(";")
+
+    # --- rendezvous ---
+
+    def serve(self, port: int, world: int) -> int:
+        """Start the coordinator server; returns the bound port."""
+        return self.lib.hvd_native_rendezvous_serve(port, world)
+
+    def serve_stop(self) -> None:
+        self.lib.hvd_native_rendezvous_stop()
+
+    def connect(self, host: str, port: int, timeout_s: float = 60.0) -> bool:
+        return self.lib.hvd_native_client_connect(
+            host.encode(), port, timeout_s) == 0
+
+    def close(self) -> None:
+        self.lib.hvd_native_client_close()
+
+    def kv_set(self, key: str, value: bytes) -> bool:
+        return self.lib.hvd_native_kv_set(
+            key.encode(), value, len(value)) == 0
+
+    def kv_get(self, key: str, timeout_ms: int = 60000) -> Optional[bytes]:
+        cap = 1 << 20
+        while True:
+            out = ctypes.create_string_buffer(cap)
+            n = self.lib.hvd_native_kv_get(
+                key.encode(), timeout_ms, out, cap)
+            if n < 0:
+                return None
+            if n <= cap:
+                return out.raw[:n]
+            cap = n  # value larger than the buffer: retry at full size
+
+    def barrier(self, barrier_id: str, timeout_ms: int = 60000) -> bool:
+        return self.lib.hvd_native_barrier(
+            barrier_id.encode(), timeout_ms) == 0
+
+    def ping(self) -> bool:
+        return self.lib.hvd_native_ping() == 0

@@ -1,0 +1,43 @@
+"""Lazy native build.
+
+The reference compiles its C++ at pip-install time against TF headers
+(`setup.py:264-337`); the TPU control plane has no framework header
+dependency, so it compiles on first use with plain g++ and is cached next
+to the source. A failed build degrades to the pure-Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+
+_SRC = os.path.join(os.path.dirname(__file__), "control_plane.cc")
+_OUT = os.path.join(os.path.dirname(__file__), "libhorovod_tpu_core.so")
+
+
+def build_if_needed() -> str:
+    """Compile the control plane if the .so is missing or stale.
+    Returns the library path; raises on compile failure."""
+    if (os.path.exists(_OUT)
+            and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC)):
+        return _OUT
+    # Build into a temp file then atomically rename, so concurrent
+    # processes (hvdrun workers) never load a half-written .so.
+    fd, tmp = tempfile.mkstemp(suffix=".so",
+                               dir=os.path.dirname(_OUT))
+    os.close(fd)
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _OUT)
+    except subprocess.CalledProcessError as e:
+        os.unlink(tmp)
+        raise RuntimeError(
+            f"native control plane build failed:\n{e.stderr}") from e
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return _OUT

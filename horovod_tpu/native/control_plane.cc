@@ -1,0 +1,770 @@
+// horovod_tpu native control plane.
+//
+// TPU-native equivalent of the reference's C++ core
+// (horovod/tensorflow/mpi_ops.cc): on TPU the *data plane* is XLA
+// collectives compiled by the SPMD partitioner, so what remains native is
+// the host control plane the reference also hand-writes:
+//
+//   1. Membership C API with -1/uninitialized semantics
+//      (mpi_ops.cc:1536-1563).
+//   2. Cross-rank collective-request validation — the contract of the
+//      coordinator's ConstructMPIResponse (mpi_ops.cc:266-474): dtype /
+//      shape / root-rank agreement, allgather dim-0 exemption.
+//   3. Chrome-trace timeline writer with the per-tensor
+//      {UNKNOWN, NEGOTIATING, TOP_LEVEL, ACTIVITY} state machine
+//      (timeline.h:37-42, timeline.cc:59-220), 1 s flush cadence.
+//   4. Stall detector: pending-op table + background sweep thread with
+//      the 60 s warning (mpi_ops.cc:228, 1150-1193).
+//   5. TCP rendezvous: a tiny coordinator (key-value store + barrier)
+//      replacing the reference's MPI_Send/Recv control messages on
+//      TAG_NOTIFY (mpi_ops.cc:225, 1321-1371) for multi-process
+//      bootstrap and eager-path metadata exchange.
+//
+// Exposed as a plain C ABI consumed via ctypes
+// (horovod_tpu/native/bindings.py), mirroring the reference's
+// ctypes.CDLL load (mpi_ops.py:68-77).
+//
+// Build: g++ -O2 -std=c++17 -shared -fPIC -pthread control_plane.cc
+//        -o libhorovod_tpu_core.so
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+double NowSeconds() {
+  using namespace std::chrono;
+  return duration<double>(steady_clock::now().time_since_epoch()).count();
+}
+
+// ---------------------------------------------------------------------------
+// 1. Membership
+// ---------------------------------------------------------------------------
+
+struct Membership {
+  std::atomic<bool> initialized{false};
+  int rank = -1;
+  int size = -1;
+  int local_rank = -1;
+  int local_size = -1;
+};
+
+Membership g_member;
+
+// ---------------------------------------------------------------------------
+// 3. Timeline
+// ---------------------------------------------------------------------------
+
+enum TensorState { UNKNOWN = 0, NEGOTIATING = 1, TOP_LEVEL = 2, ACTIVITY = 3 };
+
+class Timeline {
+ public:
+  bool Start(const std::string& path) {
+    std::lock_guard<std::mutex> lk(mu_);
+    file_ = std::fopen(path.c_str(), "w");
+    if (!file_) return false;
+    std::fputs("[\n", file_);
+    start_ = NowSeconds();
+    last_flush_ = start_;
+    return true;
+  }
+
+  void Record(const std::string& tensor, const std::string& phase,
+              const std::string& activity) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!file_) return;
+    int pid = Pid(tensor);
+    TensorState state = states_.count(tensor) ? states_[tensor] : UNKNOWN;
+    if (phase == "NEGOTIATING") {
+      Emit('B', "NEGOTIATE", pid, "");
+      states_[tensor] = NEGOTIATING;
+    } else if (phase == "TOP_LEVEL") {
+      if (state == NEGOTIATING) Emit('E', "NEGOTIATE", pid, "");
+      Emit('B', tensor, pid, "");
+      states_[tensor] = TOP_LEVEL;
+      if (!activity.empty()) {
+        Emit('B', activity, pid, "");
+        states_[tensor] = ACTIVITY;
+      }
+    } else if (phase == "DONE") {
+      if (state == ACTIVITY) Emit('E', "", pid, "");
+      if (state == TOP_LEVEL || state == ACTIVITY)
+        Emit('E', tensor, pid, "");
+      else if (state == NEGOTIATING)
+        Emit('E', "NEGOTIATE", pid, "");
+      states_[tensor] = UNKNOWN;
+    }
+    MaybeFlush();
+  }
+
+  void Mark(const std::string& tensor, const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!file_) return;
+    int pid = Pid(tensor);
+    std::fprintf(file_,
+                 "{\"ph\": \"X\", \"name\": \"%s\", \"pid\": %d, "
+                 "\"ts\": %lld, \"dur\": 0},\n",
+                 Escape(name).c_str(), pid, TsUs());
+    MaybeFlush();
+  }
+
+  void Stop() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!file_) return;
+    std::fputs("{}]\n", file_);
+    std::fclose(file_);
+    file_ = nullptr;
+    pids_.clear();
+    states_.clear();
+  }
+
+ private:
+  int Pid(const std::string& tensor) {
+    auto it = pids_.find(tensor);
+    if (it != pids_.end()) return it->second;
+    int pid = static_cast<int>(pids_.size());
+    pids_[tensor] = pid;
+    // Tensors are modeled as trace processes (timeline.cc:59-76).
+    std::fprintf(file_,
+                 "{\"name\": \"process_name\", \"ph\": \"M\", \"pid\": %d, "
+                 "\"args\": {\"name\": \"%s\"}},\n",
+                 pid, Escape(tensor).c_str());
+    return pid;
+  }
+
+  long long TsUs() {
+    return static_cast<long long>((NowSeconds() - start_) * 1e6);
+  }
+
+  static std::string Escape(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    for (char c : s) {
+      if (c == '"' || c == '\\') out.push_back('\\');
+      if (static_cast<unsigned char>(c) >= 0x20) out.push_back(c);
+    }
+    return out;
+  }
+
+  void Emit(char ph, const std::string& name, int pid,
+            const std::string& args) {
+    std::fprintf(file_,
+                 "{\"ph\": \"%c\", \"name\": \"%s\", \"pid\": %d, "
+                 "\"ts\": %lld%s},\n",
+                 ph, Escape(name).c_str(), pid, TsUs(),
+                 args.empty() ? "" : (", " + args).c_str());
+  }
+
+  void MaybeFlush() {
+    double now = NowSeconds();
+    if (now - last_flush_ >= 1.0) {  // timeline.h:35 flush cadence
+      std::fflush(file_);
+      last_flush_ = now;
+    }
+  }
+
+  std::mutex mu_;
+  std::FILE* file_ = nullptr;
+  std::unordered_map<std::string, int> pids_;
+  std::unordered_map<std::string, TensorState> states_;
+  double start_ = 0, last_flush_ = 0;
+};
+
+Timeline g_timeline;
+
+// ---------------------------------------------------------------------------
+// 4. Stall detector
+// ---------------------------------------------------------------------------
+
+class StallMonitor {
+ public:
+  void Configure(double warning_s, double check_every_s) {
+    std::lock_guard<std::mutex> lk(mu_);
+    warning_s_ = warning_s;
+    check_every_s_ = check_every_s;
+  }
+
+  void StartThread() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (running_) return;
+    running_ = true;
+    thread_ = std::thread([this] { Loop(); });
+  }
+
+  void StopThread() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!running_) return;
+      running_ = false;
+    }
+    cv_.notify_all();
+    if (thread_.joinable()) thread_.join();
+  }
+
+  void Begin(const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    pending_[name] = NowSeconds();
+  }
+
+  void End(const std::string& name) {
+    std::lock_guard<std::mutex> lk(mu_);
+    pending_.erase(name);
+    warned_.erase(name);
+  }
+
+  // Writes ";"-joined stalled names into out; returns count.
+  int Check(char* out, int cap) {
+    std::vector<std::string> stalled;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      double now = NowSeconds();
+      for (auto& kv : pending_) {
+        if (now - kv.second > warning_s_ && !warned_.count(kv.first)) {
+          stalled.push_back(kv.first);
+          warned_.insert(kv.first);
+        }
+      }
+    }
+    if (!stalled.empty()) {
+      // Message shape follows mpi_ops.cc:1166-1186.
+      std::fprintf(stderr,
+                   "WARNING: One or more tensors were submitted to be "
+                   "reduced, gathered or broadcasted by subset of ranks and "
+                   "are waiting for remainder of ranks for more than %d "
+                   "seconds. This may indicate that different ranks are "
+                   "trying to submit different tensors or that only subset "
+                   "of ranks is submitting tensors, which will cause "
+                   "deadlock.\nStalled ops:");
+      for (auto& s : stalled) std::fprintf(stderr, " %s", s.c_str());
+      std::fprintf(stderr, "\n");
+    }
+    std::string joined;
+    for (size_t i = 0; i < stalled.size(); ++i) {
+      if (i) joined += ";";
+      joined += stalled[i];
+    }
+    if (out && cap > 0) {
+      std::snprintf(out, cap, "%s", joined.c_str());
+    }
+    return static_cast<int>(stalled.size());
+  }
+
+ private:
+  void Loop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (running_) {
+      cv_.wait_for(lk, std::chrono::duration<double>(check_every_s_));
+      if (!running_) break;
+      lk.unlock();
+      Check(nullptr, 0);
+      lk.lock();
+    }
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::thread thread_;
+  bool running_ = false;
+  double warning_s_ = 60.0;  // mpi_ops.cc:228
+  double check_every_s_ = 10.0;
+  std::map<std::string, double> pending_;
+  std::set<std::string> warned_;
+};
+
+StallMonitor g_stall;
+
+// ---------------------------------------------------------------------------
+// 5. TCP rendezvous: key-value store + barrier
+// ---------------------------------------------------------------------------
+
+// Wire format: u32 length | u8 op | u32 klen | key | u32 vlen | val
+// ops: 1=SET 2=GET(blocking, val=timeout_ms as decimal string)
+//      3=BARRIER(key=barrier id) 4=PING
+// Replies: u32 length | u8 status(0=ok,1=timeout/err) | u32 vlen | val
+
+struct KvStore {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<std::string, std::string> data;
+  std::unordered_map<std::string, int> barrier_count;
+  std::unordered_map<std::string, int> barrier_generation;
+  int world = 0;
+};
+
+class RendezvousServer {
+ public:
+  // Returns the bound port (0 on failure).
+  int Serve(int port, int world) {
+    kv_.world = world;
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return 0;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0)
+      return 0;
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    int bound = ntohs(addr.sin_port);
+    if (::listen(listen_fd_, 128) != 0) return 0;
+    running_ = true;
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return bound;
+  }
+
+  void Stop() {
+    if (!running_) return;
+    running_ = false;
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    // Wake handlers parked in recv() (shutdown their sockets) or in a
+    // kv condition wait (notify; predicates re-check running_), then
+    // join — otherwise Stop() deadlocks on live connections.
+    std::vector<std::thread> to_join;
+    {
+      std::lock_guard<std::mutex> lk(threads_mu_);
+      for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+      to_join.swap(conn_threads_);
+    }
+    kv_.cv.notify_all();
+    // Join without holding threads_mu_ — exiting handlers take it to
+    // deregister their fd.
+    for (auto& t : to_join)
+      if (t.joinable()) t.join();
+    std::lock_guard<std::mutex> lk(threads_mu_);
+    conn_fds_.clear();
+  }
+
+  KvStore kv_;
+
+ private:
+  void AcceptLoop() {
+    while (running_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      std::lock_guard<std::mutex> lk(threads_mu_);
+      conn_fds_.insert(fd);
+      conn_threads_.emplace_back([this, fd] { Handle(fd); });
+    }
+  }
+
+  static bool ReadFull(int fd, void* buf, size_t n) {
+    char* p = static_cast<char*>(buf);
+    while (n) {
+      ssize_t r = ::recv(fd, p, n, 0);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static bool WriteFull(int fd, const void* buf, size_t n) {
+    const char* p = static_cast<const char*>(buf);
+    while (n) {
+      ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  static void Reply(int fd, uint8_t status, const std::string& val) {
+    uint32_t len = htonl(static_cast<uint32_t>(1 + 4 + val.size()));
+    uint32_t vlen = htonl(static_cast<uint32_t>(val.size()));
+    WriteFull(fd, &len, 4);
+    WriteFull(fd, &status, 1);
+    WriteFull(fd, &vlen, 4);
+    if (!val.empty()) WriteFull(fd, val.data(), val.size());
+  }
+
+  void Handle(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    while (running_) {
+      uint32_t len_n;
+      if (!ReadFull(fd, &len_n, 4)) break;
+      uint32_t len = ntohl(len_n);
+      if (len < 9 || len > (64u << 20)) break;
+      std::vector<char> buf(len);
+      if (!ReadFull(fd, buf.data(), len)) break;
+      uint8_t op = static_cast<uint8_t>(buf[0]);
+      uint32_t klen = ntohl(*reinterpret_cast<uint32_t*>(&buf[1]));
+      // 64-bit arithmetic: u32 sums wrap on corrupt frames and would
+      // pass the bounds check into an out-of-bounds read.
+      if (5ull + klen + 4ull > len) break;
+      std::string key(&buf[5], klen);
+      uint32_t vlen = ntohl(*reinterpret_cast<uint32_t*>(&buf[5 + klen]));
+      if (9ull + klen + vlen > len) break;
+      std::string val(&buf[9 + klen], vlen);
+
+      if (op == 1) {  // SET
+        {
+          std::lock_guard<std::mutex> lk(kv_.mu);
+          kv_.data[key] = val;
+        }
+        kv_.cv.notify_all();
+        Reply(fd, 0, "");
+      } else if (op == 2) {  // GET with timeout
+        long timeout_ms = atol(val.c_str());
+        std::unique_lock<std::mutex> lk(kv_.mu);
+        bool ok = kv_.cv.wait_for(
+            lk, std::chrono::milliseconds(timeout_ms),
+            [&] { return !running_ || kv_.data.count(key) > 0; });
+        ok = ok && kv_.data.count(key) > 0;
+        std::string out = ok ? kv_.data[key] : "";
+        lk.unlock();
+        Reply(fd, ok ? 0 : 1, out);
+      } else if (op == 3) {  // BARRIER
+        std::unique_lock<std::mutex> lk(kv_.mu);
+        int gen = kv_.barrier_generation[key];
+        if (++kv_.barrier_count[key] >= kv_.world) {
+          kv_.barrier_count[key] = 0;
+          kv_.barrier_generation[key] = gen + 1;
+          lk.unlock();
+          kv_.cv.notify_all();
+          Reply(fd, 0, "");
+        } else {
+          bool ok = kv_.cv.wait_for(
+              lk, std::chrono::milliseconds(atol(val.c_str())),
+              [&] {
+                return !running_ || kv_.barrier_generation[key] != gen;
+              });
+          ok = ok && kv_.barrier_generation[key] != gen;
+          if (!ok && kv_.barrier_generation[key] == gen &&
+              kv_.barrier_count[key] > 0) {
+            // Timed out: withdraw this participant so a retry (or the
+            // next use of the id) still needs `world` distinct arrivals.
+            --kv_.barrier_count[key];
+          }
+          lk.unlock();
+          Reply(fd, ok ? 0 : 1, "");
+        }
+      } else if (op == 4) {  // PING
+        Reply(fd, 0, "pong");
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+    std::lock_guard<std::mutex> lk(threads_mu_);
+    conn_fds_.erase(fd);
+  }
+
+  std::atomic<bool> running_{false};
+  int listen_fd_ = -1;
+  std::thread accept_thread_;
+  std::mutex threads_mu_;
+  std::vector<std::thread> conn_threads_;
+  std::set<int> conn_fds_;
+};
+
+RendezvousServer g_server;
+
+class RendezvousClient {
+ public:
+  bool Connect(const std::string& host, int port, double timeout_s) {
+    double deadline = NowSeconds() + timeout_s;
+    while (NowSeconds() < deadline) {
+      fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+        // Hostname: resolve via getaddrinfo (multi-node coordinators
+        // are usually named, not dotted-quad).
+        addrinfo hints{};
+        hints.ai_family = AF_INET;
+        hints.ai_socktype = SOCK_STREAM;
+        addrinfo* res = nullptr;
+        if (::getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 ||
+            res == nullptr) {
+          ::close(fd_);
+          fd_ = -1;
+          std::this_thread::sleep_for(std::chrono::milliseconds(200));
+          continue;
+        }
+        addr.sin_addr =
+            reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+        ::freeaddrinfo(res);
+      }
+      if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr),
+                    sizeof(addr)) == 0) {
+        int one = 1;
+        ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd_);
+      std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    }
+    fd_ = -1;
+    return false;
+  }
+
+  // Returns status (0 ok), fills reply.
+  int Request(uint8_t op, const std::string& key, const std::string& val,
+              std::string* reply) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (fd_ < 0) return 2;
+    uint32_t payload = static_cast<uint32_t>(1 + 4 + key.size() + 4 +
+                                             val.size());
+    uint32_t len_n = htonl(payload);
+    uint32_t klen_n = htonl(static_cast<uint32_t>(key.size()));
+    uint32_t vlen_n = htonl(static_cast<uint32_t>(val.size()));
+    if (!WriteFull(fd_, &len_n, 4) || !WriteFull(fd_, &op, 1) ||
+        !WriteFull(fd_, &klen_n, 4) ||
+        !WriteFull(fd_, key.data(), key.size()) ||
+        !WriteFull(fd_, &vlen_n, 4) ||
+        !WriteFull(fd_, val.data(), val.size()))
+      return 2;
+    uint32_t rlen_n;
+    if (!ReadFull(fd_, &rlen_n, 4)) return 2;
+    uint32_t rlen = ntohl(rlen_n);
+    std::vector<char> buf(rlen);
+    if (!ReadFull(fd_, buf.data(), rlen)) return 2;
+    uint8_t status = static_cast<uint8_t>(buf[0]);
+    uint32_t vlen = ntohl(*reinterpret_cast<uint32_t*>(&buf[1]));
+    if (reply) reply->assign(&buf[5], vlen);
+    return status;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (fd_ >= 0) ::close(fd_);
+    fd_ = -1;
+  }
+
+ private:
+  static bool ReadFull(int fd, void* buf, size_t n) {
+    char* p = static_cast<char*>(buf);
+    while (n) {
+      ssize_t r = ::recv(fd, p, n, 0);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+  static bool WriteFull(int fd, const void* buf, size_t n) {
+    const char* p = static_cast<const char*>(buf);
+    while (n) {
+      ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+      if (r <= 0) return false;
+      p += r;
+      n -= static_cast<size_t>(r);
+    }
+    return true;
+  }
+
+  std::mutex mu_;
+  int fd_ = -1;
+};
+
+RendezvousClient g_client;
+
+thread_local std::string g_last_error;
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// --- membership (mpi_ops.cc:1536-1563 parity) ---
+
+int hvd_native_init(int rank, int size, int local_rank, int local_size) {
+  g_member.rank = rank;
+  g_member.size = size;
+  g_member.local_rank = local_rank;
+  g_member.local_size = local_size;
+  g_member.initialized.store(true);
+  return 0;
+}
+
+int hvd_native_rank() {
+  return g_member.initialized.load() ? g_member.rank : -1;
+}
+int hvd_native_size() {
+  return g_member.initialized.load() ? g_member.size : -1;
+}
+int hvd_native_local_rank() {
+  return g_member.initialized.load() ? g_member.local_rank : -1;
+}
+int hvd_native_local_size() {
+  return g_member.initialized.load() ? g_member.local_size : -1;
+}
+
+int hvd_native_shutdown() {
+  g_member.initialized.store(false);
+  g_member.rank = g_member.size = -1;
+  g_member.local_rank = g_member.local_size = -1;
+  g_stall.StopThread();
+  g_timeline.Stop();
+  return 0;
+}
+
+// --- validation (ConstructMPIResponse parity, mpi_ops.cc:266-474) ---
+//
+// dtypes: nranks C strings. shapes: flattened int64 dims; ndims[i] gives
+// rank i's dim count. root_ranks: nranks ints or NULL. Returns 0 when
+// consistent; 1 and writes a message into err (cap bytes) otherwise.
+
+int hvd_native_validate(const char* name, const char* op, int nranks,
+                        const char** dtypes, const int* ndims,
+                        const long long* shapes, const int* root_ranks,
+                        int allow_dim0_mismatch, char* err, int cap) {
+  auto fail = [&](const std::string& msg) {
+    if (err && cap > 0) std::snprintf(err, cap, "%s", msg.c_str());
+    return 1;
+  };
+  (void)op;
+  for (int r = 1; r < nranks; ++r) {
+    if (std::strcmp(dtypes[r], dtypes[0]) != 0) {
+      return fail(std::string("Mismatched data types: One or more ranks "
+                              "submitted tensor ") + name +
+                  " with dtype " + dtypes[r] + ", but rank 0 submitted "
+                  "dtype " + dtypes[0] + ".");
+    }
+  }
+  if (root_ranks) {
+    for (int r = 1; r < nranks; ++r) {
+      if (root_ranks[r] != root_ranks[0]) {
+        return fail(std::string("Mismatched root ranks: One or more "
+                                "ranks submitted tensor ") + name +
+                    " with root rank " + std::to_string(root_ranks[r]) +
+                    ", but rank 0 submitted root rank " +
+                    std::to_string(root_ranks[0]) + ".");
+      }
+    }
+  }
+  std::vector<int> offset(nranks, 0);
+  int acc = 0;
+  for (int r = 0; r < nranks; ++r) {
+    offset[r] = acc;
+    acc += ndims[r];
+  }
+  for (int r = 1; r < nranks; ++r) {
+    if (ndims[r] != ndims[0]) {
+      return fail(std::string("Mismatched tensor ranks: tensor ") + name +
+                  " has rank " + std::to_string(ndims[r]) + " on rank " +
+                  std::to_string(r) + " but " + std::to_string(ndims[0]) +
+                  " on rank 0.");
+    }
+    int start = allow_dim0_mismatch ? 1 : 0;
+    for (int d = start; d < ndims[r]; ++d) {
+      if (shapes[offset[r] + d] != shapes[offset[0] + d]) {
+        std::string what =
+            allow_dim0_mismatch ? "non-first dimensions" : "shapes";
+        std::string s0 = "(", sr = "(";
+        for (int k = 0; k < ndims[0]; ++k)
+          s0 += std::to_string(shapes[offset[0] + k]) +
+                (k + 1 < ndims[0] ? ", " : "");
+        for (int k = 0; k < ndims[r]; ++k)
+          sr += std::to_string(shapes[offset[r] + k]) +
+                (k + 1 < ndims[r] ? ", " : "");
+        if (ndims[0] == 1) s0 += ",";
+        if (ndims[r] == 1) sr += ",";
+        s0 += ")";
+        sr += ")";
+        return fail(std::string("Mismatched ") + what + ": tensor " +
+                    name + " has shape " + sr + " on rank " +
+                    std::to_string(r) + " but " + s0 + " on rank 0.");
+      }
+    }
+  }
+  return 0;
+}
+
+// --- timeline ---
+
+int hvd_native_timeline_start(const char* path) {
+  return g_timeline.Start(path) ? 0 : 1;
+}
+void hvd_native_timeline_record(const char* tensor, const char* phase,
+                                const char* activity) {
+  g_timeline.Record(tensor, phase, activity ? activity : "");
+}
+void hvd_native_timeline_mark(const char* tensor, const char* name) {
+  g_timeline.Mark(tensor, name);
+}
+void hvd_native_timeline_stop() { g_timeline.Stop(); }
+
+// --- stall detector ---
+
+void hvd_native_stall_configure(double warning_s, double check_every_s) {
+  g_stall.Configure(warning_s, check_every_s);
+}
+void hvd_native_stall_start_thread() { g_stall.StartThread(); }
+void hvd_native_stall_stop_thread() { g_stall.StopThread(); }
+void hvd_native_stall_begin(const char* name) { g_stall.Begin(name); }
+void hvd_native_stall_end(const char* name) { g_stall.End(name); }
+int hvd_native_stall_check(char* out, int cap) {
+  return g_stall.Check(out, cap);
+}
+
+// --- rendezvous ---
+
+int hvd_native_rendezvous_serve(int port, int world) {
+  return g_server.Serve(port, world);
+}
+void hvd_native_rendezvous_stop() { g_server.Stop(); }
+
+int hvd_native_client_connect(const char* host, int port,
+                              double timeout_s) {
+  return g_client.Connect(host, port, timeout_s) ? 0 : 1;
+}
+void hvd_native_client_close() { g_client.Close(); }
+
+int hvd_native_kv_set(const char* key, const char* val, int vlen) {
+  return g_client.Request(1, key, std::string(val, vlen), nullptr);
+}
+
+// Returns length of value (-1 on timeout/error); copies into out.
+int hvd_native_kv_get(const char* key, long timeout_ms, char* out,
+                      int cap) {
+  std::string reply;
+  int status = g_client.Request(2, key, std::to_string(timeout_ms), &reply);
+  if (status != 0) return -1;
+  int n = static_cast<int>(reply.size());
+  if (out && cap > 0)
+    std::memcpy(out, reply.data(),
+                static_cast<size_t>(n < cap ? n : cap));
+  return n;
+}
+
+int hvd_native_barrier(const char* id, long timeout_ms) {
+  return g_client.Request(3, id, std::to_string(timeout_ms), nullptr);
+}
+
+int hvd_native_ping() {
+  std::string reply;
+  return g_client.Request(4, "", "", &reply) == 0 && reply == "pong" ? 0 : 1;
+}
+
+}  // extern "C"

@@ -39,8 +39,13 @@ def allreduce(x: jax.Array, *, average: bool = True,
     if not average:
         return lax.psum(x, axis_name)
     if jnp.issubdtype(x.dtype, jnp.integer):
-        return lax.psum(x, axis_name) // lax.psum(
-            jnp.ones((), x.dtype), axis_name)
+        # Accumulate narrow ints in int32: both the sum and the divisor
+        # would wrap in e.g. int8 beyond 127 ranks. (The reference only
+        # admits int32/int64 to allreduce, mpi_ops.cc:1777.)
+        acc = x.dtype if x.dtype.itemsize >= 4 else jnp.int32
+        summed = lax.psum(x.astype(acc), axis_name)
+        divisor = lax.psum(jnp.ones((), jnp.int32), axis_name)
+        return (summed // divisor.astype(acc)).astype(x.dtype)
     return lax.pmean(x, axis_name)
 
 

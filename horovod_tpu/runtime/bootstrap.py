@@ -125,15 +125,18 @@ def init(devices: Optional[Sequence] = None,
             try:
                 from horovod_tpu.native import load_native
                 st.native = load_native()
+                st.native.init(st.rank, st.size, st.local_rank,
+                               st.local_size)
             except Exception:
                 st.native = None  # graceful pure-Python degradation
 
         if config.timeline_path:
             from horovod_tpu.utils.timeline import Timeline
-            st.timeline = Timeline(config.timeline_path)
+            st.timeline = Timeline(config.timeline_path, native=st.native)
 
         from horovod_tpu.utils.stall import StallMonitor
-        st.stall_monitor = StallMonitor(config.stall_warning_time)
+        st.stall_monitor = StallMonitor(config.stall_warning_time,
+                                        native=st.native)
 
         st.initialized = True
         return 0
@@ -149,6 +152,9 @@ def shutdown() -> None:
             st.timeline.close()
         if st.stall_monitor is not None:
             st.stall_monitor.stop()
+        if st.native is not None:
+            st.native.shutdown()
+            st.native = None
         st.reset()
         st.shut_down = True  # observable until the next init()
 

@@ -19,29 +19,54 @@ import time
 
 
 class StallMonitor:
-    def __init__(self, warning_time_s: float = 60.0, check_every_s: float = 10.0):
+    def __init__(self, warning_time_s: float = 60.0,
+                 check_every_s: float = 10.0, native=None):
+        # Delegate to the C++ detector (control_plane.cc) when loaded;
+        # it runs its own sweep thread.
+        self._native = None
+        if native is not None:
+            try:
+                native.stall_configure(warning_time_s, check_every_s)
+                native.stall_start_thread()
+                self._native = native
+            except Exception:
+                self._native = None
         self._warning_time = warning_time_s
         self._check_every = check_every_s
         self._lock = threading.Lock()
         self._pending = {}   # name -> start timestamp
         self._warned = set()
         self._stop = threading.Event()
-        self._thread = threading.Thread(
-            target=self._loop, name="hvd-stall-monitor", daemon=True)
-        self._thread.start()
+        if self._native is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="hvd-stall-monitor", daemon=True)
+            self._thread.start()
 
     def begin(self, name: str):
+        if self._native is not None:
+            self._native.stall_begin(name)
+            return
         with self._lock:
             self._pending[name] = time.time()
 
     def end(self, name: str):
+        if self._native is not None:
+            self._native.stall_end(name)
+            return
         with self._lock:
             self._pending.pop(name, None)
             self._warned.discard(name)
 
     def check_once(self, now=None):
-        """One stall sweep; returns the list of stalled op names
-        (exposed for tests)."""
+        """One stall sweep; returns newly-stalled op names (warn-once,
+        like the reference). `now` overrides the clock for tests and is
+        honored only by the pure-Python backend; on the native backend
+        the C++ sweep thread may consume a stall first — programmatic
+        polling should use a large `check_every_s` (as the tests do) or
+        the Python backend.
+        """
+        if self._native is not None:
+            return self._native.stall_check()
         now = now if now is not None else time.time()
         stalled = []
         with self._lock:
@@ -67,4 +92,7 @@ class StallMonitor:
             self.check_once()
 
     def stop(self):
+        if self._native is not None:
+            self._native.stall_stop_thread()
+            return
         self._stop.set()

@@ -31,7 +31,16 @@ FLUSH_INTERVAL_S = 1.0  # timeline.h:35
 
 
 class Timeline:
-    def __init__(self, path: str):
+    def __init__(self, path: str, native=None):
+        # Prefer the C++ writer (horovod_tpu/native/control_plane.cc) when
+        # the control plane is loaded; same format, off the Python lock.
+        self._native = None
+        if native is not None:
+            try:
+                if native.timeline_start(path) == 0:
+                    self._native = native
+            except Exception:
+                self._native = None
         self._path = path
         self._lock = threading.Lock()
         self._pids = {}           # tensor name -> pid
@@ -40,9 +49,20 @@ class Timeline:
         self._last_flush = time.time()
         self._start = time.time()
         self._closed = False
-        # Truncate/create the file with the JSON array opener.
-        with open(self._path, "w") as f:
-            f.write("[\n")
+        if self._native is None:
+            try:
+                # Truncate/create the file with the JSON array opener.
+                with open(self._path, "w") as f:
+                    f.write("[\n")
+            except OSError as e:
+                # Warn and disable, don't fail training — the reference's
+                # behavior on an unwritable timeline (timeline.cc:32-34,
+                # 100-103).
+                import sys
+                sys.stderr.write(
+                    f"WARNING: Error opening the Horovod Timeline file "
+                    f"{self._path!r}, will not write a timeline: {e}\n")
+                self._closed = True
 
     def _ts_us(self) -> int:
         return int((time.time() - self._start) * 1e6)
@@ -70,6 +90,10 @@ class Timeline:
         activity span (the reference's ACTIVITY_START_ALL vocabulary:
         ALLREDUCE, ALLGATHER, BCAST, MEMCPY_IN_FUSION_BUFFER, ...).
         """
+        if self._native is not None:
+            if not self._closed:
+                self._native.timeline_record(tensor, phase, activity)
+            return
         with self._lock:
             if self._closed:
                 return
@@ -98,6 +122,10 @@ class Timeline:
 
     def mark(self, tensor: str, name: str):
         """Instant event (`X`, timeline.cc:78-92)."""
+        if self._native is not None:
+            if not self._closed:
+                self._native.timeline_mark(tensor, name)
+            return
         with self._lock:
             if self._closed:
                 return
@@ -118,6 +146,11 @@ class Timeline:
         self._last_flush = time.time()
 
     def close(self):
+        if self._native is not None:
+            if not self._closed:
+                self._native.timeline_stop()
+                self._closed = True
+            return
         with self._lock:
             if self._closed:
                 return
@@ -136,7 +169,7 @@ def start_timeline(path: str):
     st = _state.check_initialized()
     if st.timeline is not None:
         st.timeline.close()
-    st.timeline = Timeline(path)
+    st.timeline = Timeline(path, native=st.native)
     return st.timeline
 
 
