@@ -50,6 +50,7 @@ from horovod_tpu.jax import (
     broadcast_optimizer_state,
     broadcast_object,
     make_train_step,
+    make_global_batch,
 )
 from horovod_tpu.ops.sparse import IndexedSlices
 from horovod_tpu.runtime.config import config
@@ -66,6 +67,6 @@ __all__ = [
     "DistributedOptimizer", "DistributedGradientTape", "allreduce_gradients",
     "broadcast_global_variables", "broadcast_parameters",
     "broadcast_optimizer_state", "broadcast_object",
-    "make_train_step", "IndexedSlices", "config",
+    "make_train_step", "make_global_batch", "IndexedSlices", "config",
     "start_timeline", "stop_timeline",
 ]

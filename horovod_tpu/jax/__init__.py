@@ -166,11 +166,37 @@ def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
     st = _state.check_initialized()
     if st.num_processes <= 1:
         return obj
-    # Length exchange + payload broadcast over the multi-controller
-    # collective path; lands with the hvdrun launcher.
-    raise NotImplementedError(
-        "broadcast_object across processes requires the hvdrun "
-        "multi-controller collective path (not built yet)")
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # Length exchange first (scalars agree in shape on every rank), then
+    # the padded payload — broadcast requires identical shapes across
+    # ranks, like the reference (`mpi_ops.cc:409-430`).
+    n = int(np.asarray(eager.broadcast(
+        np.int64(payload.size), root_rank, name="bcast_object_len")))
+    buf = np.zeros(n, np.uint8)
+    if st.process_rank == root_rank:
+        buf[:] = payload[:n]
+    out = np.asarray(eager.broadcast(buf, root_rank,
+                                     name="bcast_object_payload"))
+    return pickle.loads(out.tobytes())
+
+
+def make_global_batch(batch: Any, *, axis_name: Optional[str] = None) -> Any:
+    """Assemble per-process local batches into global arrays sharded over
+    the data axis — how a multi-controller training loop feeds
+    `make_train_step` (each process loads its own shard, the reference's
+    per-worker data sharding pattern, `examples/keras_mnist_advanced.py:
+    113-119`). A no-op returning device arrays in single-controller mode.
+    """
+    import jax as _jax
+    from jax.sharding import NamedSharding
+    st = _state.check_initialized()
+    axis = axis_name or st.axis_name
+    sharding = NamedSharding(st.mesh, P(axis))
+    if st.num_processes <= 1:
+        return jax.tree.map(jnp.asarray, batch)
+    return jax.tree.map(
+        lambda x: _jax.make_array_from_process_local_data(
+            sharding, np.asarray(x)), batch)
 
 
 def make_train_step(loss_fn: Callable, tx: optax.GradientTransformation,

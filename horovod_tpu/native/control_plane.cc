@@ -188,7 +188,12 @@ class Timeline {
   double start_ = 0, last_flush_ = 0;
 };
 
-Timeline g_timeline;
+// Globals are heap-allocated and intentionally leaked: running their
+// destructors at static-teardown time while worker threads are parked on
+// member mutexes/CVs is UB (and a joinable std::thread's destructor is
+// std::terminate) — the classic cause of SIGABRT at exit in processes
+// that never call hvd.shutdown().
+Timeline& g_timeline = *new Timeline;
 
 // ---------------------------------------------------------------------------
 // 4. Stall detector
@@ -289,7 +294,7 @@ class StallMonitor {
   std::set<std::string> warned_;
 };
 
-StallMonitor g_stall;
+StallMonitor& g_stall = *new StallMonitor;
 
 // ---------------------------------------------------------------------------
 // 5. TCP rendezvous: key-value store + barrier
@@ -304,6 +309,7 @@ struct KvStore {
   std::mutex mu;
   std::condition_variable cv;
   std::unordered_map<std::string, std::string> data;
+  std::unordered_map<std::string, int> read_count;
   std::unordered_map<std::string, int> barrier_count;
   std::unordered_map<std::string, int> barrier_generation;
   int world = 0;
@@ -437,6 +443,15 @@ class RendezvousServer {
             [&] { return !running_ || kv_.data.count(key) > 0; });
         ok = ok && kv_.data.count(key) > 0;
         std::string out = ok ? kv_.data[key] : "";
+        // Negotiation entries ("req/...") are read exactly once per
+        // process; reap after the world-th read so the store doesn't
+        // grow per collective call (the reference coordinator likewise
+        // drops a tensor's entry once the response is sent).
+        if (ok && key.rfind("req/", 0) == 0 &&
+            ++kv_.read_count[key] >= kv_.world) {
+          kv_.data.erase(key);
+          kv_.read_count.erase(key);
+        }
         lk.unlock();
         Reply(fd, ok ? 0 : 1, out);
       } else if (op == 3) {  // BARRIER
@@ -483,7 +498,7 @@ class RendezvousServer {
   std::set<int> conn_fds_;
 };
 
-RendezvousServer g_server;
+RendezvousServer& g_server = *new RendezvousServer;
 
 class RendezvousClient {
  public:
@@ -584,7 +599,7 @@ class RendezvousClient {
   int fd_ = -1;
 };
 
-RendezvousClient g_client;
+RendezvousClient& g_client = *new RendezvousClient;
 
 thread_local std::string g_last_error;
 

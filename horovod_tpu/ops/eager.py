@@ -54,31 +54,96 @@ def _normalize_name(name: str) -> str:
     return re.sub(r"[^a-zA-Z0-9_]", "_", name)
 
 
-def _auto_name(prefix: str, name: Optional[str], tensor) -> str:
+def _auto_name(prefix: str, name: Optional[str], tensor,
+               skip_dim0: bool = False, content_free: bool = False) -> str:
     """Stable auto-name keyed on op/shape/dtype, mirroring the reference's
     naming by tensor graph name (`mpi_ops.py:143-144`) — stable across
-    steps so timeline pids and the stall table don't grow per call."""
+    steps so timeline pids and the stall table don't grow per call.
+    skip_dim0: allgather inputs may legitimately differ in dim 0 across
+    ranks, and negotiation keys on the name, so dim 0 stays out of it.
+    content_free: multi-controller negotiation must produce the SAME name
+    on processes that *disagree* on shape/dtype (that disagreement is what
+    validation exists to catch), so auto-names there carry no tensor
+    metadata — cross-process identity comes from call order, the same
+    consistent-op-order contract Horovod itself requires."""
     if name is not None:
         return _normalize_name(name)
+    if content_free:
+        return prefix
     if isinstance(tensor, PerRank):
         v = tensor.values[0]
         shape, dtype = v.shape, v.dtype
     else:
         v = np.asarray(tensor) if not hasattr(tensor, "shape") else tensor
         shape, dtype = tuple(v.shape), v.dtype
+    if skip_dim0:
+        shape = ("v",) + tuple(shape[1:])
     dims = "x".join(map(str, shape)) or "scalar"
     return f"{prefix}_{dims}_{dtype}"
 
 
-def _check_multicontroller(st, op: str):
-    """Multi-controller eager collectives land with the hvdrun launcher;
-    until then fail loudly rather than silently skipping communication."""
-    if st.num_processes > 1:
-        raise NotImplementedError(
-            f"eager {op} of a plain (non-per_rank) array across "
-            f"{st.num_processes} processes requires the hvdrun "
-            f"multi-controller path; wrap per-device values explicitly or "
-            f"use the SPMD API inside shard_map.")
+def _is_multicontroller(st) -> bool:
+    return st.num_processes > 1
+
+
+def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
+                  root_rank: Optional[int], allow_dim0: bool):
+    """Per-op metadata negotiation over the launcher's rendezvous server.
+
+    The runtime equivalent of the reference's coordinator protocol
+    (SURVEY §3.2 right half): every process posts its request
+    (name/op/dtype/shape/root) to the KV store, reads all peers', and
+    validates — the same checks `ConstructMPIResponse` runs on rank 0
+    (`mpi_ops.cc:266-474`), executed symmetrically so every process
+    raises the same error instead of hanging. Returns per-process metas.
+    """
+    import json
+    if st.native is None:
+        raise RuntimeError("multi-process eager collectives require the "
+                           "native control plane")
+    seq = st.op_cache.setdefault("_mc_seq", {})
+    cnt = seq.get(opname, 0)
+    seq[opname] = cnt + 1
+    meta = {"dtype": str(arr.dtype), "shape": list(arr.shape),
+            "op": op, "root": root_rank}
+    st.native.kv_set(f"req/{opname}/{cnt}/{st.process_rank}",
+                     json.dumps(meta).encode())
+    metas = []
+    for r in range(st.num_processes):
+        v = st.native.kv_get(f"req/{opname}/{cnt}/{r}", timeout_ms=60000)
+        if v is None:
+            raise RuntimeError(
+                f"negotiation timeout for {opname}: process {r} never "
+                f"submitted a request (see stall warnings)")
+        metas.append(json.loads(v.decode()))
+    from horovod_tpu.ops.validation import validate_requests
+    validate_requests(
+        name=opname, op=op,
+        dtypes=[m["dtype"] for m in metas],
+        shapes=[tuple(m["shape"]) for m in metas],
+        root_ranks=([m["root"] for m in metas]
+                    if root_rank is not None else None),
+        allow_dim0_mismatch=allow_dim0,
+        native=st.native)
+    return metas
+
+
+def _mc_local_devices(st):
+    import jax
+    pidx = jax.process_index()
+    return [d for d in st.devices if d.process_index == pidx]
+
+
+def _mc_global_array(st, local_block: np.ndarray) -> jax.Array:
+    """Assemble the [world, ...] global array where every device owned by
+    this process holds `local_block` as its shard."""
+    sharding = NamedSharding(st.mesh, P(st.axis_name))
+    shape = (st.size,) + local_block.shape
+    block = jnp.asarray(local_block)[None]
+    shards = [jax.device_put(block, d) for d in _mc_local_devices(st)]
+    return jax.make_array_from_single_device_arrays(shape, sharding, shards)
+
+
 
 
 def _timeline(st, name, phase, activity=None):
@@ -113,8 +178,12 @@ def _shard_over_mesh(st, stacked: np.ndarray) -> jax.Array:
     return jax.device_put(jnp.asarray(stacked), sharding)
 
 
-def _run_collective(st, key, fn, stacked):
-    """Dispatch a cached shard_map'd collective over the framework mesh."""
+def _run_collective(st, key, fn, data):
+    """Dispatch a cached shard_map'd collective over the framework mesh.
+
+    `data` is either a host [world, ...] stack (single-controller) or an
+    already-placed global jax.Array (multi-controller).
+    """
     jitted = st.op_cache.get(key)
     if jitted is None:
         # check_vma=False: all_gather outputs are replicated by
@@ -128,7 +197,9 @@ def _run_collective(st, key, fn, stacked):
         )
         jitted = jax.jit(shaped)
         st.op_cache[key] = jitted
-    return jitted(_shard_over_mesh(st, stacked))
+    if not isinstance(data, jax.Array):
+        data = _shard_over_mesh(st, data)
+    return jitted(data)
 
 
 def allreduce(tensor, average: bool = True, name: Optional[str] = None):
@@ -143,7 +214,8 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
     st = _state.check_initialized()
     if isinstance(tensor, IndexedSlices):
         return allreduce_indexed_slices(tensor, average=average, name=name)
-    opname = _auto_name("HorovodAllreduce", name, tensor)
+    opname = _auto_name("HorovodAllreduce", name, tensor,
+                        content_free=_is_multicontroller(st))
     st.stall_monitor and st.stall_monitor.begin(opname)
     _timeline(st, opname, "NEGOTIATING")
     try:
@@ -161,8 +233,30 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
                                    axis_name=st.axis_name)
             key = ("allreduce", average, stacked.shape, str(stacked.dtype))
             return _run_collective(st, key, _kernel, stacked)
+        if _is_multicontroller(st):
+            # True MPMD path: this process's local tensor, reduced across
+            # processes after KV negotiation. Each process replicates its
+            # block onto all k of its local devices, so the device psum
+            # overcounts by exactly k — divide it back out; ranks are
+            # processes here, matching Horovod's process-rank model.
+            x = np.asarray(tensor)
+            _mc_negotiate(st, opname, "allreduce", x, None, False)
+            _timeline(st, opname, "TOP_LEVEL", "ALLREDUCE")
+            k = st.size // st.num_processes
+            nproc = st.num_processes
+
+            def _kernel(g):
+                from jax import lax
+                s = lax.psum(g[0], st.axis_name)
+                if jnp.issubdtype(s.dtype, jnp.integer):
+                    s = s // k  # exact: every term is duplicated k times
+                    return s // nproc if average else s
+                s = s / k
+                return s / nproc if average else s
+            key = ("mc_allreduce", average, x.shape, str(x.dtype))
+            return _run_collective(
+                st, key, _kernel, _mc_global_array(st, x))
         # Replicated value: every rank contributes the same tensor.
-        _check_multicontroller(st, "allreduce")
         x = jnp.asarray(tensor)
         _timeline(st, opname, "TOP_LEVEL", "ALLREDUCE")
         return x if average else x * st.size
@@ -180,7 +274,8 @@ def allgather(tensor, name: Optional[str] = None):
     size vector here.
     """
     st = _state.check_initialized()
-    opname = _auto_name("HorovodAllgather", name, tensor)
+    opname = _auto_name("HorovodAllgather", name, tensor, skip_dim0=True,
+                        content_free=_is_multicontroller(st))
     st.stall_monitor and st.stall_monitor.begin(opname)
     _timeline(st, opname, "NEGOTIATING")
     try:
@@ -217,8 +312,40 @@ def allgather(tensor, name: Optional[str] = None):
             gathered = _run_collective(st, key, _kernel, stacked)
             parts = [gathered[r, :size_arr[r]] for r in range(st.size)]
             return jnp.concatenate(parts, axis=0)
+        if _is_multicontroller(st):
+            x = np.asarray(tensor)
+            x = x.reshape((1,)) if x.ndim == 0 else x
+            metas = _mc_negotiate(st, opname, "allgather", x, None, True)
+            _timeline(st, opname, "TOP_LEVEL", "ALLGATHER")
+            # Variable dim-0: sizes came back in negotiation (the
+            # reference's response.tensor_sizes, mpi_ops.cc:345-405).
+            proc_sizes = [m["shape"][0] if m["shape"] else 1
+                          for m in metas]
+            max_len = max(proc_sizes)
+            pad = [(0, max_len - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+            padded = np.pad(x, pad)
+
+            def _kernel(g):
+                from jax import lax
+                return lax.all_gather(g[0], st.axis_name, axis=0,
+                                      tiled=False)
+            key = ("mc_allgather", padded.shape, str(padded.dtype))
+            gathered = np.asarray(_run_collective(
+                st, key, _kernel, _mc_global_array(st, padded)))
+            # gathered: [world, max_len, ...]; keep one block per
+            # process (devices of a process hold identical copies) and
+            # trim each to its true size.
+            parts = []
+            seen = set()
+            for i, d in enumerate(st.devices):
+                p = d.process_index
+                if p in seen:
+                    continue
+                seen.add(p)
+                parts.append((p, gathered[i, :proc_sizes[p]]))
+            parts.sort(key=lambda t: t[0])
+            return jnp.concatenate([t[1] for t in parts], axis=0)
         # Replicated value: result is size copies concatenated on dim 0.
-        _check_multicontroller(st, "allgather")
         x = jnp.asarray(tensor)
         x2 = x.reshape((1,)) if x.ndim == 0 else x
         _timeline(st, opname, "TOP_LEVEL", "ALLGATHER")
@@ -233,7 +360,8 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
     `horovod/tensorflow/mpi_ops.py:173-190` / kernel `mpi_ops.cc:1110-1137`.
     """
     st = _state.check_initialized()
-    opname = _auto_name("HorovodBroadcast", name, tensor)
+    opname = _auto_name("HorovodBroadcast", name, tensor,
+                        content_free=_is_multicontroller(st))
     if not (0 <= root_rank < st.size):
         raise ValueError(
             f"broadcast root_rank {root_rank} out of range for size {st.size}")
@@ -254,7 +382,23 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None):
                 return C.broadcast(x[0], root_rank, axis_name=st.axis_name)
             key = ("broadcast", root_rank, stacked.shape, str(stacked.dtype))
             return _run_collective(st, key, _kernel, stacked)
-        _check_multicontroller(st, "broadcast")
+        if _is_multicontroller(st):
+            x = np.asarray(tensor)
+            # root_rank is a process rank (Horovod semantics).
+            if not (0 <= root_rank < st.num_processes):
+                raise ValueError(
+                    f"broadcast root_rank {root_rank} out of range for "
+                    f"{st.num_processes} processes")
+            _mc_negotiate(st, opname, "broadcast", x, root_rank, False)
+            _timeline(st, opname, "TOP_LEVEL", "BCAST")
+            root_dev = next(i for i, d in enumerate(st.devices)
+                            if d.process_index == root_rank)
+
+            def _kernel(g):
+                return C.broadcast(g[0], root_dev, axis_name=st.axis_name)
+            key = ("mc_broadcast", root_rank, x.shape, str(x.dtype))
+            return _run_collective(
+                st, key, _kernel, _mc_global_array(st, x))
         _timeline(st, opname, "TOP_LEVEL", "BCAST")
         return jnp.asarray(tensor)
     finally:
@@ -316,7 +460,10 @@ def reducescatter(tensor, average: bool = False, name: Optional[str] = None):
         return out.reshape((st.size, shard0) + stacked.shape[2:])
     # Replicated value: consistent with the PerRank path — the reduced
     # tensor is x*size (or x when averaging), scattered along dim 0.
-    _check_multicontroller(st, "reducescatter")
+    if _is_multicontroller(st):
+        raise NotImplementedError(
+            "reducescatter of plain arrays across processes is not "
+            "implemented yet; use the SPMD API inside shard_map")
     x = jnp.asarray(tensor)
     if x.shape[0] % st.size:
         raise ValueError(

@@ -1,0 +1,147 @@
+"""hvdrun — the launcher.
+
+Replacement for the reference's `mpirun -np N python train.py` contract
+(`README.md:125-135`, SURVEY §7 step 6): spawns N worker processes,
+wires the process group (rank/size/local placement env vars), runs the
+native TCP rendezvous (key-value + barrier) that replaces the MPI
+control plane, and points workers at a JAX coordination service for
+`jax.distributed.initialize`.
+
+Usage:
+    python -m horovod_tpu.runner -np 4 python train.py ...
+    python -m horovod_tpu.runner -np 2 --platform cpu python train.py
+
+Single-host today; the env-var contract (HOROVOD_RANK / SIZE /
+LOCAL_RANK / LOCAL_SIZE / COORDINATOR / KV) is host-agnostic, so a
+multi-host wrapper only needs to start this per host with the right
+rank offsets (TPU pods usually skip hvdrun entirely: the pod runtime
+provides the process group and `hvd.init()` attaches to it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+from typing import List
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _stream(prefix: str, pipe, out):
+    for line in iter(pipe.readline, ""):
+        out.write(f"[{prefix}] {line}")
+        out.flush()
+    pipe.close()
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch N horovod_tpu worker processes (mpirun "
+                    "replacement).")
+    ap.add_argument("-np", "--num-proc", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("--platform", default="cpu",
+                    choices=["cpu", "tpu", "auto"],
+                    help="JAX platform forced in workers (cpu default: "
+                         "single-host TPU boxes have one chip, so "
+                         "multi-process means CPU devices)")
+    ap.add_argument("--devices-per-proc", type=int, default=1,
+                    help="virtual CPU devices per worker (cpu platform)")
+    ap.add_argument("--no-prefix", action="store_true",
+                    help="don't prefix worker output with [rank]")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="worker command, e.g. python train.py")
+    args = ap.parse_args(argv)
+
+    if not args.command:
+        ap.error("missing worker command")
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+
+    n = args.num_proc
+    jax_port = _free_port()
+    kv_port = _free_port()
+
+    # The launcher hosts the rendezvous server (the rank-0 coordinator
+    # role of the reference's background thread, mpi_ops.cc:1316-1371).
+    from horovod_tpu.native import load_native
+    native = load_native()
+    bound = native.serve(kv_port, n)
+    if bound <= 0:
+        print("hvdrun: failed to start rendezvous server", file=sys.stderr)
+        return 1
+
+    procs: List[subprocess.Popen] = []
+    threads: List[threading.Thread] = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RANK": str(rank),
+            "HOROVOD_SIZE": str(n),
+            "HOROVOD_LOCAL_RANK": str(rank),
+            "HOROVOD_LOCAL_SIZE": str(n),
+            "HOROVOD_COORDINATOR": f"127.0.0.1:{jax_port}",
+            "HOROVOD_KV": f"127.0.0.1:{bound}",
+        })
+        if args.platform != "auto":
+            env["HOROVOD_PLATFORM"] = args.platform
+        if args.platform == "cpu":
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                        f"{args.devices_per_proc}").strip()
+        p = subprocess.Popen(
+            cmd, env=env,
+            stdout=subprocess.PIPE if not args.no_prefix else None,
+            stderr=subprocess.STDOUT if not args.no_prefix else None,
+            text=not args.no_prefix)
+        procs.append(p)
+        if not args.no_prefix:
+            t = threading.Thread(target=_stream,
+                                 args=(str(rank), p.stdout, sys.stdout),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+
+    exit_code = 0
+    try:
+        remaining = set(range(n))
+        while remaining:
+            for i in list(remaining):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                remaining.discard(i)
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    # mpirun behavior: one failure kills the job.
+                    for j in remaining:
+                        procs[j].terminate()
+            if remaining:
+                import time
+                time.sleep(0.2)
+    except KeyboardInterrupt:
+        for p in procs:
+            p.send_signal(signal.SIGINT)
+        exit_code = 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for t in threads:
+            t.join(timeout=2)
+        native.serve_stop()
+    return exit_code

@@ -74,6 +74,13 @@ def init(devices: Optional[Sequence] = None,
 
         import jax
 
+        # hvdrun may force the platform (e.g. cpu workers on a box whose
+        # plugin pins JAX_PLATFORMS to the single real TPU); must happen
+        # before the backend initializes.
+        forced_platform = os.environ.get("HOROVOD_PLATFORM", "")
+        if forced_platform and forced_platform != "auto":
+            jax.config.update("jax_platforms", forced_platform)
+
         proc_env = _detect_process_env()
         if proc_env is not None:
             try:
@@ -130,6 +137,22 @@ def init(devices: Optional[Sequence] = None,
             except Exception:
                 st.native = None  # graceful pure-Python degradation
 
+        # Multi-controller: connect to the launcher's rendezvous server
+        # (the control-message channel replacing MPI TAG_NOTIFY,
+        # mpi_ops.cc:225) and synchronize startup.
+        kv_addr = os.environ.get("HOROVOD_KV", "")
+        if kv_addr and st.num_processes > 1:
+            if st.native is None:
+                raise RuntimeError(
+                    "multi-process launch requires the native control "
+                    "plane (set HOROVOD_NO_NATIVE='' and ensure g++)")
+            host, port = kv_addr.rsplit(":", 1)
+            if not st.native.connect(host, int(port), timeout_s=60.0):
+                raise RuntimeError(
+                    f"could not reach rendezvous server at {kv_addr}")
+            if not st.native.barrier("hvd_init", 120000):
+                raise RuntimeError("init barrier timed out")
+
         if config.timeline_path:
             from horovod_tpu.utils.timeline import Timeline
             st.timeline = Timeline(config.timeline_path, native=st.native)
@@ -139,6 +162,11 @@ def init(devices: Optional[Sequence] = None,
                                         native=st.native)
 
         st.initialized = True
+        # Clean teardown even when user scripts never call shutdown()
+        # (the reference finalizes from its global destructor,
+        # mpi_ops.cc:207-215).
+        import atexit
+        atexit.register(shutdown)
         return 0
 
 

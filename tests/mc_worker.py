@@ -1,0 +1,91 @@
+"""Multi-controller worker script used by test_runner.py (run under
+`python -m horovod_tpu.runner -np 2 python tests/mc_worker.py`).
+
+Exercises the true MPMD path: per-process local tensors, KV-negotiated
+eager collectives across real OS processes — the TPU analogue of the
+reference's `mpirun -np 2 python mpi_ops_test.py` harness (SURVEY §4).
+Prints `MC_OK` on success; any assert kills the job via hvdrun's
+nonzero-exit propagation.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    r, n = hvd.process_rank(), hvd.num_processes()
+    assert n == 2, n
+    assert hvd.size() == 2, hvd.size()
+    assert hvd.rank() == r  # one device per process => rank == proc rank
+
+    # allreduce: sum of per-process values.
+    x = np.full((4,), float(r + 1), np.float32)
+    out = np.asarray(hvd.allreduce(x, average=False))
+    np.testing.assert_allclose(out, 3.0)  # 1 + 2
+    out = np.asarray(hvd.allreduce(x, average=True))
+    np.testing.assert_allclose(out, 1.5)
+
+    # broadcast from each root.
+    for root in range(n):
+        v = np.full((3,), float(r * 10), np.float32)
+        got = np.asarray(hvd.broadcast(v, root))
+        np.testing.assert_allclose(got, root * 10.0)
+
+    # variable-size allgather: rank r contributes r+1 rows of value r.
+    mine = np.full((r + 1, 2), float(r), np.float32)
+    gathered = np.asarray(hvd.allgather(mine))
+    assert gathered.shape == (3, 2), gathered.shape
+    np.testing.assert_allclose(gathered[0], 0.0)
+    np.testing.assert_allclose(gathered[1:], 1.0)
+
+    # broadcast_object (pickled python object).
+    obj = {"epoch": 7, "rank": r} if r == 0 else None
+    got = hvd.broadcast_object(obj, root_rank=0)
+    assert got == {"epoch": 7, "rank": 0}, got
+
+    # mismatch must raise the precondition error on every process — with
+    # an AUTO-generated name, so negotiation meets even though shapes
+    # disagree (the content-free naming contract).
+    from horovod_tpu.ops.validation import CollectiveMismatchError
+    try:
+        hvd.allreduce(np.zeros((17 + r,), np.float32))
+        raise AssertionError("expected CollectiveMismatchError")
+    except CollectiveMismatchError:
+        pass
+
+    # SPMD train step with per-process data shards.
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        return jnp.mean((xb @ params["w"] - yb) ** 2)
+
+    params = {"w": jnp.zeros((3, 1))}
+    params = hvd.broadcast_global_variables(params, 0)
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+    opt_state = tx.init(params)
+    step = hvd.make_train_step(loss_fn, tx)
+    rng = np.random.RandomState(r)
+    local = (rng.randn(8, 3).astype(np.float32),
+             rng.randn(8, 1).astype(np.float32))
+    batch = hvd.make_global_batch(local)
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+    hvd.shutdown()
+    print(f"MC_OK rank={r}")
+
+
+if __name__ == "__main__":
+    main()

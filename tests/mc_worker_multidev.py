@@ -1,0 +1,44 @@
+"""Multi-controller worker with >1 device per process (run under
+`hvdrun -np 2 --devices-per-proc 2`): ranks are processes, devices are
+an implementation detail — allreduce must not double-count."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import horovod_tpu as hvd
+
+
+def main():
+    hvd.init()
+    r, n = hvd.process_rank(), hvd.num_processes()
+    assert n == 2 and hvd.size() == 4, (n, hvd.size())
+
+    x = np.full((4,), float(r + 1), np.float32)
+    out = np.asarray(hvd.allreduce(x, average=False))
+    np.testing.assert_allclose(out, 3.0)  # 1 + 2, not 2*(1+2)
+    out = np.asarray(hvd.allreduce(x, average=True))
+    np.testing.assert_allclose(out, 1.5)
+
+    got = np.asarray(hvd.broadcast(
+        np.full((2,), float(r * 5), np.float32), 1))
+    np.testing.assert_allclose(got, 5.0)
+
+    gathered = np.asarray(hvd.allgather(
+        np.full((r + 1, 2), float(r), np.float32)))
+    assert gathered.shape == (3, 2), gathered.shape
+
+    try:
+        hvd.broadcast(np.zeros(2, np.float32), 3)  # valid device slot,
+        raise AssertionError("expected ValueError")  # invalid process
+    except ValueError:
+        pass
+
+    print(f"MCMD_OK rank={r}")
+
+
+if __name__ == "__main__":
+    main()
