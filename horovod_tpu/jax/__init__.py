@@ -187,15 +187,13 @@ def make_global_batch(batch: Any, *, axis_name: Optional[str] = None) -> Any:
     per-worker data sharding pattern, `examples/keras_mnist_advanced.py:
     113-119`). A no-op returning device arrays in single-controller mode.
     """
-    import jax as _jax
     from jax.sharding import NamedSharding
     st = _state.check_initialized()
-    axis = axis_name or st.axis_name
-    sharding = NamedSharding(st.mesh, P(axis))
     if st.num_processes <= 1:
         return jax.tree.map(jnp.asarray, batch)
+    sharding = NamedSharding(st.mesh, P(axis_name or st.axis_name))
     return jax.tree.map(
-        lambda x: _jax.make_array_from_process_local_data(
+        lambda x: jax.make_array_from_process_local_data(
             sharding, np.asarray(x)), batch)
 
 

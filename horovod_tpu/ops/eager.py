@@ -101,13 +101,22 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
     if st.native is None:
         raise RuntimeError("multi-process eager collectives require the "
                            "native control plane")
+    if not st.native.ping():
+        raise RuntimeError(
+            "multi-process eager collectives require the rendezvous "
+            "channel: this process is not connected to a coordinator. "
+            "Launch with `hvdrun` (which sets HOROVOD_KV) or set "
+            "HOROVOD_KV=host:port of a running rendezvous server.")
     seq = st.op_cache.setdefault("_mc_seq", {})
     cnt = seq.get(opname, 0)
     seq[opname] = cnt + 1
     meta = {"dtype": str(arr.dtype), "shape": list(arr.shape),
             "op": op, "root": root_rank}
-    st.native.kv_set(f"req/{opname}/{cnt}/{st.process_rank}",
-                     json.dumps(meta).encode())
+    if not st.native.kv_set(f"req/{opname}/{cnt}/{st.process_rank}",
+                            json.dumps(meta).encode()):
+        raise RuntimeError(
+            f"failed to post negotiation request for {opname} — "
+            f"rendezvous connection lost")
     metas = []
     for r in range(st.num_processes):
         v = st.native.kv_get(f"req/{opname}/{cnt}/{r}", timeout_ms=60000)
@@ -119,6 +128,7 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
     from horovod_tpu.ops.validation import validate_requests
     validate_requests(
         name=opname, op=op,
+        ops=[m["op"] for m in metas],
         dtypes=[m["dtype"] for m in metas],
         shapes=[tuple(m["shape"]) for m in metas],
         root_ranks=([m["root"] for m in metas]
@@ -137,10 +147,20 @@ def _mc_local_devices(st):
 def _mc_global_array(st, local_block: np.ndarray) -> jax.Array:
     """Assemble the [world, ...] global array where every device owned by
     this process holds `local_block` as its shard."""
+    local = _mc_local_devices(st)
+    if len(local) * st.num_processes != st.size:
+        # The k-duplication correction in mc allreduce (and the
+        # one-block-per-process selection in mc allgather) assumes a
+        # uniform device count per process; uneven ownership would give
+        # silently wrong sums.
+        raise RuntimeError(
+            f"multi-process collectives require every process to own the "
+            f"same number of devices; this process owns {len(local)} of "
+            f"{st.size} across {st.num_processes} processes")
     sharding = NamedSharding(st.mesh, P(st.axis_name))
     shape = (st.size,) + local_block.shape
     block = jnp.asarray(local_block)[None]
-    shards = [jax.device_put(block, d) for d in _mc_local_devices(st)]
+    shards = [jax.device_put(block, d) for d in local]
     return jax.make_array_from_single_device_arrays(shape, sharding, shards)
 
 
@@ -330,21 +350,21 @@ def allgather(tensor, name: Optional[str] = None):
                 return lax.all_gather(g[0], st.axis_name, axis=0,
                                       tiled=False)
             key = ("mc_allgather", padded.shape, str(padded.dtype))
-            gathered = np.asarray(_run_collective(
-                st, key, _kernel, _mc_global_array(st, padded)))
-            # gathered: [world, max_len, ...]; keep one block per
-            # process (devices of a process hold identical copies) and
-            # trim each to its true size.
-            parts = []
-            seen = set()
+            gathered = _run_collective(
+                st, key, _kernel, _mc_global_array(st, padded))
+            # gathered: [world, max_len, ...]; devices of one process hold
+            # identical copies, so select one representative row per
+            # process ON DEVICE before the host transfer (avoids moving
+            # the k-fold duplicate payload), then trim to true sizes.
+            first_row = {}
             for i, d in enumerate(st.devices):
-                p = d.process_index
-                if p in seen:
-                    continue
-                seen.add(p)
-                parts.append((p, gathered[i, :proc_sizes[p]]))
-            parts.sort(key=lambda t: t[0])
-            return jnp.concatenate([t[1] for t in parts], axis=0)
+                first_row.setdefault(d.process_index, i)
+            procs = sorted(first_row)
+            picked = np.asarray(gathered[jnp.asarray(
+                [first_row[p] for p in procs])])
+            return jnp.concatenate(
+                [picked[j, :proc_sizes[p]] for j, p in enumerate(procs)],
+                axis=0)
         # Replicated value: result is size copies concatenated on dim 0.
         x = jnp.asarray(tensor)
         x2 = x.reshape((1,)) if x.ndim == 0 else x

@@ -32,7 +32,19 @@ def validate_requests(name: str, op: str,
                       shapes: Sequence[Tuple[int, ...]],
                       root_ranks: Optional[Sequence[int]] = None,
                       allow_dim0_mismatch: bool = False,
-                      native=None) -> None:
+                      native=None,
+                      ops: Optional[Sequence[str]] = None) -> None:
+    # Op-type agreement (ConstructMPIResponse checks message_type across
+    # ranks, mpi_ops.cc:290-300). Checked first — a broadcast-vs-allreduce
+    # mix has per-rank root ranks of mixed None/int that the later checks
+    # can't represent.
+    if ops is not None:
+        for r, o in enumerate(ops):
+            if o != ops[0]:
+                raise CollectiveMismatchError(
+                    f"Mismatched collective operations: One or more ranks "
+                    f"submitted tensor {name} as {o}, but rank 0 "
+                    f"submitted it as {ops[0]}.")
     if native is not None:
         err = native.validate(name, op, list(dtypes), list(shapes),
                               list(root_ranks) if root_ranks else None,

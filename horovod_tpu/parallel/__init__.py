@@ -1,0 +1,78 @@
+"""Multi-axis parallelism for TPU device meshes.
+
+The reference (Horovod v0.10) is pure data parallelism over MPI/NCCL
+(SURVEY §2.3): every variable replicated, gradients allreduced. On TPU the
+same mesh/collective machinery that implements DP generalizes to sharding
+weights (tensor parallel), stages (pipeline parallel), sequence blocks
+(ring attention / Ulysses), and experts (MoE) — so this package provides
+all five axes as first-class citizens, composed over a single
+`jax.sharding.Mesh`:
+
+    axes:  data (dp) · seq (sp) · model (tp) · pipe (pp) · expert (ep)
+
+Design: GSPMD-first. Parameters carry logical axis annotations; `pjit`
+propagates shardings and XLA inserts the collectives (all-reduce for row
+parallel matmuls, all-to-all for MoE dispatch, collective-permute for ring
+attention and pipeline hand-off). Explicit `shard_map` implementations are
+provided where the schedule matters (ring attention, pipeline loop).
+"""
+
+from horovod_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    mesh_axis_names,
+    sharding,
+    shard_batch,
+    replicate,
+    constrain,
+    use as use_mesh,
+    AXIS_DATA,
+    AXIS_SEQ,
+    AXIS_MODEL,
+    AXIS_PIPE,
+    AXIS_EXPERT,
+)
+from horovod_tpu.parallel.tensor import (
+    column_parallel_matmul,
+    row_parallel_matmul,
+    ColumnParallelDense,
+    RowParallelDense,
+    ParallelMLP,
+    ParallelSelfAttention,
+    dot_product_attention,
+    param_specs,
+    shard_params,
+    unbox,
+)
+from horovod_tpu.parallel.sequence import (
+    ring_attention,
+    ring_attention_gspmd,
+    ulysses_attention,
+    blockwise_attention,
+)
+from horovod_tpu.parallel.pipeline import (
+    PipelineStage,
+    pipeline_apply,
+    pipeline_apply_gspmd,
+)
+from horovod_tpu.parallel.expert import (
+    MoELayer,
+    top_k_gating,
+    expert_alltoall_dispatch,
+    expert_alltoall_combine,
+)
+
+__all__ = [
+    "MeshSpec", "make_mesh", "mesh_axis_names", "sharding", "shard_batch",
+    "replicate", "constrain", "use_mesh",
+    "AXIS_DATA", "AXIS_SEQ", "AXIS_MODEL", "AXIS_PIPE", "AXIS_EXPERT",
+    "column_parallel_matmul", "row_parallel_matmul",
+    "ColumnParallelDense", "RowParallelDense", "ParallelMLP",
+    "ParallelSelfAttention", "dot_product_attention",
+    "param_specs", "shard_params", "unbox",
+    "ring_attention", "ring_attention_gspmd", "ulysses_attention",
+    "blockwise_attention",
+    "PipelineStage", "pipeline_apply", "pipeline_apply_gspmd",
+    "MoELayer", "top_k_gating", "expert_alltoall_dispatch",
+    "expert_alltoall_combine",
+]

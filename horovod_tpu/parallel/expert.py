@@ -1,0 +1,147 @@
+"""Expert parallelism: mixture-of-experts over the ``expert`` mesh axis.
+
+No reference equivalent (SURVEY §2.3 "EP: NO"). TPU-native design follows
+GShard/Switch: routing is expressed as dense one-hot einsums with a fixed
+per-expert capacity — static shapes, so XLA can tile everything onto the
+MXU and lower the token shuffle to all-to-all/reduce-scatter collectives
+over ICI. Two surfaces:
+
+* `MoELayer` — GSPMD flax module: expert weights carry an ``expert``
+  partition annotation, dispatch/combine are einsums with sharding
+  constraints, and the SPMD partitioner inserts the collectives.
+* `expert_alltoall_dispatch` / `expert_alltoall_combine` — the explicit
+  `lax.all_to_all` shuffle for shard_map code that wants the comm visible
+  (one all-to-all each way, the EP analogue of NCCL alltoall in
+  GPU MoE stacks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import flax.linen as nn
+
+from horovod_tpu.parallel.mesh import AXIS_EXPERT, constrain
+
+
+def top_k_gating(logits: jax.Array, k: int) -> Tuple[jax.Array, jax.Array,
+                                                     jax.Array]:
+    """Top-k router.
+
+    Args:
+      logits: [tokens..., E] raw router scores.
+    Returns:
+      (gates [..., k] normalized weights of the chosen experts,
+       indices [..., k] chosen expert ids,
+       aux_loss scalar — Switch-style load-balancing loss,
+       E * Σ_e fraction_tokens(e) · mean_prob(e), minimized at uniform).
+    """
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, indices = lax.top_k(probs, k)
+    gates = gates / jnp.clip(gates.sum(-1, keepdims=True), 1e-9)
+    E = logits.shape[-1]
+    me = probs.reshape(-1, E).mean(0)
+    ce = jax.nn.one_hot(indices[..., 0].reshape(-1), E).mean(0)
+    aux = E * jnp.sum(me * ce)
+    return gates, indices, aux
+
+
+def _dispatch_combine(gates, indices, num_experts, capacity):
+    """[T,k] routing → dispatch [T,E,C] {0,1} and combine [T,E,C] floats.
+
+    Tokens beyond an expert's capacity are dropped (their combine weight
+    is 0 — the residual connection carries them), the standard
+    Switch/GShard overflow policy.
+    """
+    T, k = indices.shape
+    onehot = jax.nn.one_hot(indices, num_experts, dtype=jnp.float32)
+    # Priority: k-th choices claim capacity after all (k-1)-th choices.
+    flat = onehot.transpose(1, 0, 2).reshape(k * T, num_experts)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat          # [k*T, E]
+    pos = pos_flat.reshape(k, T, num_experts).transpose(1, 0, 2)
+    within = (pos < capacity) * onehot                   # [T, k, E]
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)             # [T, k, E, C]
+    dispatch = jnp.einsum("tke,tkec->tec", within, slot)
+    combine = jnp.einsum("tk,tke,tkec->tec", gates, within, slot)
+    return dispatch, combine
+
+
+class MoELayer(nn.Module):
+    """Mixture-of-experts MLP, experts sharded over ``expert``.
+
+    Capacity C = ceil(k·T/E · capacity_factor) with T the global token
+    count per call; dropped tokens ride the residual. The aux
+    load-balancing loss is stored in the ``losses`` collection under
+    ``moe_aux`` (sow), to be added to the task loss by the train step.
+    """
+
+    num_experts: int
+    hidden: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = None
+    activation: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        *lead, d = x.shape
+        T = 1
+        for s in lead:
+            T *= s
+        E = self.num_experts
+        capacity = max(1, int(self.capacity_factor * self.k * T / E))
+
+        router = self.param("router", nn.initializers.lecun_normal(),
+                            (d, E), jnp.float32)
+        w1 = self.param(
+            "w1", nn.with_partitioning(nn.initializers.lecun_normal(),
+                                       (AXIS_EXPERT, None, None)),
+            (E, d, self.hidden), jnp.float32)
+        w2 = self.param(
+            "w2", nn.with_partitioning(nn.initializers.lecun_normal(),
+                                       (AXIS_EXPERT, None, None)),
+            (E, self.hidden, d), jnp.float32)
+
+        xt = x.reshape(T, d)
+        logits = xt.astype(jnp.float32) @ router
+        gates, indices, aux = top_k_gating(logits, self.k)
+        self.sow("losses", "moe_aux", aux)
+
+        dispatch, combine = _dispatch_combine(gates, indices, E, capacity)
+        compute_dtype = self.dtype or x.dtype
+        # Token shuffle in, expert MLP, shuffle out. The t-contraction
+        # crosses the data axis; GSPMD lowers it to the EP all-to-all /
+        # reduce-scatter pattern over ICI.
+        ein = jnp.einsum("tec,td->ecd", dispatch.astype(compute_dtype),
+                         xt.astype(compute_dtype))
+        ein = constrain(ein, AXIS_EXPERT, None, None)
+        h = self.activation(
+            jnp.einsum("ecd,edh->ech", ein, w1.astype(compute_dtype)))
+        out = jnp.einsum("ech,ehd->ecd", h, w2.astype(compute_dtype))
+        out = constrain(out, AXIS_EXPERT, None, None)
+        y = jnp.einsum("tec,ecd->td", combine.astype(compute_dtype), out)
+        return y.reshape(*lead, d).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Explicit SPMD shuffle (inside shard_map over the ``expert`` axis).
+# ---------------------------------------------------------------------------
+
+def expert_alltoall_dispatch(expert_inputs: jax.Array,
+                             *, axis_name: str = AXIS_EXPERT) -> jax.Array:
+    """[E, C_local, d] per-rank dispatch buffers → each rank receives the
+    buffers destined for ITS experts: [E/ep, ep·C_local, d]."""
+    return lax.all_to_all(expert_inputs, axis_name, split_axis=0,
+                          concat_axis=1, tiled=True)
+
+
+def expert_alltoall_combine(expert_outputs: jax.Array,
+                            *, axis_name: str = AXIS_EXPERT) -> jax.Array:
+    """Inverse shuffle: [E/ep, ep·C_local, d] → [E, C_local, d]."""
+    return lax.all_to_all(expert_outputs, axis_name, split_axis=1,
+                          concat_axis=0, tiled=True)

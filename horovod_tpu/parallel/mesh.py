@@ -1,0 +1,165 @@
+"""Multi-axis device mesh construction and sharding helpers.
+
+Generalizes the framework's 1-D ``data`` mesh (`runtime/bootstrap.py`) to
+the full 5-axis TPU layout. Axis order follows the ICI-locality rule from
+the scaling playbook: the innermost (fastest-varying, most ICI-local) axes
+carry the chattiest collectives — tensor parallel all-reduces every layer,
+expert all-to-alls — while data parallel (one gradient all-reduce per
+step) rides the outermost axis and, multi-slice, DCN.
+
+There is no reference equivalent: Horovod v0.10 has exactly one implicit
+axis, `MPI_COMM_WORLD` (SURVEY §2.3). This module is the TPU-native
+extension that makes the other four axes first-class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DATA = "data"
+AXIS_SEQ = "seq"
+AXIS_MODEL = "model"
+AXIS_PIPE = "pipe"
+AXIS_EXPERT = "expert"
+
+# Outer → inner device-grid order (inner = most ICI-local; see module doc).
+_CANONICAL_ORDER = (AXIS_PIPE, AXIS_DATA, AXIS_SEQ, AXIS_EXPERT, AXIS_MODEL)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Requested degree of each parallelism axis.
+
+    ``data=-1`` (default) absorbs all devices not claimed by other axes.
+    Axes of degree 1 are still present in the mesh (size-1 axes are free),
+    so model code can always reference every canonical axis name.
+    """
+
+    data: int = -1
+    seq: int = 1
+    model: int = 1
+    pipe: int = 1
+    expert: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        fixed = {f.name: getattr(self, f.name)
+                 for f in dataclasses.fields(self)}
+        free = [k for k, v in fixed.items() if v == -1]
+        if len(free) > 1:
+            raise ValueError(f"at most one axis may be -1, got {free}")
+        claimed = math.prod(v for v in fixed.values() if v != -1)
+        if free:
+            if n_devices % claimed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by the "
+                    f"{claimed} claimed by {fixed}")
+            fixed[free[0]] = n_devices // claimed
+        elif claimed != n_devices:
+            raise ValueError(
+                f"mesh axes {fixed} need {claimed} devices, have "
+                f"{n_devices}")
+        return MeshSpec(**fixed)
+
+
+def make_mesh(spec: Optional[MeshSpec] = None,
+              devices: Optional[Sequence] = None,
+              **axis_sizes: int) -> Mesh:
+    """Build a 5-axis `jax.sharding.Mesh`.
+
+    Either pass a `MeshSpec` or axis sizes as keywords::
+
+        mesh = make_mesh(data=2, model=2, seq=2)   # 8 devices
+
+    The device grid is laid out in canonical outer→inner order
+    (pipe, data, seq, expert, model) so the chatty axes map to adjacent
+    devices (contiguous ICI neighborhoods on a real slice).
+    """
+    if spec is None:
+        spec = MeshSpec(**axis_sizes)
+    elif axis_sizes:
+        raise ValueError("pass either spec or keyword axis sizes, not both")
+    devs = list(devices) if devices is not None else list(jax.devices())
+    spec = spec.resolve(len(devs))
+    shape = tuple(getattr(spec, name) for name in _CANONICAL_ORDER)
+    grid = np.asarray(devs).reshape(shape)
+    return Mesh(grid, _CANONICAL_ORDER)
+
+
+def mesh_axis_names(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+def use(mesh: Mesh):
+    """Context manager installing `mesh` as the ambient mesh for
+    P(...)-spec sharding constraints (insulates the jax API rename:
+    `jax.set_mesh` ≥0.8, `jax.sharding.use_mesh` before)."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    return jax.sharding.use_mesh(mesh)  # pragma: no cover
+
+
+def sharding(mesh: Mesh, *spec) -> NamedSharding:
+    """`NamedSharding(mesh, P(*spec))` shorthand."""
+    return NamedSharding(mesh, P(*spec))
+
+
+def _place(x, sh: NamedSharding):
+    """device_put that also works inside a `use()` mesh context, where
+    jax requires the source to be host-resident or already mesh-committed
+    (single-device jax Arrays are rejected) — round-trip through numpy."""
+    if isinstance(x, jax.Array) and not isinstance(
+            x.sharding, NamedSharding):
+        x = np.asarray(x)
+    return jax.device_put(x, sh)
+
+
+def shard_batch(mesh: Mesh, batch,
+                axes: Sequence[str] = (AXIS_DATA,)):
+    """Place a host batch onto the mesh, dim 0 split over `axes`.
+
+    The TPU analogue of the reference's per-worker dataset sharding
+    (`examples/keras_mnist_advanced.py:113-119` divides steps per epoch by
+    `hvd.size()`): here one global batch is laid out across the data axis.
+    """
+    sh = sharding(mesh, tuple(axes))
+    return jax.tree.map(lambda x: _place(x, sh), batch)
+
+
+def replicate(mesh: Mesh, tree):
+    """Fully replicate a pytree over the mesh (e.g. initial params before
+    tensor-parallel sharding, mirroring `broadcast_global_variables`)."""
+    sh = sharding(mesh)
+    return jax.tree.map(lambda x: _place(x, sh), tree)
+
+
+def constrain(x, *spec):
+    """`with_sharding_constraint` with a plain P(...) spec — the GSPMD
+    escape hatch for pinning an intermediate's layout inside pjit.
+
+    No-op when no mesh is in context (e.g. single-device init or the
+    unsharded reference path in tests), so annotated modules run
+    unchanged off-mesh. Axes absent from the context mesh are dropped
+    from the spec (a mesh built without ``model`` simply doesn't shard
+    that dim).
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(e for e in entry if e in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    return jax.lax.with_sharding_constraint(x, P(*(keep(s) for s in spec)))

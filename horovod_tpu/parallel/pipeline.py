@@ -1,0 +1,131 @@
+"""Pipeline parallelism: microbatched GPipe schedule over the ``pipe`` axis.
+
+No reference equivalent (SURVEY §2.3 "PP: NO"). TPU-native design: every
+pipeline rank runs the SAME program (SPMD — XLA requires identical HLO on
+all devices), holding its own stage's weights; activations hand off to the
+next stage with a single-hop `lax.ppermute` each tick, which on a real
+slice is a neighbor transfer over ICI. The schedule is the classic GPipe
+fill-run-drain loop expressed as `lax.scan` (M + P - 1 ticks for M
+microbatches over P stages), so `jax.grad` through it yields the reversed
+drain-run-fill backward pipeline for free — no hand-written 1F1B state
+machine, the compiler schedules both directions.
+
+Bubble fraction is (P-1)/(M+P-1); pick M >= 4·P for >80 % utilization.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel.mesh import AXIS_DATA, AXIS_PIPE
+
+
+def _axis_size(axis_name: str) -> int:
+    """Static size of a bound mesh axis."""
+    try:
+        return jax.lax.axis_size(axis_name)  # jax >= 0.8
+    except (AttributeError, NameError):
+        return lax.psum(1, axis_name)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any,
+                   microbatches: jax.Array,
+                   *, axis_name: str = AXIS_PIPE) -> jax.Array:
+    """Run `microbatches` through the P-stage pipeline (SPMD; in shard_map).
+
+    Args:
+      stage_fn: `(params, x) -> y` applied by every stage to its resident
+        microbatch each tick; `y` must have `x`'s shape/dtype.
+      stage_params: THIS rank's stage weights (leading stage dim already
+        stripped by the shard_map in-spec).
+      microbatches: [M, mb, ...] — the full microbatch stack, replicated
+        across the ``pipe`` axis (only stage 0 reads it).
+
+    Returns:
+      [M, mb, ...] final-stage outputs, replicated across ``pipe``.
+    """
+    nstages = _axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    ticks = M + nstages - 1
+    fwd = [(i, (i + 1) % nstages) for i in range(nstages)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # Stage 0 consumes microbatch t (clamped; invalid ticks produce
+        # garbage that is never written — see validity algebra below).
+        feed = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
+        x = jnp.where(idx == 0, feed, state)
+        y = stage_fn(stage_params, x)
+        # Stage s at tick t holds microbatch (t - s); the last stage's
+        # result is valid when 0 <= t - (P-1) < M. A microbatch that is
+        # invalid at stage s stays invalid at s+1, tick t+1, so garbage
+        # can never reach the output buffer.
+        out_ix = t - (nstages - 1)
+        valid = jnp.logical_and(idx == nstages - 1,
+                                jnp.logical_and(out_ix >= 0, out_ix < M))
+        slot = jnp.clip(out_ix, 0, M - 1)
+        cur = lax.dynamic_index_in_dim(outputs, slot, axis=0,
+                                       keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(valid, y, cur), slot, axis=0)
+        # Hand the activation to the next stage (single ICI hop).
+        state = lax.ppermute(y, axis_name, fwd)
+        return (state, outputs), None
+
+    # *0 keeps the inputs' varying-manual-axes type (see sequence.py).
+    state0 = microbatches[0] * 0
+    out0 = microbatches * 0
+    (_, outputs), _ = lax.scan(tick, (state0, out0), jnp.arange(ticks))
+    # Outputs are complete only on the last stage; replicate them so the
+    # loss (and its gradient) is computed identically on every pipe rank.
+    outputs = lax.psum(
+        jnp.where(idx == nstages - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name)
+    return outputs
+
+
+def pipeline_apply_gspmd(mesh, stage_fn, stacked_params, microbatches,
+                         *, data_sharded: bool = True) -> jax.Array:
+    """`pipeline_apply` as a shard_map region inside a pjit'ed step.
+
+    `stacked_params`: pytree whose leaves have leading dim P (one slice
+    per stage), sharded over ``pipe`` by the in-spec; each rank sees its
+    slice with leading dim 1, squeezed before `stage_fn`.
+    `microbatches`: [M, mb, ...], batch dim sharded over ``data`` when
+    `data_sharded` (each data-parallel group runs its own pipeline).
+    """
+    pspec = jax.tree.map(lambda _: P(AXIS_PIPE), stacked_params)
+    xspec = P(None, AXIS_DATA) if data_sharded else P()
+
+    def body(params, x):
+        local = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)
+        return pipeline_apply(stage_fn, local, x)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, xspec), out_specs=xspec,
+        check_vma=False,
+    )(stacked_params, microbatches)
+
+
+class PipelineStage:
+    """Stack per-stage parameter pytrees into the [P, ...] layout
+    `pipeline_apply_gspmd` expects."""
+
+    @staticmethod
+    def stack(per_stage_params):
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+    @staticmethod
+    def unstack(stacked):
+        n = jax.tree.leaves(stacked)[0].shape[0]
+        return [jax.tree.map(lambda a: a[i], stacked) for i in range(n)]

@@ -1,0 +1,216 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+No reference equivalent — Horovod v0.10 predates long-context work
+entirely (SURVEY §5.7). These are the TPU-native long-context primitives
+the brief makes first-class:
+
+* `ring_attention` — Q stays put, K/V blocks rotate around the ``seq``
+  mesh axis via `lax.ppermute` (the ICI ring is the physical topology, so
+  each hop is a single neighbor transfer), with flash-attention-style
+  online-softmax accumulation so the full [S, S] score matrix never
+  materializes. Liu et al. 2023 (Ring Attention), expressed as an XLA
+  collective-permute pipeline that overlaps each block's compute with the
+  next block's transfer.
+* `ulysses_attention` — DeepSpeed-Ulysses: `all_to_all` swaps the sharded
+  dim from sequence to heads, runs ordinary per-head attention locally,
+  and swaps back. Two all-to-alls per call; preferable when
+  heads % seq_degree == 0 and sequence blocks are small.
+* `blockwise_attention` — the single-device online-softmax scan over K/V
+  chunks (Rabe & Staats 2021); the local compute kernel inside
+  `ring_attention` and the O(S) memory fallback when the ``seq`` axis is 1.
+
+All functions are SPMD: call them inside `shard_map` (or via
+`ring_attention_gspmd`, which wraps the shard_map over an explicit mesh
+for use inside a pjit'ed model). Tensor layout is [batch, seq, heads,
+head_dim]; the ``model`` axis may shard `heads` independently — ring/
+blockwise attention never communicates across heads.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
+
+
+def _online_block(carry, q, k, v, logit_bias):
+    """One online-softmax accumulation step.
+
+    carry = (o, m, l): running unnormalized output [B,Sq,H,D], running max
+    m [B,H,Sq] and running denominator l [B,H,Sq], all float32.
+    """
+    o, m, l = carry
+    scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if logit_bias is not None:
+        logits = logits + logit_bias
+    m_new = jnp.maximum(m, logits.max(axis=-1))
+    # Block rows that are fully masked keep m == -inf; exp(-inf - -inf)
+    # would be NaN, so guard the shift.
+    shift = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(logits - shift[..., None])
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - shift))
+    l_new = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return o_new, m_new, l_new
+
+
+def _finalize(o, m, l, dtype):
+    denom = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(dtype)
+
+
+def _causal_bias(q_pos: jax.Array, k_pos: jax.Array) -> jax.Array:
+    """[1,1,Sq,Sk] additive bias: 0 where k ≤ q, -inf otherwise."""
+    keep = q_pos[:, None] >= k_pos[None, :]
+    return jnp.where(keep, 0.0, -jnp.inf)[None, None]
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        *, block_size: int = 512,
+                        causal: bool = False,
+                        q_offset: int = 0,
+                        k_offset: int = 0) -> jax.Array:
+    """Memory-efficient attention: scan over K/V chunks, online softmax.
+
+    [B, Sq, H, D] x [B, Sk, H, D] → [B, Sq, H, D] without the [Sq, Sk]
+    matrix. `q_offset`/`k_offset` are the global positions of element 0
+    (used by ring attention to causal-mask rotated blocks).
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    nblk = max(1, -(-Sk // block_size))
+    blk = -(-Sk // nblk)
+    pad = nblk * blk - Sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    kb = k.reshape(B, nblk, blk, H, D).transpose(1, 0, 2, 3, 4)
+    vb = v.reshape(B, nblk, blk, H, D).transpose(1, 0, 2, 3, 4)
+
+    q32 = q.astype(jnp.float32)
+    q_pos = q_offset + jnp.arange(Sq)
+
+    def step(carry, inp):
+        i, (kc, vc) = inp
+        k_pos = k_offset + i * blk + jnp.arange(blk)
+        bias = None
+        if causal:
+            bias = _causal_bias(q_pos, k_pos)
+        if pad:
+            # mask the zero-padding tail (local key index >= Sk)
+            tail = jnp.where((k_pos - k_offset < Sk)[None, None, None, :],
+                             0.0, -jnp.inf)
+            bias = tail if bias is None else bias + tail
+        carry = _online_block(carry, q32, kc.astype(jnp.float32), vc, bias)
+        return carry, None
+
+    # Derive carry inits from q so they inherit its varying-manual-axes
+    # type under shard_map (a plain constant would fail the vma check).
+    o0 = q32 * 0.0
+    l0 = q32[..., 0].transpose(0, 2, 1) * 0.0
+    m0 = l0 - jnp.inf
+    (o, m, l), _ = lax.scan(step, (o0, m0, l0),
+                            (jnp.arange(nblk), (kb, vb)))
+    return _finalize(o, m, l, q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   *, axis_name: str = AXIS_SEQ,
+                   causal: bool = False) -> jax.Array:
+    """Ring attention over the ``seq`` mesh axis (SPMD; inside shard_map).
+
+    Each rank holds a contiguous sequence block [B, S/sp, H, D]. K/V
+    rotate sp-1 times around the ring (`ppermute` to the next neighbor);
+    Q never moves. Online softmax makes the result exactly (up to fp
+    accumulation order) full attention over the global sequence. With
+    `causal=True`, blocks strictly in the future contribute -inf bias and
+    their compute is skipped by masking (XLA still schedules the permute,
+    keeping the ring in lockstep — required for collective correctness).
+    """
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, S, H, D = q.shape
+    q32 = q.astype(jnp.float32)
+    q_pos = idx * S + jnp.arange(S)
+
+    def block(carry, kc, vc, step):
+        # Block kc originated on rank (idx - step) mod sp.
+        src = (idx - step) % sp
+        k_pos = src * S + jnp.arange(S)
+        bias = _causal_bias(q_pos, k_pos) if causal else None
+        return _online_block(carry, q32, kc.astype(jnp.float32), vc, bias)
+
+    def body(carry, step):
+        o, m, l, kc, vc = carry
+        o, m, l = block((o, m, l), kc, vc, step)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o, m, l, kc, vc), None
+
+    # Carry inits derived from q to inherit its varying-manual-axes type.
+    o0 = q32 * 0.0
+    l0 = q32[..., 0].transpose(0, 2, 1) * 0.0
+    m0 = l0 - jnp.inf
+    # sp-1 rotate-and-accumulate steps, then the last resident block is
+    # consumed without a final (wasted) permute.
+    (o, m, l, kc, vc), _ = lax.scan(body, (o0, m0, l0, k, v),
+                                    jnp.arange(sp - 1))
+    o, m, l = block((o, m, l), kc, vc, sp - 1)
+    return _finalize(o, m, l, q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      *, axis_name: str = AXIS_SEQ,
+                      causal: bool = False,
+                      attn_impl=None) -> jax.Array:
+    """DeepSpeed-Ulysses sequence parallelism (SPMD; inside shard_map).
+
+    [B, S/sp, H, D] --all_to_all--> [B, S, H/sp, D] → local attention →
+    --all_to_all--> [B, S/sp, H, D]. Requires H % sp == 0.
+    """
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    B, S_local, H, D = q.shape
+
+    def seq_to_heads(t):  # [B, S/sp, H, D] -> [B, S, H/sp, D]
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(t):
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if attn_impl is None:
+        attn_impl = functools.partial(blockwise_attention, causal=causal)
+    else:
+        attn_impl = functools.partial(attn_impl, causal=causal)
+    oh = attn_impl(qh, kh, vh)
+    del idx
+    return heads_to_seq(oh)
+
+
+def ring_attention_gspmd(mesh, q, k, v, *, causal: bool = False,
+                         seq_axis: str = AXIS_SEQ) -> jax.Array:
+    """Ring attention as a shard_map region inside a pjit'ed model.
+
+    Activations are global-shaped [B, S, H, D] sharded
+    (data, seq, model, -); the shard_map boundary hands each device its
+    local block and the ring runs over ``seq``. This is how the flagship
+    transformer calls it.
+    """
+    spec = P(AXIS_DATA, seq_axis, AXIS_MODEL, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis,
+                           causal=causal)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)

@@ -1,0 +1,341 @@
+"""Tests for horovod_tpu.parallel — tp/sp/pp/ep over the virtual 8-device
+CPU mesh (same harness as the collective tests, SURVEY §4)."""
+
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu import parallel as par
+
+
+# ---------------------------------------------------------------------------
+# mesh
+# ---------------------------------------------------------------------------
+
+class TestMesh:
+    def test_default_absorbs_data(self):
+        mesh = par.make_mesh()
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+            "pipe": 1, "data": 8, "seq": 1, "expert": 1, "model": 1}
+
+    def test_explicit_axes(self):
+        mesh = par.make_mesh(data=2, seq=2, model=2)
+        shape = dict(zip(mesh.axis_names, mesh.devices.shape))
+        assert shape["data"] == 2 and shape["seq"] == 2
+        assert shape["model"] == 2 and shape["pipe"] == 1
+
+    def test_bad_product_raises(self):
+        with pytest.raises(ValueError):
+            par.make_mesh(data=3, model=2)
+        with pytest.raises(ValueError):
+            par.MeshSpec(data=-1, seq=-1).resolve(8)
+
+    def test_shard_batch_and_replicate(self):
+        mesh = par.make_mesh(data=4, model=2)
+        x = np.arange(32, dtype=np.float32).reshape(8, 4)
+        xs = par.shard_batch(mesh, x)
+        assert xs.sharding.spec == P("data")
+        w = par.replicate(mesh, {"w": np.ones((3,), np.float32)})
+        assert w["w"].sharding.spec == P()
+
+
+# ---------------------------------------------------------------------------
+# tensor parallel
+# ---------------------------------------------------------------------------
+
+class TestTensorParallel:
+    def test_column_row_pair_matches_dense(self):
+        """Explicit shard_map column→row pair == plain two-layer matmul."""
+        mesh = par.make_mesh(data=2, model=4)
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 16).astype(np.float32)
+        w1 = rng.randn(16, 32).astype(np.float32)
+        w2 = rng.randn(32, 16).astype(np.float32)
+
+        def spmd(x, w1, w2):
+            h = par.column_parallel_matmul(x, w1)
+            return par.row_parallel_matmul(h, w2)
+
+        out = jax.jit(jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P("data"), P(None, "model"), P("model", None)),
+            out_specs=P("data")))(x, w1, w2)
+        np.testing.assert_allclose(np.asarray(out), (x @ w1) @ w2,
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_parallel_mlp_matches_unsharded(self):
+        """GSPMD ParallelMLP on a TP mesh == same module on 1 device."""
+        mesh = par.make_mesh(data=2, model=4)
+        mlp = par.ParallelMLP(hidden=64, out=16)
+        x = np.random.RandomState(1).randn(8, 16).astype(np.float32)
+        variables = mlp.init(jax.random.PRNGKey(0), x)
+        want = mlp.apply(par.unbox(variables), x)
+
+        sharded_params = par.shard_params(mesh, variables)
+        xs = par.shard_batch(mesh, x)
+        with par.use_mesh(mesh):
+            got = jax.jit(mlp.apply)(sharded_params, xs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_parallel_attention_matches_unsharded(self):
+        mesh = par.make_mesh(data=2, model=4)
+        attn = par.ParallelSelfAttention(num_heads=4, head_dim=8)
+        x = np.random.RandomState(2).randn(2, 10, 32).astype(np.float32)
+        variables = attn.init(jax.random.PRNGKey(0), x)
+        want = attn.apply(par.unbox(variables), x)
+        sharded_params = par.shard_params(mesh, variables)
+        xs = par.shard_batch(mesh, x)
+        with par.use_mesh(mesh):
+            got = jax.jit(attn.apply)(sharded_params, xs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_param_specs(self):
+        mlp = par.ParallelMLP(hidden=8, out=4)
+        v = mlp.init(jax.random.PRNGKey(0), jnp.ones((1, 4)))
+        specs = par.param_specs(v)
+        assert specs["params"]["wi"]["kernel"] == P(None, "model")
+        assert specs["params"]["wo"]["kernel"] == P("model", None)
+
+
+# ---------------------------------------------------------------------------
+# sequence parallel
+# ---------------------------------------------------------------------------
+
+def _ref_attention(q, k, v, causal):
+    mask = None
+    if causal:
+        S = q.shape[1]
+        mask = np.tril(np.ones((S, S), bool))[None, None]
+    return np.asarray(par.dot_product_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        None if mask is None else jnp.asarray(mask)))
+
+
+class TestSequenceParallel:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_blockwise_matches_full(self, causal):
+        rng = np.random.RandomState(0)
+        q = rng.randn(2, 24, 2, 8).astype(np.float32)
+        k = rng.randn(2, 24, 2, 8).astype(np.float32)
+        v = rng.randn(2, 24, 2, 8).astype(np.float32)
+        got = par.blockwise_attention(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), block_size=7,
+                                      causal=causal)
+        np.testing.assert_allclose(np.asarray(got),
+                                   _ref_attention(q, k, v, causal),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_attention_matches_full(self, causal):
+        mesh = par.make_mesh(data=2, seq=4)
+        rng = np.random.RandomState(1)
+        q = rng.randn(2, 32, 2, 8).astype(np.float32)
+        k = rng.randn(2, 32, 2, 8).astype(np.float32)
+        v = rng.randn(2, 32, 2, 8).astype(np.float32)
+        spec = P("data", "seq", None, None)
+        fn = jax.jit(jax.shard_map(
+            functools.partial(par.ring_attention, causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+        got = fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(got),
+                                   _ref_attention(q, k, v, causal),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_ring_attention_gspmd(self):
+        mesh = par.make_mesh(data=2, seq=2, model=2)
+        rng = np.random.RandomState(2)
+        q = rng.randn(2, 16, 4, 8).astype(np.float32)
+        k = rng.randn(2, 16, 4, 8).astype(np.float32)
+        v = rng.randn(2, 16, 4, 8).astype(np.float32)
+        got = jax.jit(functools.partial(
+            par.ring_attention_gspmd, mesh, causal=True))(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(got),
+                                   _ref_attention(q, k, v, True),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ulysses_matches_full(self, causal):
+        mesh = par.make_mesh(data=2, seq=4)
+        rng = np.random.RandomState(3)
+        q = rng.randn(2, 32, 4, 8).astype(np.float32)  # H=4 % sp=4 == 0
+        k = rng.randn(2, 32, 4, 8).astype(np.float32)
+        v = rng.randn(2, 32, 4, 8).astype(np.float32)
+        spec = P("data", "seq", None, None)
+        fn = jax.jit(jax.shard_map(
+            functools.partial(par.ulysses_attention, causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+        got = fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        np.testing.assert_allclose(np.asarray(got),
+                                   _ref_attention(q, k, v, causal),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_ring_attention_grad(self):
+        """Gradients flow through the ppermute ring."""
+        mesh = par.make_mesh(seq=4, data=2)
+        rng = np.random.RandomState(4)
+        q = rng.randn(2, 16, 2, 4).astype(np.float32)
+        k = rng.randn(2, 16, 2, 4).astype(np.float32)
+        v = rng.randn(2, 16, 2, 4).astype(np.float32)
+        spec = P("data", "seq", None, None)
+
+        def loss_ring(q, k, v):
+            o = jax.shard_map(
+                functools.partial(par.ring_attention, causal=True),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            )(q, k, v)
+            return (o ** 2).sum()
+
+        def loss_ref(q, k, v):
+            S = q.shape[1]
+            m = jnp.tril(jnp.ones((S, S), bool))[None, None]
+            return (par.dot_product_attention(q, k, v, m) ** 2).sum()
+
+        g1 = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        g2 = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# pipeline parallel
+# ---------------------------------------------------------------------------
+
+class TestPipelineParallel:
+    def _stage_fn(self, params, x):
+        return jnp.tanh(x @ params["w"] + params["b"])
+
+    def _make(self, nstages, d):
+        rng = np.random.RandomState(5)
+        per_stage = [
+            {"w": rng.randn(d, d).astype(np.float32) * 0.5,
+             "b": rng.randn(d).astype(np.float32) * 0.1}
+            for _ in range(nstages)]
+        stacked = par.PipelineStage.stack(
+            [jax.tree.map(jnp.asarray, p) for p in per_stage])
+        return per_stage, stacked
+
+    def test_matches_sequential(self):
+        mesh = par.make_mesh(pipe=4, data=2)
+        d, M, mb = 8, 8, 4
+        per_stage, stacked = self._make(4, d)
+        x = np.random.RandomState(6).randn(M, mb, d).astype(np.float32)
+
+        got = jax.jit(functools.partial(
+            par.pipeline_apply_gspmd, mesh, self._stage_fn))(
+                stacked, jnp.asarray(x))
+
+        want = x.copy()
+        for p in per_stage:
+            want = np.tanh(want @ p["w"] + p["b"])
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gradient_matches_sequential(self):
+        mesh = par.make_mesh(pipe=4, data=2)
+        d, M, mb = 4, 8, 2
+        per_stage, stacked = self._make(4, d)
+        x = jnp.asarray(
+            np.random.RandomState(7).randn(M, mb, d).astype(np.float32))
+
+        def loss_pp(stacked, x):
+            y = par.pipeline_apply_gspmd(mesh, self._stage_fn, stacked, x)
+            return (y ** 2).mean()
+
+        def loss_seq(stacked, x):
+            y = x
+            for i in range(4):
+                p = jax.tree.map(lambda a: a[i], stacked)
+                y = self._stage_fn(p, y)
+            return (y ** 2).mean()
+
+        g1 = jax.jit(jax.grad(loss_pp))(stacked, x)
+        g2 = jax.jit(jax.grad(loss_seq))(stacked, x)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4),
+            g1, g2)
+
+    def test_unstack_roundtrip(self):
+        _, stacked = self._make(4, 4)
+        stages = par.PipelineStage.unstack(stacked)
+        assert len(stages) == 4
+        re = par.PipelineStage.stack(stages)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)), re, stacked)
+
+
+# ---------------------------------------------------------------------------
+# expert parallel
+# ---------------------------------------------------------------------------
+
+class TestExpertParallel:
+    def test_top_k_gating(self):
+        logits = jnp.asarray(
+            np.random.RandomState(8).randn(16, 4).astype(np.float32))
+        gates, idx, aux = par.top_k_gating(logits, 2)
+        assert gates.shape == (16, 2) and idx.shape == (16, 2)
+        np.testing.assert_allclose(np.asarray(gates.sum(-1)),
+                                   np.ones(16), rtol=1e-6)
+        assert float(aux) >= 1.0 - 1e-6  # E·Σ f·p ≥ 1 (uniform optimum)
+
+    def test_moe_layer_sharded_matches_unsharded(self):
+        mesh = par.make_mesh(data=2, expert=4)
+        moe = par.MoELayer(num_experts=4, hidden=32, k=2,
+                           capacity_factor=2.0)
+        x = np.random.RandomState(9).randn(4, 8, 16).astype(np.float32)
+        variables = moe.init(jax.random.PRNGKey(0), x)
+        want = moe.apply(par.unbox(variables), x)
+        sharded_params = par.shard_params(mesh, variables)
+        xs = par.shard_batch(mesh, x)
+        with par.use_mesh(mesh):
+            got = jax.jit(moe.apply)(sharded_params, xs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_moe_capacity_drops_are_bounded(self):
+        """With capacity_factor ≥ E/k·(worst skew) nothing is dropped;
+        with tiny capacity the layer still runs and outputs are finite."""
+        moe = par.MoELayer(num_experts=2, hidden=8, k=1,
+                           capacity_factor=0.25)
+        x = np.random.RandomState(10).randn(2, 8, 4).astype(np.float32)
+        v = moe.init(jax.random.PRNGKey(1), x)
+        y = moe.apply(par.unbox(v), x)
+        assert np.isfinite(np.asarray(y)).all()
+
+    def test_alltoall_dispatch_roundtrip(self):
+        mesh = par.make_mesh(expert=4, data=2)
+        rng = np.random.RandomState(11)
+        # Global view: capacity dim stacks the 4 expert-ranks' local
+        # [E=4, C_local=6, d] dispatch buffers.
+        buf = rng.randn(4, 4 * 6, 8).astype(np.float32)
+
+        def body(b):
+            shuffled = par.expert_alltoall_dispatch(b)
+            assert shuffled.shape == (1, 4 * 6, 8)  # my expert, all ranks
+            return par.expert_alltoall_combine(shuffled)
+
+        spec = P(None, "expert", None)
+        out = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(spec,), out_specs=spec))(
+                jnp.asarray(buf))
+        np.testing.assert_allclose(np.asarray(out), buf, rtol=1e-6)
+
+    def test_moe_aux_loss_sown(self):
+        moe = par.MoELayer(num_experts=4, hidden=8, k=2)
+        x = jnp.ones((2, 4, 8))
+        v = moe.init(jax.random.PRNGKey(2), x)
+        y, state = moe.apply(par.unbox(v), x, mutable=["losses"])
+        leaves = jax.tree.leaves(state["losses"])
+        assert leaves and all(np.isfinite(float(a)) for a in leaves)
