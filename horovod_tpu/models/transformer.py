@@ -1,0 +1,292 @@
+"""Flagship model: GPT-style transformer LM over the full 5-axis mesh.
+
+No reference equivalent — Horovod v0.10 ships no model library and no
+attention (SURVEY §5.7); its largest exercised model family is the
+tf_cnn_benchmarks CNNs. This is the TPU-native extension that makes the
+brief's long-context + multi-axis parallelism first-class, composing
+every `horovod_tpu.parallel` primitive in one model:
+
+* **TP**: `ParallelSelfAttention` / `ParallelMLP` (Megatron column/row
+  pairs, heads sharded over ``model``) — one all-reduce per sub-block,
+  inserted by GSPMD, riding the innermost ICI axis.
+* **SP**: `attn_impl="ring"` / `"ulysses"` run the attention as a
+  shard_map region over the ``seq`` axis (K/V `ppermute` ring or
+  all-to-all head swap).
+* **EP**: `moe_every=n` replaces every n-th MLP with a GShard-style
+  `MoELayer`, experts sharded over ``expert``.
+* **DP**: the train step shards the batch over ``data``; since params
+  carry no ``data`` axis, GSPMD inserts the gradient all-reduce —
+  the reference's entire product (`DistributedOptimizer`,
+  `horovod/tensorflow/__init__.py:127-186`) falls out of the sharding.
+* **PP**: `TransformerBlockStack` exposes the per-block apply used by
+  `parallel.pipeline.pipeline_apply_gspmd` (GPipe over ``pipe``).
+
+Attention kernels: ``dot`` (materialized softmax baseline), ``blockwise``
+(online-softmax scan), ``flash`` (Pallas TPU kernel,
+`ops/flash_attention.py`), ``ring``/``ulysses`` (sequence-parallel).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import flax.linen as nn
+
+from horovod_tpu.parallel.expert import MoELayer
+from horovod_tpu.parallel.mesh import (
+    AXIS_DATA, AXIS_MODEL, AXIS_SEQ, constrain, use,
+)
+from horovod_tpu.parallel.sequence import (
+    blockwise_attention, ring_attention_gspmd, ulysses_attention_gspmd,
+)
+from horovod_tpu.parallel.tensor import (
+    ParallelMLP, ParallelSelfAttention, dot_product_attention,
+    param_specs, shard_params, unbox,
+)
+
+Dtype = Any
+
+ATTN_IMPLS = ("dot", "blockwise", "flash", "ring", "ulysses")
+
+
+def make_attn_fn(impl: str, *, causal: bool = True,
+                 block_size: int = 512) -> Optional[Callable]:
+    """attn_fn for `ParallelSelfAttention` (None = dot baseline, which
+    consumes the explicit mask argument instead)."""
+    if impl == "dot":
+        return None
+
+    def _no_mask(m):
+        if m is not None:
+            raise NotImplementedError(
+                f"attn_impl={impl!r} supports causal masking only; use "
+                f"impl='dot' for arbitrary masks")
+
+    if impl == "blockwise":
+        def attn(q, k, v, m):
+            _no_mask(m)
+            return blockwise_attention(q, k, v, causal=causal,
+                                       block_size=block_size)
+        return attn
+    if impl == "flash":
+        from horovod_tpu.ops.flash_attention import flash_attention
+
+        def attn(q, k, v, m):
+            _no_mask(m)
+            return flash_attention(q, k, v, causal=causal)
+        return attn
+    if impl in ("ring", "ulysses"):
+        sp_fn = (ring_attention_gspmd if impl == "ring"
+                 else ulysses_attention_gspmd)
+
+        def attn(q, k, v, m):
+            _no_mask(m)
+            # Off-mesh (e.g. model.init, single-device eval) there is no
+            # seq axis to ring over; blockwise is the same math locally
+            # and attention has no params, so the init trace is identical.
+            mesh = jax.sharding.get_abstract_mesh()
+            if mesh is None or mesh.empty:
+                return blockwise_attention(q, k, v, causal=causal,
+                                           block_size=block_size)
+            return sp_fn(None, q, k, v, causal=causal)
+
+        return attn
+    raise ValueError(f"attn_impl must be one of {ATTN_IMPLS}, got {impl!r}")
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN transformer block: TP attention + TP MLP (or EP MoE)."""
+
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+    dtype: Optional[Dtype] = jnp.bfloat16
+    attn_impl: str = "blockwise"
+    moe: bool = False
+    num_experts: int = 8
+    moe_k: int = 2
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d = x.shape[-1]
+        attn_fn = make_attn_fn(self.attn_impl)
+        mask = None
+        if attn_fn is None:  # dot baseline materializes the causal mask
+            S = x.shape[-2]
+            mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
+        h = ParallelSelfAttention(
+            num_heads=self.num_heads, head_dim=self.head_dim,
+            dtype=self.dtype, attn_fn=attn_fn, name="attn")(h, mask)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
+        if self.moe:
+            h = MoELayer(num_experts=self.num_experts,
+                         hidden=self.mlp_ratio * d, k=self.moe_k,
+                         dtype=self.dtype, name="moe")(h)
+        else:
+            h = ParallelMLP(hidden=self.mlp_ratio * d, out=d,
+                            dtype=self.dtype, name="mlp")(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM. Input [B, S] int tokens → [B, S, V] logits.
+
+    Embedding table and LM head are vocab-sharded over ``model``
+    (Megatron layout); activations are pinned (data, seq) so the batch
+    and sequence axes stay distributed through every block.
+    """
+
+    vocab_size: int
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    mlp_ratio: int = 4
+    max_len: int = 2048
+    dtype: Optional[Dtype] = jnp.bfloat16
+    attn_impl: str = "blockwise"
+    moe_every: int = 0          # 0 = dense; n = every n-th block is MoE
+    num_experts: int = 8
+    moe_k: int = 2
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> jax.Array:
+        B, S = tokens.shape
+        d = self.num_heads * self.head_dim
+        embed = self.param(
+            "embed",
+            nn.with_partitioning(nn.initializers.normal(0.02),
+                                 (AXIS_MODEL, None)),
+            (self.vocab_size, d), jnp.float32)
+        pos = self.param("pos", nn.initializers.normal(0.02),
+                         (self.max_len, d), jnp.float32)
+        x = jnp.take(embed, tokens, axis=0) + pos[:S]
+        x = x.astype(self.dtype)
+        x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
+
+        block_cls = TransformerBlock
+        if self.remat:
+            block_cls = nn.remat(TransformerBlock)
+        for i in range(self.num_layers):
+            moe = self.moe_every > 0 and (i + 1) % self.moe_every == 0
+            x = block_cls(
+                num_heads=self.num_heads, head_dim=self.head_dim,
+                mlp_ratio=self.mlp_ratio, dtype=self.dtype,
+                attn_impl=self.attn_impl, moe=moe,
+                num_experts=self.num_experts, moe_k=self.moe_k,
+                name=f"block_{i}")(x)
+            x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
+
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        # Tied LM head: logits sharded over ``model`` on vocab; the CE
+        # loss reduces over it with GSPMD-inserted collectives.
+        logits = jnp.einsum("bsd,vd->bsv", x,
+                            embed.astype(self.dtype))
+        return constrain(logits, AXIS_DATA, AXIS_SEQ, AXIS_MODEL)
+
+
+class TransformerBlockStack(nn.Module):
+    """The per-stage body for pipeline parallelism: `layers_per_stage`
+    blocks applied in sequence, no embedding/head (those live outside the
+    pipeline loop). Used via `pipeline_apply_gspmd` with this module's
+    params stacked [P, ...] over the ``pipe`` axis."""
+
+    num_heads: int
+    head_dim: int
+    layers_per_stage: int = 1
+    mlp_ratio: int = 4
+    dtype: Optional[Dtype] = jnp.bfloat16
+    attn_impl: str = "blockwise"
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for i in range(self.layers_per_stage):
+            x = TransformerBlock(
+                num_heads=self.num_heads, head_dim=self.head_dim,
+                mlp_ratio=self.mlp_ratio, dtype=self.dtype,
+                attn_impl=self.attn_impl, name=f"block_{i}")(x)
+        return x
+
+
+# ---------------------------------------------------------------------------
+# Train step (GSPMD: jit over the mesh; DP/TP/SP/EP collectives inserted
+# by the partitioner from the param/activation shardings).
+# ---------------------------------------------------------------------------
+
+def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Next-token cross entropy, [B, S, V] logits vs [B, S] tokens."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1].astype(jnp.float32), tokens[:, 1:]).mean()
+
+
+def make_lm_train_step(model: TransformerLM,
+                       tx: optax.GradientTransformation, mesh,
+                       *, moe_aux_weight: float = 0.01,
+                       donate: bool = True) -> Callable:
+    """step(params, opt_state, tokens) -> (params, opt_state, loss).
+
+    `params` = unboxed pytree placed by `init_lm_state` (TP/EP leaves
+    sharded per their `nn.Partitioned` annotations, the rest replicated);
+    `tokens` [B, S] sharded (data, seq). One jit over the whole mesh: the
+    gradient all-reduce over ``data`` (the reference's entire hot path,
+    SURVEY §3.2) is inserted by GSPMD because params carry no ``data``
+    axis, and XLA's collective combiner provides the tensor-fusion
+    batching the reference implements by hand (`docs/tensor-fusion.md`).
+    """
+    has_moe = model.moe_every > 0
+
+    def loss_fn(params, tokens):
+        if has_moe:
+            logits, col = model.apply({"params": params}, tokens,
+                                      mutable=["losses"])
+            aux = sum(jnp.asarray(v).sum()
+                      for v in jax.tree.leaves(col.get("losses", {})))
+            return lm_loss(logits, tokens) + moe_aux_weight * aux
+        logits = model.apply({"params": params}, tokens)
+        return lm_loss(logits, tokens)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, loss
+
+    jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    def wrapped(params, opt_state, tokens):
+        with use(mesh):
+            return jitted(params, opt_state, tokens)
+
+    return wrapped
+
+
+def init_lm_state(model: TransformerLM, tx: optax.GradientTransformation,
+                  rng, mesh, sample_tokens) -> Tuple[Any, Any]:
+    """Initialize and mesh-place (params, opt_state).
+
+    Params are initialized on the default device (`model.init`), unboxed,
+    and placed per their partition annotations (`shard_params`); optimizer
+    state inherits placement from params through `tx.init` under jit.
+    Models too large for one device's HBM need sharded-at-birth init
+    (`jax.jit(model.init, out_shardings=...)`) — not wired up yet.
+    """
+    variables = model.init(rng, sample_tokens)
+    with use(mesh):
+        params = shard_params(mesh, variables["params"])
+        opt_state = jax.jit(tx.init)(params)
+    return params, opt_state
+
+
+def lm_param_specs(model: TransformerLM, rng, sample_tokens):
+    """PartitionSpec pytree for the model's params (for inspection/tests)."""
+    variables = jax.eval_shape(model.init, rng, sample_tokens)
+    return param_specs(variables["params"])

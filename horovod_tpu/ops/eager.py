@@ -111,7 +111,8 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
     cnt = seq.get(opname, 0)
     seq[opname] = cnt + 1
     meta = {"dtype": str(arr.dtype), "shape": list(arr.shape),
-            "op": op, "root": root_rank}
+            "op": op, "root": root_rank,
+            "ndev": len(_mc_local_devices(st))}
     if not st.native.kv_set(f"req/{opname}/{cnt}/{st.process_rank}",
                             json.dumps(meta).encode()):
         raise RuntimeError(
@@ -125,6 +126,17 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
                 f"negotiation timeout for {opname}: process {r} never "
                 f"submitted a request (see stall warnings)")
         metas.append(json.loads(v.decode()))
+    # Uniform-ownership check on the *exchanged* counts so every process
+    # raises symmetrically (a local-only check would let the conforming
+    # process proceed into the collective and hang waiting for peers).
+    ndevs = [m.get("ndev") for m in metas]
+    if None not in ndevs and (
+            len(set(ndevs)) > 1
+            or ndevs[0] * st.num_processes != st.size):
+        raise RuntimeError(
+            f"multi-process collectives require every process to own the "
+            f"same number of devices; per-process counts {ndevs} over "
+            f"world size {st.size}")
     from horovod_tpu.ops.validation import validate_requests
     validate_requests(
         name=opname, op=op,

@@ -48,6 +48,7 @@ from horovod_tpu.parallel.sequence import (
     ring_attention,
     ring_attention_gspmd,
     ulysses_attention,
+    ulysses_attention_gspmd,
     blockwise_attention,
 )
 from horovod_tpu.parallel.pipeline import (
@@ -71,7 +72,7 @@ __all__ = [
     "ParallelSelfAttention", "dot_product_attention",
     "param_specs", "shard_params", "unbox",
     "ring_attention", "ring_attention_gspmd", "ulysses_attention",
-    "blockwise_attention",
+    "ulysses_attention_gspmd", "blockwise_attention",
     "PipelineStage", "pipeline_apply", "pipeline_apply_gspmd",
     "MoELayer", "top_k_gating", "expert_alltoall_dispatch",
     "expert_alltoall_combine",

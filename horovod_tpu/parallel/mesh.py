@@ -152,7 +152,13 @@ def constrain(x, *spec):
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return x
-    names = set(mesh.axis_names)
+    # Only Auto axes may appear in a sharding constraint; axes already
+    # Manual (inside an enclosing shard_map, e.g. the pipeline loop) are
+    # out of GSPMD's hands and must be dropped from the spec.
+    names = {n for n, t in zip(mesh.axis_names, mesh.axis_types)
+             if t == jax.sharding.AxisType.Auto}
+    if not names:
+        return x
 
     def keep(entry):
         if entry is None:

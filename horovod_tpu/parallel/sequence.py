@@ -179,8 +179,12 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     --all_to_all--> [B, S/sp, H, D]. Requires H % sp == 0.
     """
     sp = lax.psum(1, axis_name)
-    idx = lax.axis_index(axis_name)
-    B, S_local, H, D = q.shape
+    H = q.shape[2]
+    if H % sp:
+        raise ValueError(
+            f"ulysses_attention needs heads % seq_degree == 0, got "
+            f"{H} heads over seq axis of size {sp}; use ring_attention "
+            f"for head counts that don't divide")
 
     def seq_to_heads(t):  # [B, S/sp, H, D] -> [B, S, H/sp, D]
         return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
@@ -196,8 +200,17 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     else:
         attn_impl = functools.partial(attn_impl, causal=causal)
     oh = attn_impl(qh, kh, vh)
-    del idx
     return heads_to_seq(oh)
+
+
+def _ambient_mesh(mesh):
+    if mesh is not None:
+        return mesh
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        raise ValueError(
+            "no mesh: pass mesh= or call under horovod_tpu.parallel.use()")
+    return mesh
 
 
 def ring_attention_gspmd(mesh, q, k, v, *, causal: bool = False,
@@ -207,10 +220,30 @@ def ring_attention_gspmd(mesh, q, k, v, *, causal: bool = False,
     Activations are global-shaped [B, S, H, D] sharded
     (data, seq, model, -); the shard_map boundary hands each device its
     local block and the ring runs over ``seq``. This is how the flagship
-    transformer calls it.
+    transformer calls it. `mesh=None` uses the ambient mesh installed by
+    `horovod_tpu.parallel.use()`.
     """
+    mesh = _ambient_mesh(mesh)
     spec = P(AXIS_DATA, seq_axis, AXIS_MODEL, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis,
                            causal=causal)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+def ulysses_attention_gspmd(mesh, q, k, v, *, causal: bool = False,
+                            seq_axis: str = AXIS_SEQ,
+                            attn_impl=None) -> jax.Array:
+    """Ulysses sequence parallelism as a shard_map region inside pjit.
+
+    Same boundary contract as `ring_attention_gspmd`; inside, two
+    all-to-alls swap seq↔heads sharding around a local attention call
+    (`attn_impl`, default blockwise — pass the Pallas flash kernel on
+    TPU). Requires heads_per_model_shard % seq_degree == 0.
+    """
+    mesh = _ambient_mesh(mesh)
+    spec = P(AXIS_DATA, seq_axis, AXIS_MODEL, None)
+    fn = functools.partial(ulysses_attention, axis_name=seq_axis,
+                           causal=causal, attn_impl=attn_impl)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)(q, k, v)
