@@ -28,7 +28,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import flax.linen as nn
 
-from horovod_tpu.parallel.mesh import AXIS_MODEL, constrain
+from horovod_tpu.parallel.mesh import (
+    AXIS_DATA, AXIS_MODEL, AXIS_SEQ, constrain,
+)
 
 Dtype = Any
 
@@ -149,8 +151,16 @@ class ParallelSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):
+            # [B, ..., S, H*D] -> [B, ..., S, H, D], keeping batch on
+            # ``data`` and sequence on ``seq`` (a fully-specified
+            # constraint with None there would force batch/seq
+            # replication — an all-gather per block). Unbatched [S, H*D]
+            # input has no data dim to pin.
             t = t.reshape(*t.shape[:-1], self.num_heads, self.head_dim)
-            return constrain(t, *([None] * (t.ndim - 2)), AXIS_MODEL, None)
+            if t.ndim == 3:
+                return constrain(t, AXIS_SEQ, AXIS_MODEL, None)
+            return constrain(t, AXIS_DATA, *([None] * (t.ndim - 4)),
+                             AXIS_SEQ, AXIS_MODEL, None)
 
         q, k, v = heads(q), heads(k), heads(v)
         if self.attn_fn is not None:
@@ -158,7 +168,11 @@ class ParallelSelfAttention(nn.Module):
         else:
             o = dot_product_attention(q, k, v, mask)
         o = o.reshape(*o.shape[:-2], features)
-        o = constrain(o, *([None] * (o.ndim - 1)), AXIS_MODEL)
+        if o.ndim == 2:
+            o = constrain(o, AXIS_SEQ, AXIS_MODEL)
+        else:
+            o = constrain(o, AXIS_DATA, *([None] * (o.ndim - 3)),
+                          AXIS_SEQ, AXIS_MODEL)
         return RowParallelDense(features, use_bias=False, dtype=self.dtype,
                                 name="out")(o)
 
