@@ -31,7 +31,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet101",
                     choices=["resnet50", "resnet101", "vgg16", "mnist"])
-    ap.add_argument("--batch", type=int, default=64,
+    ap.add_argument("--batch", type=int, default=128,
                     help="per-chip batch size")
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--steps", type=int, default=10)

@@ -1,0 +1,105 @@
+"""Checkpoint / resume with the reference's consistency contract.
+
+The reference delegates serialization to TF and imposes two rules
+(SURVEY §5.4): (a) only rank 0 writes, so concurrent workers cannot
+corrupt the checkpoint (`README.md:79-81`, `examples/tensorflow_mnist.py:102`);
+(b) on start/restore, rank-0 state is broadcast so every worker resumes
+from identical weights (`horovod/tensorflow/__init__.py:93-124`).
+
+This module keeps both rules and delegates serialization to Orbax (the
+JAX-native checkpointer): `save()` is a no-op off rank 0, `restore()`
+broadcasts the loaded pytree from rank 0 when requested. Multi-host
+sharded checkpointing (every host writes its own shards in parallel —
+something the reference cannot do) is available via ``distributed=True``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def save(path: str, state: Any, *, force: bool = True,
+         distributed: bool = False) -> bool:
+    """Write `state` (any pytree of arrays) to `path`.
+
+    Rank-0-only unless ``distributed`` (Orbax multi-host mode where all
+    processes participate in writing their own shards). Returns True if
+    this process wrote.
+    """
+    from horovod_tpu.runtime import bootstrap as bs
+
+    if not distributed and bs.is_initialized() and bs.rank() != 0:
+        return False
+    state = jax.tree.map(
+        lambda x: np.asarray(x) if not distributed else x, state)
+    _checkpointer().save(os.path.abspath(path), state, force=force)
+    return True
+
+
+def restore(path: str, *, like: Optional[Any] = None,
+            broadcast: bool = False) -> Any:
+    """Load the pytree at `path`.
+
+    ``like``: optional template pytree — restored leaves adopt its
+    structure/dtypes (Orbax restore_args). ``broadcast=True`` re-asserts
+    the reference's resume contract by broadcasting the loaded state
+    from rank 0 (meaningful in multi-controller mode where workers may
+    read different files or a stale mirror).
+    """
+    restored = _checkpointer().restore(os.path.abspath(path),
+                                       item=like)
+    if broadcast:
+        import horovod_tpu as hvd
+        restored = hvd.broadcast_global_variables(restored, 0)
+    return restored
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Highest numeric subdirectory of `directory` (step_000100-style or
+    plain ints), or None — the resume-discovery helper."""
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        digits = name.split("_")[-1]
+        if digits.isdigit():
+            steps.append(int(digits))
+    return max(steps) if steps else None
+
+
+def save_step(directory: str, step: int, state: Any, *,
+              keep: int = 3) -> bool:
+    """`save()` into `directory/step_{step:08d}`, pruning old steps
+    beyond `keep` (rank 0 only)."""
+    from horovod_tpu.runtime import bootstrap as bs
+
+    wrote = save(os.path.join(directory, f"step_{step:08d}"), state)
+    if wrote and keep > 0:
+        kept = sorted(
+            (n for n in os.listdir(directory)
+             if n.startswith("step_") and n.split("_")[-1].isdigit()),
+            key=lambda n: int(n.split("_")[-1]))
+        for name in kept[:-keep]:
+            import shutil
+            shutil.rmtree(os.path.join(directory, name),
+                          ignore_errors=True)
+    return wrote
+
+
+def restore_latest(directory: str, *, like: Optional[Any] = None,
+                   broadcast: bool = False) -> Optional[Any]:
+    """Restore the highest step under `directory`, or None if empty."""
+    step = latest_step(directory)
+    if step is None:
+        return None
+    return restore(os.path.join(directory, f"step_{step:08d}"),
+                   like=like, broadcast=broadcast)

@@ -1,0 +1,101 @@
+"""Checkpoint/resume contract tests (SURVEY §5.4): rank-0-only writes,
+broadcast-on-restore, save/restore round trip, step discovery."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from horovod_tpu.utils import checkpoint as ckpt
+
+
+@pytest.fixture()
+def state():
+    return {
+        "params": {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                   "b": np.zeros((3,), np.float32)},
+        "step": np.asarray(7),
+    }
+
+
+class TestRoundTrip:
+    def test_save_restore(self, tmp_path, state, hvd):
+        assert ckpt.save(str(tmp_path / "c1"), state)
+        out = ckpt.restore(str(tmp_path / "c1"))
+        np.testing.assert_array_equal(out["params"]["w"],
+                                      state["params"]["w"])
+        assert int(out["step"]) == 7
+
+    def test_restore_with_template(self, tmp_path, state, hvd):
+        ckpt.save(str(tmp_path / "c2"), state)
+        like = {"params": {"w": jnp.zeros((2, 3), jnp.float32),
+                           "b": jnp.zeros((3,), jnp.float32)},
+                "step": jnp.asarray(0)}
+        out = ckpt.restore(str(tmp_path / "c2"), like=like)
+        np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                      state["params"]["w"])
+
+    def test_restore_broadcast(self, tmp_path, state, hvd):
+        """broadcast=True re-runs the reference's resume contract
+        (broadcast rank-0 vars, horovod/tensorflow/__init__.py:93-124)."""
+        ckpt.save(str(tmp_path / "c3"), state)
+        out = ckpt.restore(str(tmp_path / "c3"), broadcast=True)
+        np.testing.assert_array_equal(np.asarray(out["params"]["w"]),
+                                      state["params"]["w"])
+
+
+class TestStepManagement:
+    def test_latest_step_empty(self, tmp_path):
+        assert ckpt.latest_step(str(tmp_path)) is None
+        assert ckpt.latest_step(str(tmp_path / "missing")) is None
+        assert ckpt.restore_latest(str(tmp_path)) is None
+
+    def test_save_step_and_restore_latest(self, tmp_path, state, hvd):
+        for s in (1, 5, 3):
+            st = dict(state, step=np.asarray(s))
+            assert ckpt.save_step(str(tmp_path), s, st)
+        assert ckpt.latest_step(str(tmp_path)) == 5
+        out = ckpt.restore_latest(str(tmp_path))
+        assert int(out["step"]) == 5
+
+    def test_keep_prunes_old_steps(self, tmp_path, state, hvd):
+        import os
+        for s in range(6):
+            ckpt.save_step(str(tmp_path), s, state, keep=2)
+        dirs = sorted(n for n in os.listdir(str(tmp_path))
+                      if n.startswith("step_"))
+        assert dirs == ["step_00000004", "step_00000005"]
+
+    def test_resume_continues_training(self, tmp_path, hvd):
+        """End-to-end resume: train, checkpoint, restore, keep training —
+        loss continues from where it left off."""
+        import optax
+        import horovod_tpu as hv
+
+        def loss_fn(params, batch):
+            x, y = batch
+            return ((x @ params["w"] - y) ** 2).mean()
+
+        tx = hv.DistributedOptimizer(optax.sgd(0.1))
+        params = hv.broadcast_global_variables(
+            {"w": np.zeros((3,), np.float32)}, 0)
+        opt_state = tx.init(params)
+        step = hv.make_train_step(loss_fn, tx)
+        rng = np.random.RandomState(0)
+        w_true = np.asarray([1.0, -2.0, 0.5], np.float32)
+
+        def batch():
+            x = rng.randn(16, 3).astype(np.float32)
+            return x, x @ w_true
+
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, batch())
+        mid = float(loss)
+        ckpt.save_step(str(tmp_path), 5, {"params": params})
+
+        restored = ckpt.restore_latest(str(tmp_path), broadcast=True)
+        params2 = restored["params"]
+        opt_state2 = tx.init(params2)
+        for _ in range(10):
+            params2, opt_state2, loss = step(params2, opt_state2, batch())
+        assert float(loss) < mid
