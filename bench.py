@@ -12,7 +12,7 @@ ResNet-101 throughput (~138 img/s, tf_cnn_benchmarks as used in
 arXiv:1802.05799's setup) — i.e. per-chip speed relative to the
 hardware the reference published on.
 
-Usage: python bench.py [--model resnet101] [--batch 64] [--steps 10]
+Usage: python bench.py [--model resnet101] [--batch 128] [--steps 10]
 """
 
 import argparse
@@ -30,7 +30,8 @@ def log(msg):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet101",
-                    choices=["resnet50", "resnet101", "vgg16", "mnist"])
+                    choices=["resnet50", "resnet101", "vgg16",
+                             "inception3", "mnist"])
     ap.add_argument("--batch", type=int, default=128,
                     help="per-chip batch size")
     ap.add_argument("--image-size", type=int, default=224)
@@ -61,6 +62,10 @@ def main():
     elif args.model == "vgg16":
         model = models.VGG16(num_classes=1000)
         shape = (1, args.image_size, args.image_size, 3)
+        num_classes = 1000
+    elif args.model == "inception3":
+        model = models.InceptionV3(num_classes=1000)
+        shape = (1, max(args.image_size, 299), max(args.image_size, 299), 3)
         num_classes = 1000
     else:
         cls = models.ResNet50 if args.model == "resnet50" else models.ResNet101

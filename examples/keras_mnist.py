@@ -1,0 +1,77 @@
+"""Keras MNIST via the `horovod.keras` compat surface.
+
+The minimal reference Keras flow (`examples/keras_mnist.py` there) plus
+the advanced callbacks (`examples/keras_mnist_advanced.py`): wrap the
+optimizer, broadcast initial state, average metrics, warm the LR up.
+Synthetic MNIST-shaped data (no dataset download in the sandbox).
+
+Run:  python examples/keras_mnist.py --epochs 3
+      python -m horovod_tpu.runner -np 2 python examples/keras_mnist.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import tensorflow as tf
+
+import horovod.keras as hvd
+from horovod.keras.callbacks import (
+    BroadcastGlobalVariablesCallback, MetricAverageCallback,
+    LearningRateWarmupCallback)
+
+
+def make_data(rng, n):
+    y = rng.randint(0, 10, size=(n,))
+    x = rng.randn(n, 28, 28, 1).astype(np.float32) * 0.1
+    x += (y / 10.0)[:, None, None, None]
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args()
+
+    hvd.init()
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Input((28, 28, 1)),
+        tf.keras.layers.Conv2D(16, 3, activation="relu"),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(10),
+    ])
+
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(args.lr, momentum=0.9))
+    model.compile(
+        optimizer=opt,
+        loss=tf.keras.losses.SparseCategoricalCrossentropy(
+            from_logits=True),
+        metrics=["accuracy"])
+
+    # Shard the dataset per worker (reference keras_mnist_advanced.py:
+    # 113-119 divides steps per epoch by hvd.size()).
+    rng = np.random.RandomState(1234 + hvd.rank())
+    x, y = make_data(rng, 4096 // hvd.size())
+
+    hist = model.fit(
+        x, y, batch_size=args.batch, epochs=args.epochs,
+        verbose=2 if hvd.rank() == 0 else 0,
+        callbacks=[
+            BroadcastGlobalVariablesCallback(0),
+            MetricAverageCallback(),
+            LearningRateWarmupCallback(warmup_epochs=1),
+        ])
+    if hvd.rank() == 0:
+        print("final loss %.4f" % hist.history["loss"][-1], flush=True)
+
+
+if __name__ == "__main__":
+    main()

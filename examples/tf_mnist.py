@@ -1,0 +1,93 @@
+"""TF1-style MNIST via the `horovod.tensorflow` compat surface.
+
+The canonical reference flow (`examples/tensorflow_mnist.py` there):
+hvd.init; DistributedOptimizer; BroadcastGlobalVariablesHook;
+MonitoredTrainingSession with rank-0-only checkpointing. Synthetic
+MNIST-shaped data (no dataset download in the sandbox).
+
+Run:  python examples/tf_mnist.py --steps 50
+      python -m horovod_tpu.runner -np 2 python examples/tf_mnist.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import tensorflow as tf
+
+import horovod.tensorflow as hvd
+
+tf1 = tf.compat.v1
+
+
+def make_batch(rng, n):
+    y = rng.randint(0, 10, size=(n,))
+    x = rng.randn(n, 784).astype(np.float32) * 0.1
+    x += np.eye(10, 784, dtype=np.float32)[y] * 2.0
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--checkpoint-dir", default=None)
+    args = ap.parse_args()
+
+    hvd.init()
+
+    g = tf1.Graph()
+    with g.as_default():
+        images = tf1.placeholder(tf.float32, (None, 784), name="images")
+        labels = tf1.placeholder(tf.int32, (None,), name="labels")
+        w1 = tf1.get_variable(
+            "w1", (784, 128),
+            initializer=tf1.glorot_uniform_initializer())
+        b1 = tf1.get_variable("b1", (128,),
+                              initializer=tf1.zeros_initializer())
+        hidden = tf.nn.relu(tf1.matmul(images, w1) + b1)
+        w2 = tf1.get_variable(
+            "w2", (128, 10),
+            initializer=tf1.glorot_uniform_initializer())
+        b2 = tf1.get_variable("b2", (10,),
+                              initializer=tf1.zeros_initializer())
+        logits = tf1.matmul(hidden, w2) + b2
+        loss = tf1.reduce_mean(
+            tf1.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=labels, logits=logits))
+
+        # Scale LR by workers, wrap optimizer — reference steps 2+4.
+        opt = tf1.train.GradientDescentOptimizer(args.lr * hvd.size())
+        opt = hvd.DistributedOptimizer(opt)
+
+        global_step = tf1.train.get_or_create_global_step()
+        train_op = opt.minimize(loss, global_step=global_step)
+
+        hooks = [
+            hvd.BroadcastGlobalVariablesHook(0),
+            tf1.train.StopAtStepHook(last_step=args.steps),
+        ]
+        # Rank-0-only checkpointing (reference README.md:79-81).
+        ckpt_dir = args.checkpoint_dir if hvd.rank() == 0 else None
+
+        rng = np.random.RandomState(1234 + hvd.rank())
+        with tf1.train.MonitoredTrainingSession(
+                checkpoint_dir=ckpt_dir, hooks=hooks) as sess:
+            step = 0
+            while not sess.should_stop():
+                x, y = make_batch(rng, args.batch)
+                _, lv = sess.run([train_op, loss],
+                                 feed_dict={images: x, labels: y})
+                if step % 10 == 0 and hvd.rank() == 0:
+                    print(f"step {step:4d}  loss {lv:.4f}", flush=True)
+                step += 1
+            if hvd.rank() == 0:
+                print(f"final loss {lv:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""`horovod` compatibility namespace.
+
+Lets scripts written against the reference API (`import horovod.tensorflow
+as hvd`, `import horovod.keras as hvd` — reference
+`horovod/tensorflow/__init__.py`, `horovod/keras/__init__.py`) run on the
+TPU-native framework unmodified: the modules re-implement the reference's
+public surface on top of `horovod_tpu`'s eager collectives, bridging
+TensorFlow tensors to the XLA data plane. The native implementation (and
+the JAX-first API) lives in `horovod_tpu`.
+"""

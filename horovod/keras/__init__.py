@@ -1,0 +1,110 @@
+"""Keras adapter — reference-API-compatible surface.
+
+Re-implements the reference's `horovod/keras/__init__.py` on the
+TPU-native collectives: `DistributedOptimizer` dynamically subclasses
+the wrapped optimizer's class (so checkpoints deserialize without
+horovod installed — reference `:81-87`), averaging gradients across
+ranks before they are applied.
+
+Interception point by Keras generation:
+- Keras 3 (`tf.keras` ≥ TF 2.16): `apply_gradients` — the fit loop
+  calls it directly (keras/src/backend/tensorflow/trainer.py).
+- Keras 2 / legacy optimizers: `get_gradients` (the reference's hook,
+  `:41-63`) and `_compute_gradients` (TF2 tape path).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu as _hvd
+from horovod.tensorflow import (  # noqa: F401  (re-exported API)
+    init, shutdown, rank, local_rank, size,
+    allreduce as _tf_allreduce,
+)
+
+
+class _DistributedOptimizer:
+    """Mixin holding the gradient-averaging overrides; combined with
+    the wrapped optimizer's class at wrap time (reference `:27-63`)."""
+
+    _hvd_wrapped = True
+
+    def apply_gradients(self, grads_and_vars, *args, **kwargs):
+        """Keras 3 path: average before apply."""
+        gv = [(g, v) for g, v in grads_and_vars]
+        if size() > 1:
+            gv = [(None if g is None else _average_one(g), v)
+                  for g, v in gv]
+        return super().apply_gradients(gv, *args, **kwargs)
+
+    def get_gradients(self, loss, params):
+        """Keras 2 graph-mode path (reference `:50-61`). Grads arrive
+        already averaged when apply_gradients also intercepted — guard
+        with a flag so they are not averaged twice."""
+        self._hvd_in_get_gradients = True
+        try:
+            grads = super().get_gradients(loss, params)
+        finally:
+            self._hvd_in_get_gradients = False
+        if size() <= 1:
+            return grads
+        return [None if g is None else _average_one(g) for g in grads]
+
+    def _compute_gradients(self, loss, var_list, grad_loss=None,
+                           tape=None):
+        """TF2 legacy-optimizer tape path."""
+        gv = super()._compute_gradients(loss, var_list,
+                                        grad_loss=grad_loss, tape=tape)
+        if size() <= 1:
+            return gv
+        return [(None if g is None else _average_one(g), v)
+                for g, v in gv]
+
+
+def _average_one(grad):
+    if isinstance(grad, tf.IndexedSlices):
+        return _tf_allreduce(grad, average=True)
+    out = tf.numpy_function(
+        lambda t: np.asarray(_hvd.allreduce(t, average=True),
+                             dtype=t.dtype),
+        [grad], grad.dtype)
+    out.set_shape(grad.shape)
+    return out
+
+
+def DistributedOptimizer(optimizer, name=None, device_dense="",
+                         device_sparse=""):
+    """Wrap a Keras optimizer; returns an instance of a dynamically
+    created class so `optimizer.__class__.__name__` survives
+    serialization (reference `:66-87`)."""
+    cls = type(optimizer.__class__.__name__,
+               (_DistributedOptimizer, optimizer.__class__), {})
+    return cls.from_config(optimizer.get_config())
+
+
+def broadcast_global_variables(root_rank):
+    """Broadcast all TF global variables from root (reference `:90-98`);
+    for Keras-3 models prefer `BroadcastGlobalVariablesCallback`."""
+    from horovod.tensorflow import broadcast_global_variables as bgv
+    if tf.executing_eagerly():
+        return bgv(root_rank)
+    op = bgv(root_rank)
+    tf.compat.v1.keras.backend.get_session().run(op)
+    return op
+
+
+def allreduce(value, name=None, average=True):
+    """Eager helper on concrete values (reference `:101-116`)."""
+    return np.asarray(_hvd.allreduce(np.asarray(value), average=average))
+
+
+def allgather(value, name=None):
+    """(reference `:118-130`)"""
+    return np.asarray(_hvd.allgather(np.asarray(value)))
+
+
+def broadcast(value, root_rank, name=None):
+    """(reference `:132-144`)"""
+    return np.asarray(_hvd.broadcast(np.asarray(value), root_rank))

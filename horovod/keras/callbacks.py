@@ -1,0 +1,147 @@
+"""Keras callbacks — reference-API-compatible surface.
+
+Re-implements the reference's `horovod/keras/callbacks.py`:
+BroadcastGlobalVariablesCallback (`:8-34`), MetricAverageCallback
+(`:37-86`), LearningRateWarmupCallback (`:89-178`, Goyal et al. 2017
+momentum-corrected linear warmup).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+import horovod.keras as hvd
+
+
+def _get_value(x):
+    """Read a scalar from a Keras-3 Variable, TF variable, or python
+    number (tf.keras.backend.get_value is gone in Keras 3)."""
+    if hasattr(x, "numpy"):
+        return float(x.numpy())
+    if isinstance(x, (int, float, np.floating)):
+        return float(x)
+    return float(tf.keras.backend.get_value(x))
+
+
+def _set_value(x, v) -> bool:
+    """Assign if the target is a variable; returns False for plain
+    python attributes (which compiled train steps have already baked
+    in, so assignment would be a silent no-op)."""
+    if hasattr(x, "assign"):
+        x.assign(v)
+        return True
+    return False
+
+
+class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+    """Broadcast all model/optimizer state from root at train begin so
+    every worker starts identically (reference `:8-34`)."""
+
+    def __init__(self, root_rank, device=""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_train_begin(self, logs=None):
+        if self.broadcast_done:
+            return
+        for var in self.model.weights:
+            var.assign(hvd.broadcast(var.numpy(), self.root_rank))
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(tf.keras.callbacks.Callback):
+    """Allreduce-average every logged metric at epoch end, in sorted
+    name order for deterministic cross-rank collective order, feeding
+    averaged values back into `logs` so downstream callbacks
+    (ReduceLROnPlateau, TensorBoard) see global metrics
+    (reference `:37-86`)."""
+
+    def _average_metrics(self, logs):
+        if logs is None or hvd.size() <= 1:
+            return
+        for name in sorted(logs.keys()):
+            value = logs[name]
+            if isinstance(value, (int, float, np.floating, np.integer)):
+                logs[name] = float(hvd.allreduce(
+                    np.asarray(value, np.float64), average=True))
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._average_metrics(logs)
+
+
+class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
+    """Linear LR warmup from `initial_lr` to `initial_lr * size` over
+    `warmup_epochs`, with the momentum-correction factor from Goyal et
+    al. 2017 (reference `:89-178`; math at `:96-104`): at each batch of
+    the warmup the LR is
+
+        lr = initial_lr * (1 + progress * (size - 1))
+
+    with progress in [0, 1] across warmup batches.
+    """
+
+    def __init__(self, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0, initial_lr=None):
+        super().__init__()
+        self.warmup_epochs = warmup_epochs
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self.initial_lr = initial_lr
+        self.current_epoch = 0
+        self.restore_momentum = None
+        self._steps = None
+
+    def _lr(self):
+        return self.model.optimizer.learning_rate
+
+    def on_train_begin(self, logs=None):
+        if self.initial_lr is None:
+            self.initial_lr = _get_value(self._lr())
+        if hvd.size() <= 1 or self.warmup_epochs <= 0:
+            self.warmup_epochs = 0
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.steps_per_epoch is not None:
+            self._steps = self.steps_per_epoch
+        if epoch == self.warmup_epochs and self.verbose:
+            print(f"Epoch {epoch}: finished gradual learning rate "
+                  f"warmup to {self.initial_lr * hvd.size()}.")
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self.current_epoch >= self.warmup_epochs:
+            return
+        steps = self._steps or self.params.get("steps") or 1
+        progress = (self.current_epoch * steps + batch) / float(
+            self.warmup_epochs * steps)
+        lr = self.initial_lr * (1.0 + progress * (hvd.size() - 1.0))
+        _set_value(self._lr(), lr)
+        # Momentum correction: scale momentum by lr_new/lr_old so the
+        # effective update magnitude is continuous (Goyal et al. §2.2,
+        # reference `:96-104`). Only possible when momentum is a
+        # variable (compiled steps bake plain attributes in).
+        opt = self.model.optimizer
+        mom = getattr(opt, "momentum", None)
+        if self.momentum_correction and hasattr(mom, "assign"):
+            if self.restore_momentum is None:
+                self.restore_momentum = _get_value(mom)
+            prev_lr = getattr(self, "_prev_lr", lr)
+            if prev_lr > 0:
+                _set_value(mom, self.restore_momentum * lr /
+                           max(lr, prev_lr))
+        self._prev_lr = lr
+
+    def on_epoch_end(self, epoch, logs=None):
+        if (self.restore_momentum is not None
+                and epoch + 1 >= self.warmup_epochs):
+            _set_value(self.model.optimizer.momentum,
+                       self.restore_momentum)
+            self.restore_momentum = None
+
+
+# Reference-era alias (the class appears as both names across Horovod
+# versions; SURVEY §2.2 P4 uses the short form).
+LRWarmupCallback = LearningRateWarmupCallback

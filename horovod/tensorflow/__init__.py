@@ -1,0 +1,231 @@
+"""TensorFlow adapter — reference-API-compatible surface.
+
+Re-implements the public API of the reference's TF adapter
+(`horovod/tensorflow/__init__.py` + `horovod/tensorflow/mpi_ops.py`) on
+the TPU-native data plane: graph ops are `tf.numpy_function` bridges into
+`horovod_tpu`'s eager collectives (XLA `psum`/`all_gather` over the
+device mesh) instead of AsyncOpKernels enqueueing to an MPI background
+thread (`mpi_ops.cc:1746-1909`).
+
+Deployment model matches the reference (one process per accelerator,
+`README.md:66-68`): launch with `python -m horovod_tpu.runner -np N`.
+rank/size/local_rank are the framework's device-level values, which
+coincide with process ranks at one device per process.
+
+Covered surface (reference line cites):
+  init/rank/local_rank/size            mpi_ops.py:80-124
+  allreduce(average, IndexedSlices)    __init__.py:43-79
+  allgather / broadcast                mpi_ops.py:150-187
+  broadcast_global_variables           __init__.py:82-90
+  BroadcastGlobalVariablesHook         __init__.py:93-124
+  DistributedOptimizer                 __init__.py:127-226
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu as _hvd
+
+_tf1 = tf.compat.v1
+
+
+def init():
+    """Attach to the device mesh (reference `mpi_ops.py:80-83`)."""
+    _hvd.init()
+
+
+def shutdown():
+    _hvd.shutdown()
+
+
+def rank() -> int:
+    """Global rank; raises if `init` was not called
+    (reference `mpi_ops.py:98-110`)."""
+    return _hvd.rank()
+
+
+def local_rank() -> int:
+    return _hvd.local_rank()
+
+
+def size() -> int:
+    return _hvd.size()
+
+
+def _np_dtype(tensor):
+    return tensor.dtype.as_numpy_dtype
+
+
+def _bridge(py_fn, tensor, name):
+    """Run `py_fn(np_array) -> np_array` against a TF tensor as a
+    numpy_function node — executes immediately under eager, becomes a
+    graph op inside sessions/tf.function (the analogue of loading the
+    compiled op library, reference `mpi_ops.py:43-74`)."""
+    return tf.numpy_function(py_fn, [tensor], tensor.dtype, name=name)
+
+
+def _allreduce(tensor, name=None):
+    """Raw sum-allreduce graph op (reference `mpi_ops.py:132-148`).
+
+    Not differentiable, like the reference's `ops.NotDifferentiable`
+    registration — gradients do not flow through collectives.
+    """
+    if name is None:
+        name = "HorovodAllreduce_%s" % _norm_name(tensor)
+    dtype = _np_dtype(tensor)
+
+    def fn(t):
+        return np.asarray(
+            _hvd.allreduce(t, average=False), dtype=dtype)
+
+    out = _bridge(fn, tensor, name)
+    out.set_shape(tensor.shape)  # same-shape contract, mpi_ops.cc:1780
+    return out
+
+
+def allgather(tensor, name=None):
+    """Concatenate across ranks on dim 0; ranks may differ in dim 0
+    (reference `mpi_ops.py:150-170`, `mpi_ops.cc:1830-1836`)."""
+    if name is None:
+        name = "HorovodAllgather_%s" % _norm_name(tensor)
+    dtype = _np_dtype(tensor)
+
+    def fn(t):
+        return np.asarray(_hvd.allgather(t), dtype=dtype)
+
+    out = _bridge(fn, tensor, name)
+    out.set_shape([None] + list(tensor.shape)[1:])  # dim 0 unknown
+    return out
+
+
+def broadcast(tensor, root_rank, name=None):
+    """Every rank receives root's value (reference `mpi_ops.py:173-187`)."""
+    if name is None:
+        name = "HorovodBroadcast_%s" % _norm_name(tensor)
+    dtype = _np_dtype(tensor)
+
+    def fn(t):
+        return np.asarray(_hvd.broadcast(t, root_rank), dtype=dtype)
+
+    out = _bridge(fn, tensor, name)
+    out.set_shape(tensor.shape)
+    return out
+
+
+def _norm_name(tensor) -> str:
+    import re
+    name = getattr(tensor, "name", None) or "tensor"
+    return re.sub(r"[^a-zA-Z0-9_]", "_", name)  # mpi_ops.py:127-129
+
+
+def allreduce(tensor, average=True, device_dense="", device_sparse=""):
+    """Average (or sum) a tensor across ranks; `tf.IndexedSlices` takes
+    the allgather path (reference `__init__.py:43-79`). The device_*
+    arguments are accepted for API compatibility; placement belongs to
+    XLA here."""
+    if isinstance(tensor, tf.IndexedSlices):
+        values = allgather(tensor.values)
+        indices = allgather(tensor.indices)
+        new_values = tf.divide(values, size()) if average else values
+        return tf.IndexedSlices(new_values, indices,
+                                dense_shape=tensor.dense_shape)
+    summed = _allreduce(tensor)
+    return tf.divide(summed, size()) if average else summed
+
+
+def broadcast_global_variables(root_rank):
+    """Assign every global variable its root-rank value
+    (reference `__init__.py:82-90`)."""
+    return tf.group(*[_tf1.assign(var, broadcast(var, root_rank))
+                      for var in _tf1.global_variables()])
+
+
+class BroadcastGlobalVariablesHook(_tf1.train.SessionRunHook):
+    """SessionRunHook broadcasting initial state from root
+    (reference `__init__.py:93-124`)."""
+
+    def __init__(self, root_rank, device=""):
+        super().__init__()
+        self.root_rank = root_rank
+        self.bcast_op = None
+        self.device = device
+
+    def begin(self):
+        self.bcast_op = broadcast_global_variables(self.root_rank)
+
+    def after_create_session(self, session, coord):
+        session.run(self.bcast_op)
+
+
+class DistributedOptimizer(_tf1.train.Optimizer):
+    """Wraps a `tf.compat.v1.train.Optimizer`, averaging gradients
+    across ranks before apply (reference `__init__.py:127-226`)."""
+
+    def __init__(self, optimizer, name=None, use_locking=False,
+                 device_dense="", device_sparse=""):
+        if name is None:
+            name = "Distributed{}".format(type(optimizer).__name__)
+        self._optimizer = optimizer
+        self._device_dense = device_dense
+        self._device_sparse = device_sparse
+        super().__init__(name=name, use_locking=use_locking)
+
+    def compute_gradients(self, *args, **kwargs):
+        """Allreduce-average each gradient; None grads pass through;
+        no-op at world size 1 (reference `__init__.py:164-186`)."""
+        gradients = self._optimizer.compute_gradients(*args, **kwargs)
+        if size() <= 1:
+            return gradients
+        return [(None if grad is None else allreduce(
+                    grad, device_dense=self._device_dense,
+                    device_sparse=self._device_sparse), var)
+                for grad, var in gradients]
+
+    # Everything else delegates to the wrapped optimizer
+    # (reference `__init__.py:188-226`).
+    def apply_gradients(self, *args, **kwargs):
+        return self._optimizer.apply_gradients(*args, **kwargs)
+
+    def get_slot(self, *args, **kwargs):
+        return self._optimizer.get_slot(*args, **kwargs)
+
+    def get_slot_names(self, *args, **kwargs):
+        return self._optimizer.get_slot_names(*args, **kwargs)
+
+    def variables(self, *args, **kwargs):
+        return self._optimizer.variables(*args, **kwargs)
+
+    def get_name(self):
+        return self._optimizer.get_name()
+
+    def minimize(self, *args, **kwargs):
+        # Route through *our* compute_gradients so grads are reduced.
+        return super().minimize(*args, **kwargs)
+
+    def _prepare(self):
+        return self._optimizer._prepare()
+
+    def _apply_dense(self, *args, **kwargs):
+        return self._optimizer._apply_dense(*args, **kwargs)
+
+    def _resource_apply_dense(self, *args, **kwargs):
+        return self._optimizer._resource_apply_dense(*args, **kwargs)
+
+    def _apply_sparse_duplicate_indices(self, *args, **kwargs):
+        return self._optimizer._apply_sparse_duplicate_indices(
+            *args, **kwargs)
+
+    def _resource_apply_sparse_duplicate_indices(self, *args, **kwargs):
+        return self._optimizer._resource_apply_sparse_duplicate_indices(
+            *args, **kwargs)
+
+    def _apply_sparse(self, *args, **kwargs):
+        return self._optimizer._apply_sparse(*args, **kwargs)
+
+    def _resource_apply_sparse(self, *args, **kwargs):
+        return self._optimizer._resource_apply_sparse(*args, **kwargs)
+
+    def _finish(self, *args, **kwargs):
+        return self._optimizer._finish(*args, **kwargs)

@@ -85,8 +85,12 @@ def make_cnn_train_step(model, tx: optax.GradientTransformation,
 
 def init_cnn_state(model, tx: optax.GradientTransformation, rng,
                    sample_input) -> dict:
-    """Initialize {params, batch_stats, opt_state} for a CNN model."""
-    variables = model.init(rng, sample_input, train=False)
+    """Initialize {params, batch_stats, opt_state} for a CNN model.
+
+    init is jitted: eager tracing dispatches every initializer op
+    individually, which takes minutes for Inception-sized models."""
+    variables = jax.jit(lambda r, x: model.init(r, x, train=False))(
+        rng, sample_input)
     params = variables["params"]
     batch_stats = variables.get("batch_stats", {})
     return {"params": params, "batch_stats": batch_stats,

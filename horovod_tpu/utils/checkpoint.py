@@ -55,41 +55,54 @@ def restore(path: str, *, like: Optional[Any] = None,
     from rank 0 (meaningful in multi-controller mode where workers may
     read different files or a stale mirror).
     """
-    restored = _checkpointer().restore(os.path.abspath(path),
-                                       item=like)
+    restore_args = None
+    if like is not None:
+        import orbax.checkpoint as ocp
+        restore_args = ocp.checkpoint_utils.construct_restore_args(like)
+    restored = _checkpointer().restore(
+        os.path.abspath(path), item=like, restore_args=restore_args)
     if broadcast:
         import horovod_tpu as hvd
         restored = hvd.broadcast_global_variables(restored, 0)
     return restored
 
 
-def latest_step(directory: str) -> Optional[int]:
-    """Highest numeric subdirectory of `directory` (step_000100-style or
-    plain ints), or None — the resume-discovery helper."""
+def _step_entries(directory: str):
+    """Sorted [(step, dirname)] for step checkpoint subdirectories
+    (`step_00000100`-style or plain ints like `100`)."""
     if not os.path.isdir(directory):
-        return None
-    steps = []
+        return []
+    entries = []
     for name in os.listdir(directory):
-        digits = name.split("_")[-1]
-        if digits.isdigit():
-            steps.append(int(digits))
-    return max(steps) if steps else None
+        if not os.path.isdir(os.path.join(directory, name)):
+            continue
+        if name.isdigit():
+            entries.append((int(name), name))
+        elif name.startswith("step_") and name[5:].isdigit():
+            entries.append((int(name[5:]), name))
+    return sorted(entries)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    """Highest step checkpoint under `directory`, or None — the
+    resume-discovery helper."""
+    entries = _step_entries(directory)
+    return entries[-1][0] if entries else None
 
 
 def save_step(directory: str, step: int, state: Any, *,
               keep: int = 3) -> bool:
-    """`save()` into `directory/step_{step:08d}`, pruning old steps
-    beyond `keep` (rank 0 only)."""
-    from horovod_tpu.runtime import bootstrap as bs
-
-    wrote = save(os.path.join(directory, f"step_{step:08d}"), state)
+    """`save()` into `directory/step_{step:08d}`, then prune the lowest
+    steps down to `keep` entries — never the one just written (rank 0
+    only)."""
+    current = f"step_{step:08d}"
+    wrote = save(os.path.join(directory, current), state)
     if wrote and keep > 0:
-        kept = sorted(
-            (n for n in os.listdir(directory)
-             if n.startswith("step_") and n.split("_")[-1].isdigit()),
-            key=lambda n: int(n.split("_")[-1]))
-        for name in kept[:-keep]:
-            import shutil
+        import shutil
+        entries = _step_entries(directory)
+        candidates = [n for _, n in entries if n != current]
+        excess = len(entries) - keep
+        for name in candidates[:max(0, excess)]:
             shutil.rmtree(os.path.join(directory, name),
                           ignore_errors=True)
     return wrote
@@ -98,8 +111,8 @@ def save_step(directory: str, step: int, state: Any, *,
 def restore_latest(directory: str, *, like: Optional[Any] = None,
                    broadcast: bool = False) -> Optional[Any]:
     """Restore the highest step under `directory`, or None if empty."""
-    step = latest_step(directory)
-    if step is None:
+    entries = _step_entries(directory)
+    if not entries:
         return None
-    return restore(os.path.join(directory, f"step_{step:08d}"),
+    return restore(os.path.join(directory, entries[-1][1]),
                    like=like, broadcast=broadcast)

@@ -99,3 +99,40 @@ class TestStepManagement:
         for _ in range(10):
             params2, opt_state2, loss = step(params2, opt_state2, batch())
         assert float(loss) < mid
+
+
+class TestDiscoveryEdgeCases:
+    def test_stray_files_ignored(self, tmp_path, state, hvd):
+        """Non-directories and non-step names never enter discovery."""
+        ckpt.save_step(str(tmp_path), 3, state)
+        (tmp_path / "log_7").write_text("not a checkpoint")
+        (tmp_path / "events_99").write_text("")
+        assert ckpt.latest_step(str(tmp_path)) == 3
+        assert int(ckpt.restore_latest(str(tmp_path))["step"]) == 7
+
+    def test_plain_int_dirs_restorable(self, tmp_path, state, hvd):
+        """Plain-int step dirs are both discovered AND restorable."""
+        ckpt.save(str(tmp_path / "100"), state)
+        assert ckpt.latest_step(str(tmp_path)) == 100
+        out = ckpt.restore_latest(str(tmp_path))
+        assert int(out["step"]) == 7
+
+    def test_out_of_order_save_not_self_pruned(self, tmp_path, state,
+                                               hvd):
+        """Writing a lower step with keep=1 must not delete itself."""
+        import os
+        ckpt.save_step(str(tmp_path), 5, state, keep=1)
+        ckpt.save_step(str(tmp_path), 1, state, keep=1)
+        names = sorted(n for n in os.listdir(str(tmp_path))
+                       if n.startswith("step_"))
+        assert "step_00000001" in names
+
+    def test_restore_like_applies_dtype(self, tmp_path, state, hvd):
+        """The template's dtypes are applied on restore."""
+        import jax.numpy as jnp
+        ckpt.save(str(tmp_path / "d"), state)
+        like = {"params": {"w": jnp.zeros((2, 3), jnp.bfloat16),
+                           "b": jnp.zeros((3,), jnp.bfloat16)},
+                "step": jnp.asarray(0)}
+        out = ckpt.restore(str(tmp_path / "d"), like=like)
+        assert out["params"]["w"].dtype == jnp.bfloat16
