@@ -32,24 +32,24 @@ class _DistributedOptimizer:
     _hvd_wrapped = True
 
     def apply_gradients(self, grads_and_vars, *args, **kwargs):
-        """Keras 3 path: average before apply."""
+        """Keras 3 path: average before apply. Skipped when the grads
+        were already averaged upstream by get_gradients /
+        _compute_gradients (legacy paths) — averaging twice would
+        double collective traffic and square the sparse allgather."""
         gv = [(g, v) for g, v in grads_and_vars]
-        if size() > 1:
+        if size() > 1 and not getattr(self, "_hvd_already_averaged",
+                                      False):
             gv = [(None if g is None else _average_one(g), v)
                   for g, v in gv]
+        self._hvd_already_averaged = False
         return super().apply_gradients(gv, *args, **kwargs)
 
     def get_gradients(self, loss, params):
-        """Keras 2 graph-mode path (reference `:50-61`). Grads arrive
-        already averaged when apply_gradients also intercepted — guard
-        with a flag so they are not averaged twice."""
-        self._hvd_in_get_gradients = True
-        try:
-            grads = super().get_gradients(loss, params)
-        finally:
-            self._hvd_in_get_gradients = False
+        """Keras 2 graph-mode path (reference `:50-61`)."""
+        grads = super().get_gradients(loss, params)
         if size() <= 1:
             return grads
+        self._hvd_already_averaged = True
         return [None if g is None else _average_one(g) for g in grads]
 
     def _compute_gradients(self, loss, var_list, grad_loss=None,
@@ -59,6 +59,7 @@ class _DistributedOptimizer:
                                         grad_loss=grad_loss, tape=tape)
         if size() <= 1:
             return gv
+        self._hvd_already_averaged = True
         return [(None if g is None else _average_one(g), v)
                 for g, v in gv]
 
@@ -85,11 +86,20 @@ def DistributedOptimizer(optimizer, name=None, device_dense="",
 
 
 def broadcast_global_variables(root_rank):
-    """Broadcast all TF global variables from root (reference `:90-98`);
-    for Keras-3 models prefer `BroadcastGlobalVariablesCallback`."""
-    from horovod.tensorflow import broadcast_global_variables as bgv
+    """Broadcast all TF global variables from root (reference `:90-98`).
+
+    Graph-mode only: under TF2 eager there is no global-variable
+    collection to discover (`tf1.global_variables()` is empty), so a
+    silent no-op would leave workers divergent — raise instead and
+    point at the callback, which walks `model.weights` explicitly.
+    """
     if tf.executing_eagerly():
-        return bgv(root_rank)
+        raise RuntimeError(
+            "broadcast_global_variables requires graph mode; under "
+            "eager/Keras-3 use "
+            "horovod.keras.callbacks.BroadcastGlobalVariablesCallback "
+            "(it broadcasts model.weights directly).")
+    from horovod.tensorflow import broadcast_global_variables as bgv
     op = bgv(root_rank)
     tf.compat.v1.keras.backend.get_session().run(op)
     return op

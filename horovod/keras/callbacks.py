@@ -115,23 +115,25 @@ class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
         if self.current_epoch >= self.warmup_epochs:
             return
         steps = self._steps or self.params.get("steps") or 1
-        progress = (self.current_epoch * steps + batch) / float(
-            self.warmup_epochs * steps)
+        # Clamp: with unknown steps-per-epoch the fallback of 1 would
+        # otherwise push progress (and the LR) far past the size*lr
+        # target.
+        progress = min(1.0, (self.current_epoch * steps + batch) /
+                       float(self.warmup_epochs * steps))
         lr = self.initial_lr * (1.0 + progress * (hvd.size() - 1.0))
         _set_value(self._lr(), lr)
-        # Momentum correction: scale momentum by lr_new/lr_old so the
-        # effective update magnitude is continuous (Goyal et al. §2.2,
-        # reference `:96-104`). Only possible when momentum is a
-        # variable (compiled steps bake plain attributes in).
+        # Momentum correction: scale momentum by lr_new/lr_prev so the
+        # effective update magnitude is continuous across the ramp
+        # (Goyal et al. §2.2, reference `:96-104`). Only possible when
+        # momentum is a variable (compiled steps bake attributes in).
         opt = self.model.optimizer
         mom = getattr(opt, "momentum", None)
         if self.momentum_correction and hasattr(mom, "assign"):
             if self.restore_momentum is None:
                 self.restore_momentum = _get_value(mom)
-            prev_lr = getattr(self, "_prev_lr", lr)
+            prev_lr = getattr(self, "_prev_lr", 0.0)
             if prev_lr > 0:
-                _set_value(mom, self.restore_momentum * lr /
-                           max(lr, prev_lr))
+                _set_value(mom, self.restore_momentum * lr / prev_lr)
         self._prev_lr = lr
 
     def on_epoch_end(self, epoch, logs=None):

@@ -201,31 +201,8 @@ class DistributedOptimizer(_tf1.train.Optimizer):
         return self._optimizer.get_name()
 
     def minimize(self, *args, **kwargs):
-        # Route through *our* compute_gradients so grads are reduced.
+        # Route through *our* compute_gradients so grads are reduced;
+        # apply_gradients then delegates wholesale to the wrapped
+        # optimizer (which drives its own private _prepare/_apply_*
+        # machinery — no per-method delegation needed).
         return super().minimize(*args, **kwargs)
-
-    def _prepare(self):
-        return self._optimizer._prepare()
-
-    def _apply_dense(self, *args, **kwargs):
-        return self._optimizer._apply_dense(*args, **kwargs)
-
-    def _resource_apply_dense(self, *args, **kwargs):
-        return self._optimizer._resource_apply_dense(*args, **kwargs)
-
-    def _apply_sparse_duplicate_indices(self, *args, **kwargs):
-        return self._optimizer._apply_sparse_duplicate_indices(
-            *args, **kwargs)
-
-    def _resource_apply_sparse_duplicate_indices(self, *args, **kwargs):
-        return self._optimizer._resource_apply_sparse_duplicate_indices(
-            *args, **kwargs)
-
-    def _apply_sparse(self, *args, **kwargs):
-        return self._optimizer._apply_sparse(*args, **kwargs)
-
-    def _resource_apply_sparse(self, *args, **kwargs):
-        return self._optimizer._resource_apply_sparse(*args, **kwargs)
-
-    def _finish(self, *args, **kwargs):
-        return self._optimizer._finish(*args, **kwargs)

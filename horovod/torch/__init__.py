@@ -1,0 +1,188 @@
+"""PyTorch adapter on the TPU-native collectives.
+
+The reference (v0.10) ships only the TF adapter; `horovod.torch` is the
+API surface Horovod users expect from the torch side (same shape as the
+TF one, SURVEY §2.2 P2): allreduce/allgather/broadcast on
+`torch.Tensor`s, `broadcast_parameters` / `broadcast_optimizer_state`
+for consistent init, and a `DistributedOptimizer` that averages
+gradients across ranks before `step()`.
+
+CPU torch tensors bridge zero-copy to numpy and ride the same eager
+collective path (XLA `psum`/`all_gather` over the mesh) as everything
+else.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import torch
+
+import horovod_tpu as _hvd
+
+
+def init():
+    _hvd.init()
+
+
+def shutdown():
+    _hvd.shutdown()
+
+
+def rank() -> int:
+    return _hvd.rank()
+
+
+def local_rank() -> int:
+    return _hvd.local_rank()
+
+
+def size() -> int:
+    return _hvd.size()
+
+
+def _to_np(tensor: torch.Tensor) -> np.ndarray:
+    return tensor.detach().cpu().numpy()
+
+
+def _like(arr: np.ndarray, ref: torch.Tensor) -> torch.Tensor:
+    return torch.from_numpy(np.ascontiguousarray(arr)).to(ref.dtype)
+
+
+def allreduce(tensor: torch.Tensor, average: bool = True,
+              name: str | None = None) -> torch.Tensor:
+    """Average (or sum) across ranks; returns a new tensor."""
+    out = np.asarray(_hvd.allreduce(_to_np(tensor), average=average,
+                                    name=name))
+    return _like(out, tensor)
+
+
+def allreduce_(tensor: torch.Tensor, average: bool = True,
+               name: str | None = None) -> torch.Tensor:
+    """In-place variant."""
+    tensor.copy_(allreduce(tensor, average=average, name=name))
+    return tensor
+
+
+def allgather(tensor: torch.Tensor,
+              name: str | None = None) -> torch.Tensor:
+    """Concatenate across ranks on dim 0 (ranks may differ in dim 0)."""
+    out = np.asarray(_hvd.allgather(_to_np(tensor), name=name))
+    return _like(out, tensor)
+
+
+def broadcast(tensor: torch.Tensor, root_rank: int,
+              name: str | None = None) -> torch.Tensor:
+    out = np.asarray(_hvd.broadcast(_to_np(tensor), root_rank,
+                                    name=name))
+    return _like(out, tensor)
+
+
+def broadcast_(tensor: torch.Tensor, root_rank: int,
+               name: str | None = None) -> torch.Tensor:
+    tensor.copy_(broadcast(tensor, root_rank, name=name))
+    return tensor
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a `model.state_dict()` (or `named_parameters()`)
+    in-place so all workers start identically — the torch analogue of
+    `broadcast_global_variables` (reference `__init__.py:82-90`)."""
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        items = sorted(params)
+    for name, p in items:
+        if isinstance(p, torch.Tensor):
+            with torch.no_grad():
+                broadcast_(p.data if p.requires_grad else p, root_rank,
+                           name=f"bcast_{name}")
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0) -> None:
+    """Broadcast optimizer state tensors (momentum buffers etc.)."""
+    for gi, group in enumerate(optimizer.param_groups):
+        for pi, p in enumerate(group["params"]):
+            state = optimizer.state.get(p, {})
+            for key in sorted(state):
+                val = state[key]
+                if isinstance(val, torch.Tensor):
+                    broadcast_(val, root_rank,
+                               name=f"opt_{gi}_{pi}_{key}")
+
+
+class DistributedOptimizer(torch.optim.Optimizer):
+    """Wraps a torch optimizer: every `step()` first allreduce-averages
+    each parameter's `.grad` across ranks — the torch analogue of the
+    reference's compute_gradients override
+    (`horovod/tensorflow/__init__.py:164-186`). Fusion-bucketed: grads
+    are packed same-dtype up to HOROVOD_FUSION_THRESHOLD bytes per
+    collective (`ops/fusion.py`), like the reference's fusion buffer."""
+
+    def __init__(self, optimizer: torch.optim.Optimizer,
+                 named_parameters=None):
+        self._optimizer = optimizer
+        self._names = {}
+        if named_parameters is not None:
+            self._names = {id(p): n for n, p in named_parameters}
+
+    # -- gradient averaging ------------------------------------------------
+    def _averaged_grads(self):
+        grads, params = [], []
+        for group in self._optimizer.param_groups:
+            for p in group["params"]:
+                if p.grad is not None:
+                    grads.append(_to_np(p.grad))
+                    params.append(p)
+        return params, grads
+
+    def step(self, closure=None):
+        loss = None
+        if closure is not None:
+            with torch.enable_grad():
+                loss = closure()
+        if _hvd.size() > 1:
+            params, grads = self._averaged_grads()
+            if grads:
+                from horovod_tpu.ops.fusion import plan_buckets
+                buckets = plan_buckets(grads)
+                for bucket in buckets:
+                    flat = np.concatenate(
+                        [grads[i].ravel() for i in bucket])
+                    red = np.asarray(_hvd.allreduce(
+                        flat, average=True,
+                        name=f"torch_grad_bucket_{bucket[0]}"))
+                    off = 0
+                    for i in bucket:
+                        n = grads[i].size
+                        with torch.no_grad():
+                            params[i].grad.copy_(_like(
+                                red[off:off + n].reshape(
+                                    grads[i].shape), params[i].grad))
+                        off += n
+        self._optimizer.step()
+        return loss
+
+    # -- delegation --------------------------------------------------------
+    def zero_grad(self, set_to_none: bool = True):
+        return self._optimizer.zero_grad(set_to_none=set_to_none)
+
+    @property
+    def param_groups(self):
+        return self._optimizer.param_groups
+
+    @property
+    def state(self):
+        return self._optimizer.state
+
+    def state_dict(self):
+        return self._optimizer.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._optimizer.load_state_dict(sd)
+
+    def add_param_group(self, group):
+        return self._optimizer.add_param_group(group)
+
+    def __repr__(self):
+        return f"Distributed{self._optimizer!r}"

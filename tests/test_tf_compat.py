@@ -187,3 +187,47 @@ class TestKeras:
         assert g.shape == (16, 2)
         b = hvd_keras.broadcast(np.full((2,), 1.5, np.float32), 0)
         np.testing.assert_allclose(b, 1.5)
+
+
+class TestCompatRegressions:
+    def test_apply_gradients_skips_double_average(self, hvd_keras,
+                                                  monkeypatch):
+        """Grads already averaged by a legacy get_gradients /
+        _compute_gradients path are not averaged again in
+        apply_gradients."""
+        import horovod.keras as hk
+        calls = []
+        real = hk._average_one
+        monkeypatch.setattr(hk, "_average_one",
+                            lambda g: calls.append(1) or real(g))
+        v = tf.Variable([1.0, 2.0])
+        opt = hvd_keras.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1))
+        opt._hvd_already_averaged = True
+        opt.apply_gradients([(tf.constant([0.1, 0.1]), v)])
+        assert calls == []           # skipped
+        assert opt._hvd_already_averaged is False  # one-shot flag
+        opt.apply_gradients([(tf.constant([0.1, 0.1]), v)])
+        assert calls == [1]          # normal path averages again
+
+    def test_warmup_lr_clamped_without_steps(self, hvd_keras):
+        """Unknown steps-per-epoch must not push the LR past
+        initial_lr * size."""
+        from horovod.keras.callbacks import LearningRateWarmupCallback
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, input_shape=(2,))])
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.01),
+                      loss="mse")
+        cb = LearningRateWarmupCallback(warmup_epochs=5)
+        cb.set_model(model)
+        cb.params = {"steps": None}
+        cb.on_train_begin()
+        cb.on_epoch_begin(0)
+        for batch in (0, 50, 500):
+            cb.on_train_batch_begin(batch)
+            lr = float(np.asarray(model.optimizer.learning_rate))
+            assert lr <= 0.01 * hvd_keras.size() + 1e-9, (batch, lr)
+
+    def test_broadcast_global_variables_eager_raises(self, hvd_keras):
+        with pytest.raises(RuntimeError, match="Callback"):
+            hvd_keras.broadcast_global_variables(0)

@@ -1,0 +1,112 @@
+"""`horovod.torch` adapter tests — the reference oracle strategy
+(allreduce == tensor*size, SURVEY §4) on torch tensors, plus the
+consistent-init and training contracts."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def hvd_torch(hvd):
+    import horovod.torch as hvd_torch
+    hvd_torch.init()
+    return hvd_torch
+
+
+class TestCollectives:
+    @pytest.mark.parametrize("dtype", [torch.float32, torch.float64,
+                                       torch.int32, torch.int64])
+    def test_allreduce(self, hvd_torch, dtype):
+        t = torch.arange(6, dtype=dtype).reshape(2, 3)
+        total = hvd_torch.allreduce(t, average=False)
+        assert total.dtype == dtype
+        np.testing.assert_array_equal(total.numpy(),
+                                      t.numpy() * hvd_torch.size())
+        avg = hvd_torch.allreduce(t.to(torch.float32))
+        np.testing.assert_allclose(avg.numpy(),
+                                   t.to(torch.float32).numpy())
+
+    def test_allreduce_inplace(self, hvd_torch):
+        t = torch.ones(4)
+        out = hvd_torch.allreduce_(t, average=False)
+        assert out is t
+        np.testing.assert_allclose(t.numpy(), hvd_torch.size())
+
+    def test_allgather(self, hvd_torch):
+        t = torch.ones(2, 3)
+        g = hvd_torch.allgather(t)
+        assert g.shape == (2 * hvd_torch.size(), 3)
+
+    def test_broadcast(self, hvd_torch):
+        t = torch.full((3,), 2.5)
+        out = hvd_torch.broadcast(t, 0)
+        np.testing.assert_allclose(out.numpy(), 2.5)
+
+
+class TestTraining:
+    def _data(self, rng, n=64):
+        x = rng.randn(n, 3).astype(np.float32)
+        w = np.asarray([[1.0], [-2.0], [0.5]], np.float32)
+        return torch.from_numpy(x), torch.from_numpy(x @ w)
+
+    def test_broadcast_parameters(self, hvd_torch):
+        model = torch.nn.Linear(3, 1)
+        hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+        hvd_torch.broadcast_parameters(
+            list(model.named_parameters()), root_rank=0)
+
+    def test_distributed_optimizer_trains(self, hvd_torch):
+        rng = np.random.RandomState(0)
+        model = torch.nn.Linear(3, 1, bias=False)
+        torch.nn.init.zeros_(model.weight)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.02, momentum=0.9),
+            named_parameters=model.named_parameters())
+        hvd_torch.broadcast_parameters(model.state_dict(), 0)
+        losses = []
+        for _ in range(50):
+            x, y = self._data(rng)
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        assert losses[-1] < 0.05 * losses[0], losses
+        hvd_torch.broadcast_optimizer_state(opt._optimizer, 0)
+
+    def test_wrapped_step_matches_unwrapped(self, hvd_torch):
+        """With replicated inputs the grad-average is the identity, so
+        one wrapped step must equal one plain step — the tensor*size/
+        size oracle (mpi_ops_test.py:85-114) at the optimizer level."""
+        rng = np.random.RandomState(3)
+        x, y = self._data(rng)
+
+        def one_step(wrap):
+            torch.manual_seed(0)
+            model = torch.nn.Linear(3, 1)
+            inner = torch.optim.SGD(model.parameters(), lr=0.05)
+            opt = hvd_torch.DistributedOptimizer(inner) if wrap else inner
+            opt.zero_grad()
+            torch.nn.functional.mse_loss(model(x), y).backward()
+            opt.step()
+            return model.weight.detach().numpy().copy()
+
+        np.testing.assert_allclose(one_step(True), one_step(False),
+                                   rtol=1e-6)
+
+    def test_optimizer_delegation(self, hvd_torch):
+        model = torch.nn.Linear(2, 1)
+        inner = torch.optim.Adam(model.parameters(), lr=1e-3)
+        opt = hvd_torch.DistributedOptimizer(inner)
+        assert opt.param_groups is inner.param_groups
+        sd = opt.state_dict()
+        opt.load_state_dict(sd)
+        # LR schedulers operate on param_groups through the wrapper.
+        sched = torch.optim.lr_scheduler.StepLR(inner, step_size=1)
+        x = torch.randn(4, 2)
+        model(x).sum().backward()
+        opt.step()
+        sched.step()
+        assert opt.param_groups[0]["lr"] < 1e-3 + 1e-12
