@@ -3,7 +3,8 @@
 Mirror of the reference `examples/keras_mnist_advanced.py`: all three
 callbacks — broadcast-on-begin, metric averaging, gradual LR warmup
 (Goyal et al.) — plus per-worker data sharding
-(`keras_mnist_advanced.py:80-119`).
+(`keras_mnist_advanced.py:80-119`), here through the native prefetching
+sharded dataset (`horovod_tpu.data`) instead of steps-per-epoch math.
 """
 
 import argparse
@@ -18,25 +19,57 @@ import numpy as np
 import optax
 
 import horovod_tpu as hvd
+from horovod_tpu import data as hvd_data
 from horovod_tpu.callbacks import MetricAverager, lr_warmup_schedule
 from horovod_tpu.models import MnistConvNet, make_cnn_train_step
 from horovod_tpu.models.train import init_cnn_state
 from examples.jax_mnist import make_batch
 
+SPEC = [("image", "float32", (28, 28, 1)), ("label", "int32", ())]
+
+
+def prepare_shards(directory, n=4096, num_shards=8):
+    """Synthetic MNIST-shaped dataset as binary shards (one-time)."""
+    rng = np.random.RandomState(0)
+    x, y = make_batch(rng, n)
+    return hvd_data.write_shards(
+        directory, "mnist", SPEC,
+        {"image": x, "label": y.astype(np.int32)}, num_shards)
+
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--epochs", type=int, default=3)
-    ap.add_argument("--steps-per-epoch", type=int, default=20)
     ap.add_argument("--batch-per-rank", type=int, default=32)
+    ap.add_argument("--data-dir", default="/tmp/hvd_tpu_mnist_shards")
     args = ap.parse_args()
 
     hvd.init()
     model = MnistConvNet(dtype=jnp.float32)
 
+    # Native prefetching dataset, shards owned round-robin per rank
+    # (the process grid: each launcher worker reads its own shards).
+    # Only one process writes; broadcast_object doubles as the barrier
+    # so readers never see half-written files.
+    num_shards = 8
+    if hvd.process_rank() == 0:
+        prepare_shards(args.data_dir, num_shards=num_shards)
+    hvd.broadcast_object("shards-ready", 0)
+    paths = hvd_data.shard_paths(args.data_dir, "mnist", num_shards)
+    global_batch = args.batch_per_rank * hvd.size()
+    ds = hvd_data.ShardedDataset(
+        paths, SPEC, batch_size=global_batch, shuffle=True, seed=42,
+        rank=hvd.process_rank(), world=hvd.num_processes(),
+        drop_remainder=True)
+    # Ranks may own different record counts when shards don't divide
+    # evenly; every step issues collectives, so all ranks must run the
+    # same number — take the global minimum.
+    steps_per_epoch = int(np.min(np.asarray(
+        hvd.allgather(np.asarray([ds.steps_per_epoch()])))))
+
     # LRWarmupCallback parity: warm from lr to size*lr over 2 epochs.
     schedule = lr_warmup_schedule(0.01, warmup_epochs=2,
-                                  steps_per_epoch=args.steps_per_epoch)
+                                  steps_per_epoch=steps_per_epoch)
     tx = optax.sgd(schedule, momentum=0.9)
 
     rng = jax.random.PRNGKey(0)
@@ -47,17 +80,19 @@ def main():
     step = make_cnn_train_step(model, tx)
     averager = MetricAverager()  # MetricAverageCallback parity
 
-    data_rng = np.random.RandomState(hvd.process_rank())
-    global_batch = args.batch_per_rank * hvd.size()
+    import itertools
     for epoch in range(args.epochs):
-        epoch_loss = 0.0
-        for _ in range(args.steps_per_epoch):
-            x, y = make_batch(data_rng, global_batch)
-            state, loss = step(state, (x, y), rng)
+        epoch_loss, nsteps = 0.0, 0
+        for batch in itertools.islice(ds.epoch(epoch), steps_per_epoch):
+            state, loss = step(
+                state, (batch["image"], batch["label"]), rng)
             epoch_loss += float(loss)
-        logs = averager({"loss": epoch_loss / args.steps_per_epoch})
+            nsteps += 1
+        logs = averager({"loss": epoch_loss / max(1, nsteps)})
         if hvd.rank() == 0:
-            print(f"epoch {epoch}  avg loss {logs['loss']:.4f}")
+            print(f"epoch {epoch}  avg loss {logs['loss']:.4f} "
+                  f"({nsteps} steps, native={ds.native})")
+    ds.close()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,267 @@
+"""Sharded input pipeline over the native prefetching loader.
+
+The reference leaves IO to user code and prescribes only the sharding
+arithmetic (`examples/keras_mnist_advanced.py:113-119`: divide the work
+by `hvd.size()`). On TPU the host must hide IO behind device steps, so
+this subsystem makes the recipe a component:
+
+* `write_shards` — pack numpy arrays into fixed-record binary shards.
+* `ShardedDataset` — per-rank round-robin shard ownership, C++ reader
+  threads prefetching batches into a bounded queue
+  (`native/data_loader.cc`), deterministic per-epoch shuffling; a
+  pure-Python fallback keeps the same semantics when the native build
+  is unavailable (`HOROVOD_NO_NATIVE=1`).
+
+Records are structured rows: a `spec` of (name, dtype, shape) fields,
+e.g. ``[("image", "float32", (28, 28, 1)), ("label", "int32", ())]``;
+batches come back as dicts of numpy arrays with a leading batch dim.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Spec = Sequence[Tuple[str, str, Tuple[int, ...]]]
+
+
+def _field_bytes(dtype: str, shape: Tuple[int, ...]) -> int:
+    return int(np.dtype(dtype).itemsize * int(np.prod(shape, dtype=np.int64)))
+
+
+def record_bytes(spec: Spec) -> int:
+    return sum(_field_bytes(d, s) for _, d, s in spec)
+
+
+def pack_records(spec: Spec, arrays: Dict[str, np.ndarray]) -> bytes:
+    """Pack {name: [N, *shape] array} into N contiguous records."""
+    n = len(next(iter(arrays.values())))
+    parts = []
+    for name, dtype, shape in spec:
+        a = np.ascontiguousarray(arrays[name], dtype=np.dtype(dtype))
+        if a.shape != (n, *shape):
+            raise ValueError(
+                f"field {name}: expected {(n, *shape)}, got {a.shape}")
+        parts.append(a.reshape(n, -1).view(np.uint8).reshape(n, -1))
+    return np.concatenate(parts, axis=1).tobytes()
+
+
+def unpack_records(spec: Spec, buf: np.ndarray,
+                   n: int) -> Dict[str, np.ndarray]:
+    """Inverse of `pack_records` for a [n * record_bytes] uint8 buffer."""
+    rb = record_bytes(spec)
+    rows = buf[:n * rb].reshape(n, rb)
+    out, off = {}, 0
+    for name, dtype, shape in spec:
+        fb = _field_bytes(dtype, shape)
+        field = rows[:, off:off + fb].copy().view(np.dtype(dtype))
+        out[name] = field.reshape(n, *shape)
+        off += fb
+    return out
+
+
+def shard_paths(directory: str, prefix: str,
+                num_shards: int) -> List[str]:
+    """The deterministic shard file names `write_shards` produces —
+    lets non-writer ranks construct the list without writing."""
+    return [os.path.join(directory,
+                         f"{prefix}-{s:05d}-of-{num_shards:05d}.bin")
+            for s in range(num_shards)]
+
+
+def write_shards(directory: str, prefix: str, spec: Spec,
+                 arrays: Dict[str, np.ndarray],
+                 num_shards: int) -> List[str]:
+    """Split rows round-robin into `num_shards` binary shard files.
+
+    Writes atomically (tmp + rename) so a concurrent reader never sees
+    a truncated shard. In multi-process runs only one process should
+    write (then barrier) — see `examples/jax_mnist_advanced.py`.
+    """
+    os.makedirs(directory, exist_ok=True)
+    n = len(next(iter(arrays.values())))
+    paths = shard_paths(directory, prefix, num_shards)
+    for s, path in enumerate(paths):
+        idx = np.arange(s, n, num_shards)
+        shard = {k: v[idx] for k, v in arrays.items()}
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(pack_records(spec, shard))
+        os.replace(tmp, path)
+    return paths
+
+
+class _NativeLoader:
+    def __init__(self, lib_path: str, files: Sequence[str], rb: int,
+                 batch: int, capacity: int, shuffle: bool, seed: int,
+                 rank: int, world: int, drop_remainder: bool):
+        lib = ctypes.CDLL(lib_path)
+        lib.hvd_dl_open.restype = ctypes.c_void_p
+        lib.hvd_dl_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int]
+        lib.hvd_dl_start_epoch.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_uint64]
+        lib.hvd_dl_next.restype = ctypes.c_int64
+        lib.hvd_dl_next.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_uint8)]
+        lib.hvd_dl_num_records.restype = ctypes.c_int64
+        lib.hvd_dl_num_records.argtypes = [ctypes.c_void_p]
+        lib.hvd_dl_error.restype = ctypes.c_char_p
+        lib.hvd_dl_error.argtypes = [ctypes.c_void_p]
+        lib.hvd_dl_close.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        arr = (ctypes.c_char_p * len(files))(
+            *[f.encode() for f in files])
+        self._h = lib.hvd_dl_open(arr, len(files), rb, batch, capacity,
+                                  int(shuffle), seed, rank, world,
+                                  int(drop_remainder))
+        if not self._h:
+            raise ValueError("hvd_dl_open rejected arguments")
+        self._rb, self._batch = rb, batch
+
+    def num_records(self) -> int:
+        return self._lib.hvd_dl_num_records(self._h)
+
+    def epoch(self, epoch_idx: int):
+        self._lib.hvd_dl_start_epoch(self._h, epoch_idx)
+        buf = np.empty(self._batch * self._rb, np.uint8)
+        ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        while True:
+            n = self._lib.hvd_dl_next(self._h, ptr)
+            if n < 0:
+                raise RuntimeError(
+                    self._lib.hvd_dl_error(self._h).decode())
+            if n == 0:
+                return
+            yield buf, int(n)
+
+    def close(self):
+        if self._h:
+            self._lib.hvd_dl_close(self._h)
+            self._h = None
+
+
+class _PythonLoader:
+    """Same semantics, no prefetch thread — the degraded path."""
+
+    def __init__(self, files, rb, batch, shuffle, seed, rank, world,
+                 drop_remainder):
+        self._files = [f for i, f in enumerate(files)
+                       if i % world == rank]
+        self._rb, self._batch = rb, batch
+        self._shuffle, self._seed = shuffle, seed
+        self._drop = drop_remainder
+
+    def num_records(self) -> int:
+        return sum(os.path.getsize(f) // self._rb for f in self._files)
+
+    def epoch(self, epoch_idx: int):
+        order = []
+        for fi, f in enumerate(self._files):
+            n = os.path.getsize(f) // self._rb
+            order += [(fi, r) for r in range(n)]
+        if self._shuffle:
+            rng = np.random.default_rng(
+                (self._seed * 0x9E3779B97F4A7C15 + epoch_idx)
+                % (2 ** 63))
+            rng.shuffle(order)
+        buf = np.empty(self._batch * self._rb, np.uint8)
+        n_in = 0
+        handles = [open(f, "rb") for f in self._files]
+        try:
+            for fi, ri in order:
+                handles[fi].seek(ri * self._rb)
+                rec = handles[fi].read(self._rb)
+                buf[n_in * self._rb:(n_in + 1) * self._rb] = (
+                    np.frombuffer(rec, np.uint8))
+                n_in += 1
+                if n_in == self._batch:
+                    yield buf, n_in
+                    n_in = 0
+            if n_in and not self._drop:
+                yield buf, n_in
+        finally:
+            for h in handles:
+                h.close()
+
+    def close(self):
+        pass
+
+
+class ShardedDataset:
+    """Per-rank sharded, prefetched dataset over binary record shards.
+
+    >>> ds = ShardedDataset(paths, spec, batch_size=64, shuffle=True)
+    >>> for epoch in range(3):
+    ...     for batch in ds.epoch(epoch):   # dict of numpy arrays
+    ...         step(state, batch)
+    """
+
+    def __init__(self, files: Sequence[str], spec: Spec,
+                 batch_size: int, *, shuffle: bool = False,
+                 seed: int = 0, capacity: int = 4,
+                 rank: Optional[int] = None, world: Optional[int] = None,
+                 drop_remainder: bool = False):
+        from horovod_tpu.runtime import bootstrap as bs
+        from horovod_tpu.runtime.config import config
+
+        if rank is None:
+            rank = bs.rank() if bs.is_initialized() else 0
+        if world is None:
+            world = bs.size() if bs.is_initialized() else 1
+        self.spec = list(spec)
+        self._rb = record_bytes(spec)
+        self.batch_size = batch_size
+        impl = None
+        if config.use_native:
+            try:
+                from horovod_tpu.native.build import build_data_loader
+                impl = _NativeLoader(
+                    build_data_loader(), files, self._rb, batch_size,
+                    capacity, shuffle, seed, rank, world,
+                    drop_remainder)
+            except Exception as e:
+                # Degrading silently would hide real misconfiguration
+                # behind a slow single-threaded path.
+                import warnings
+                warnings.warn(
+                    f"native data loader unavailable ({e!r}); falling "
+                    f"back to the Python reader. Set "
+                    f"HOROVOD_NO_NATIVE=1 to silence.")
+                impl = None
+        if impl is None:
+            impl = _PythonLoader(files, self._rb, batch_size, shuffle,
+                                 seed, rank, world, drop_remainder)
+        self._impl = impl
+
+    @property
+    def native(self) -> bool:
+        return isinstance(self._impl, _NativeLoader)
+
+    def num_records(self) -> int:
+        """Records owned by this rank — steps_per_epoch numerator
+        (reference keras_mnist_advanced.py:113-119)."""
+        return self._impl.num_records()
+
+    def steps_per_epoch(self) -> int:
+        return self.num_records() // self.batch_size
+
+    def epoch(self, epoch_idx: int = 0):
+        """Iterate one epoch of batches as {field: array} dicts."""
+        for buf, n in self._impl.epoch(epoch_idx):
+            yield unpack_records(self.spec, buf, n)
+
+    def close(self):
+        self._impl.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -12,32 +12,44 @@ import os
 import subprocess
 import tempfile
 
-_SRC = os.path.join(os.path.dirname(__file__), "control_plane.cc")
-_OUT = os.path.join(os.path.dirname(__file__), "libhorovod_tpu_core.so")
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "control_plane.cc")
+_OUT = os.path.join(_DIR, "libhorovod_tpu_core.so")
 
 
-def build_if_needed() -> str:
-    """Compile the control plane if the .so is missing or stale.
+def build_library(src: str, out: str) -> str:
+    """Compile `src` into shared library `out` if missing or stale.
     Returns the library path; raises on compile failure."""
-    if (os.path.exists(_OUT)
-            and os.path.getmtime(_OUT) >= os.path.getmtime(_SRC)):
-        return _OUT
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
     # Build into a temp file then atomically rename, so concurrent
     # processes (hvdrun workers) never load a half-written .so.
-    fd, tmp = tempfile.mkstemp(suffix=".so",
-                               dir=os.path.dirname(_OUT))
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(out))
     os.close(fd)
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", tmp]
+           src, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(tmp, _OUT)
+        os.replace(tmp, out)
     except subprocess.CalledProcessError as e:
         os.unlink(tmp)
         raise RuntimeError(
-            f"native control plane build failed:\n{e.stderr}") from e
+            f"native build of {os.path.basename(src)} failed:\n"
+            f"{e.stderr}") from e
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
-    return _OUT
+    return out
+
+
+def build_if_needed() -> str:
+    """Compile the control plane if missing/stale."""
+    return build_library(_SRC, _OUT)
+
+
+def build_data_loader() -> str:
+    """Compile the native data loader if missing/stale."""
+    return build_library(os.path.join(_DIR, "data_loader.cc"),
+                         os.path.join(_DIR, "libhorovod_tpu_data.so"))

@@ -1,0 +1,167 @@
+"""Native data loader tests: pack/unpack round trip, rank sharding,
+shuffle determinism, prefetch queue drain, python-fallback equivalence
+(SURVEY §4 oracle style: everything checked against locally computable
+truth)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu import data as hd
+
+SPEC = [("image", "float32", (4, 4)), ("label", "int32", ())]
+
+
+def _arrays(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "image": rng.randn(n, 4, 4).astype(np.float32),
+        "label": rng.randint(0, 10, size=(n,)).astype(np.int32),
+    }
+
+
+@pytest.fixture()
+def shards(tmp_path):
+    arrays = _arrays(64)
+    paths = hd.write_shards(str(tmp_path), "train", SPEC, arrays, 4)
+    return paths, arrays
+
+
+class TestPacking:
+    def test_round_trip(self):
+        arrays = _arrays(8)
+        buf = np.frombuffer(hd.pack_records(SPEC, arrays), np.uint8)
+        out = hd.unpack_records(SPEC, buf.copy(), 8)
+        np.testing.assert_array_equal(out["image"], arrays["image"])
+        np.testing.assert_array_equal(out["label"], arrays["label"])
+
+    def test_bad_shape_rejected(self):
+        with pytest.raises(ValueError, match="field image"):
+            hd.pack_records(SPEC, {"image": np.zeros((2, 3, 3)),
+                                   "label": np.zeros((2,))})
+
+
+class TestShardedDataset:
+    def test_native_loader_builds(self, shards):
+        paths, _ = shards
+        with hd.ShardedDataset(paths, SPEC, batch_size=8) as ds:
+            assert ds.native, "native loader should build in this image"
+
+    def test_full_epoch_covers_all_records(self, shards):
+        paths, arrays = shards
+        with hd.ShardedDataset(paths, SPEC, batch_size=8,
+                               rank=0, world=1) as ds:
+            assert ds.num_records() == 64
+            assert ds.steps_per_epoch() == 8
+            got = [b for b in ds.epoch(0)]
+        labels = np.concatenate([b["label"] for b in got])
+        assert sorted(labels.tolist()) == sorted(
+            arrays["label"].tolist())
+
+    def test_rank_sharding_disjoint_and_complete(self, shards):
+        paths, arrays = shards
+        seen = []
+        for r in range(4):
+            with hd.ShardedDataset(paths, SPEC, batch_size=4,
+                                   rank=r, world=4) as ds:
+                assert ds.num_records() == 16
+                for b in ds.epoch(0):
+                    seen.append(b["image"].reshape(len(b["label"]), -1))
+        seen = np.concatenate(seen)
+        all_rows = arrays["image"].reshape(64, -1)
+        assert seen.shape == all_rows.shape
+        # disjoint + complete == same multiset of rows
+        np.testing.assert_allclose(
+            np.sort(seen.sum(axis=1)), np.sort(all_rows.sum(axis=1)),
+            rtol=1e-6)
+
+    def test_shuffle_deterministic_per_seed_and_epoch(self, shards):
+        paths, _ = shards
+
+        def labels_of(seed, epoch):
+            with hd.ShardedDataset(paths, SPEC, batch_size=64,
+                                   shuffle=True, seed=seed,
+                                   rank=0, world=1) as ds:
+                return np.concatenate(
+                    [b["label"] for b in ds.epoch(epoch)])
+
+        a = labels_of(7, 0)
+        assert not np.array_equal(a, labels_of(7, 1)), \
+            "epochs must reshuffle"
+        np.testing.assert_array_equal(a, labels_of(7, 0))
+        assert not np.array_equal(a, labels_of(8, 0))
+
+    def test_remainder_batch(self, shards):
+        paths, _ = shards
+        with hd.ShardedDataset(paths, SPEC, batch_size=24,
+                               rank=0, world=1) as ds:
+            sizes = [len(b["label"]) for b in ds.epoch(0)]
+        assert sizes == [24, 24, 16]
+        with hd.ShardedDataset(paths, SPEC, batch_size=24, rank=0,
+                               world=1, drop_remainder=True) as ds:
+            sizes = [len(b["label"]) for b in ds.epoch(0)]
+        assert sizes == [24, 24]
+
+    def test_multiple_epochs_reusable(self, shards):
+        paths, _ = shards
+        with hd.ShardedDataset(paths, SPEC, batch_size=16,
+                               rank=0, world=1) as ds:
+            for e in range(3):
+                n = sum(len(b["label"]) for b in ds.epoch(e))
+                assert n == 64
+
+    def test_python_fallback_equivalent(self, shards, monkeypatch):
+        paths, arrays = shards
+        from horovod_tpu.runtime.config import config
+        monkeypatch.setattr(config, "use_native", False)
+        with hd.ShardedDataset(paths, SPEC, batch_size=8, shuffle=True,
+                               seed=3, rank=1, world=2) as ds:
+            assert not ds.native
+            py = np.concatenate([b["label"] for b in ds.epoch(0)])
+        monkeypatch.setattr(config, "use_native", True)
+        with hd.ShardedDataset(paths, SPEC, batch_size=8, shuffle=True,
+                               seed=3, rank=1, world=2) as ds:
+            assert ds.native
+            nat = np.concatenate([b["label"] for b in ds.epoch(0)])
+        # same multiset (shard ownership identical; order may differ
+        # between the two shuffle implementations)
+        assert sorted(py.tolist()) == sorted(nat.tolist())
+
+
+class TestLoaderRobustness:
+    def test_abandoned_epoch_then_restart(self, shards):
+        """Breaking out of an epoch with a full prefetch queue must not
+        deadlock the next epoch, and no stale batches may leak."""
+        paths, _ = shards
+        with hd.ShardedDataset(paths, SPEC, batch_size=4, capacity=2,
+                               rank=0, world=1) as ds:
+            it = ds.epoch(0)
+            next(it)  # producer now blocked on the full queue
+            del it
+            total = sum(len(b["label"]) for b in ds.epoch(1))
+            assert total == 64
+
+    def test_truncated_shard_raises_not_hangs(self, tmp_path):
+        arrays = _arrays(32, seed=5)
+        paths = hd.write_shards(str(tmp_path), "t", SPEC, arrays, 2)
+        rb = hd.record_bytes(SPEC)
+        # Leave 2.5 records in shard 0: num_records floors to 2, but
+        # the short tail read must surface as an error, not a hang.
+        with open(paths[0], "r+b") as f:
+            f.truncate(rb * 2 + rb // 2)
+        with open(paths[0], "ab") as f:
+            pass
+        with hd.ShardedDataset(paths, SPEC, batch_size=8, rank=0,
+                               world=1) as ds:
+            assert ds.native
+            batches = []
+            for b in ds.epoch(0):
+                batches.append(b)
+            # 2 + 16 records readable; all batches intact
+            assert sum(len(b["label"]) for b in batches) == 18
+
+    def test_missing_shard_raises(self, tmp_path):
+        missing = str(tmp_path / "nope.bin")
+        with hd.ShardedDataset([missing], SPEC, batch_size=4, rank=0,
+                               world=1) as ds:
+            with pytest.raises(RuntimeError, match="cannot open"):
+                list(ds.epoch(0))
