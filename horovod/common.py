@@ -1,0 +1,31 @@
+"""Shared adapter utilities (numpy-only — no framework imports)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compression:
+    """Gradient compression for the wire: halve allreduce bytes by
+    reducing in fp16 (the bandwidth knob the reference lists as future
+    work; the native path's `HOROVOD_ALLREDUCE_DTYPE` equivalent)."""
+
+    class none:  # noqa: N801 — horovod-API name
+        @staticmethod
+        def compress(arr):
+            return arr, arr.dtype
+
+        @staticmethod
+        def decompress(arr, dtype):
+            return arr
+
+    class fp16:  # noqa: N801
+        @staticmethod
+        def compress(arr):
+            if arr.dtype in (np.float32, np.float64):
+                return arr.astype(np.float16), arr.dtype
+            return arr, arr.dtype
+
+        @staticmethod
+        def decompress(arr, dtype):
+            return arr.astype(dtype) if arr.dtype != dtype else arr

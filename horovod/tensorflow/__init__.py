@@ -120,7 +120,11 @@ def _norm_name(tensor) -> str:
     return re.sub(r"[^a-zA-Z0-9_]", "_", name)  # mpi_ops.py:127-129
 
 
-def allreduce(tensor, average=True, device_dense="", device_sparse=""):
+from horovod.common import Compression  # noqa: E402 — horovod-API name
+
+
+def allreduce(tensor, average=True, device_dense="", device_sparse="",
+              compression=Compression.none):
     """Average (or sum) a tensor across ranks; `tf.IndexedSlices` takes
     the allgather path (reference `__init__.py:43-79`). The device_*
     arguments are accepted for API compatibility; placement belongs to
@@ -131,6 +135,18 @@ def allreduce(tensor, average=True, device_dense="", device_sparse=""):
         new_values = tf.divide(values, size()) if average else values
         return tf.IndexedSlices(new_values, indices,
                                 dense_shape=tensor.dense_shape)
+    if compression is not Compression.none:
+        name = "HorovodAllreduce_%s" % _norm_name(tensor)
+        dtype = _np_dtype(tensor)
+
+        def fn(t):
+            c, meta = compression.compress(t)
+            red = np.asarray(_hvd.allreduce(c, average=average))
+            return np.asarray(compression.decompress(red, meta), dtype)
+
+        out = _bridge(fn, tensor, name)
+        out.set_shape(tensor.shape)
+        return out
     summed = _allreduce(tensor)
     return tf.divide(summed, size()) if average else summed
 
@@ -164,12 +180,14 @@ class DistributedOptimizer(_tf1.train.Optimizer):
     across ranks before apply (reference `__init__.py:127-226`)."""
 
     def __init__(self, optimizer, name=None, use_locking=False,
-                 device_dense="", device_sparse=""):
+                 device_dense="", device_sparse="",
+                 compression=Compression.none):
         if name is None:
             name = "Distributed{}".format(type(optimizer).__name__)
         self._optimizer = optimizer
         self._device_dense = device_dense
         self._device_sparse = device_sparse
+        self._compression = compression
         super().__init__(name=name, use_locking=use_locking)
 
     def compute_gradients(self, *args, **kwargs):
@@ -180,7 +198,8 @@ class DistributedOptimizer(_tf1.train.Optimizer):
             return gradients
         return [(None if grad is None else allreduce(
                     grad, device_dense=self._device_dense,
-                    device_sparse=self._device_sparse), var)
+                    device_sparse=self._device_sparse,
+                    compression=self._compression), var)
                 for grad, var in gradients]
 
     # Everything else delegates to the wrapped optimizer

@@ -18,6 +18,7 @@ import numpy as np
 import torch
 
 import horovod_tpu as _hvd
+from horovod.common import Compression  # noqa: F401 — shared API
 
 
 def init():
@@ -120,8 +121,10 @@ class DistributedOptimizer(torch.optim.Optimizer):
     collective (`ops/fusion.py`), like the reference's fusion buffer."""
 
     def __init__(self, optimizer: torch.optim.Optimizer,
-                 named_parameters=None):
+                 named_parameters=None,
+                 compression=Compression.none):
         self._optimizer = optimizer
+        self._compression = compression
         self._names = {}
         if named_parameters is not None:
             self._names = {id(p): n for n, p in named_parameters}
@@ -149,9 +152,12 @@ class DistributedOptimizer(torch.optim.Optimizer):
                 for bucket in buckets:
                     flat = np.concatenate(
                         [grads[i].ravel() for i in bucket])
+                    flat, meta = self._compression.compress(flat)
                     red = np.asarray(_hvd.allreduce(
                         flat, average=True,
                         name=f"torch_grad_bucket_{bucket[0]}"))
+                    red = np.asarray(
+                        self._compression.decompress(red, meta))
                     off = 0
                     for i in bucket:
                         n = grads[i].size

@@ -231,3 +231,24 @@ class TestCompatRegressions:
     def test_broadcast_global_variables_eager_raises(self, hvd_keras):
         with pytest.raises(RuntimeError, match="Callback"):
             hvd_keras.broadcast_global_variables(0)
+
+
+class TestTFCompression:
+    def test_allreduce_fp16_session(self, hvd_tf):
+        from horovod.common import Compression
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, shape=(6,))
+            out = hvd_tf.allreduce(x, average=True,
+                                   compression=Compression.fp16)
+            with tf1.Session(graph=g) as sess:
+                val = np.linspace(-1, 1, 6).astype(np.float32)
+                o = sess.run(out, feed_dict={x: val})
+        np.testing.assert_allclose(o, val, atol=1e-3)
+
+    def test_distributed_optimizer_accepts_compression(self, hvd_tf):
+        from horovod.common import Compression
+        opt = hvd_tf.DistributedOptimizer(
+            tf1.train.GradientDescentOptimizer(0.1),
+            compression=Compression.fp16)
+        assert opt._compression is Compression.fp16

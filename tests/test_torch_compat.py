@@ -110,3 +110,35 @@ class TestTraining:
         opt.step()
         sched.step()
         assert opt.param_groups[0]["lr"] < 1e-3 + 1e-12
+
+
+class TestCompression:
+    def test_fp16_compression_roundtrip(self, hvd_torch):
+        from horovod.common import Compression
+        a = np.linspace(-2, 2, 16).astype(np.float32)
+        c, meta = Compression.fp16.compress(a)
+        assert c.dtype == np.float16 and meta == np.float32
+        back = Compression.fp16.decompress(c, meta)
+        assert back.dtype == np.float32
+        np.testing.assert_allclose(back, a, atol=1e-3)
+        # ints pass through untouched
+        i = np.arange(4, dtype=np.int32)
+        ci, mi = Compression.fp16.compress(i)
+        assert ci.dtype == np.int32
+
+    def test_optimizer_with_fp16_compression(self, hvd_torch):
+        from horovod.common import Compression
+        model = torch.nn.Linear(3, 1, bias=False)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            compression=Compression.fp16)
+        x = torch.randn(16, 3)
+        y = x @ torch.tensor([[1.0], [-2.0], [0.5]])
+        l0 = None
+        for _ in range(10):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            opt.step()
+            l0 = l0 if l0 is not None else float(loss)
+        assert float(loss) < l0
