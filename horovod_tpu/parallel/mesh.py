@@ -29,6 +29,13 @@ AXIS_MODEL = "model"
 AXIS_PIPE = "pipe"
 AXIS_EXPERT = "expert"
 
+# In a sharding constraint, None means "this dim is NOT sharded"
+# (replicated) while UNCONSTRAINED leaves the dim for the partitioner to
+# decide from context. Layers that only care about one dim (e.g. the
+# feature dim of a column-parallel matmul) must use UNCONSTRAINED for the
+# rest, or they force batch/seq replication — a hidden all-gather.
+UNCONSTRAINED = P.UNCONSTRAINED
+
 # Outer → inner device-grid order (inner = most ICI-local; see module doc).
 _CANONICAL_ORDER = (AXIS_PIPE, AXIS_DATA, AXIS_SEQ, AXIS_EXPERT, AXIS_MODEL)
 
@@ -161,8 +168,8 @@ def constrain(x, *spec):
         return x
 
     def keep(entry):
-        if entry is None:
-            return None
+        if entry is None or entry is P.UNCONSTRAINED:
+            return entry
         if isinstance(entry, (tuple, list)):
             kept = tuple(e for e in entry if e in names)
             return kept if kept else None

@@ -29,7 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import flax.linen as nn
 
 from horovod_tpu.parallel.mesh import (
-    AXIS_DATA, AXIS_MODEL, AXIS_SEQ, constrain,
+    AXIS_DATA, AXIS_MODEL, AXIS_SEQ, UNCONSTRAINED, constrain,
 )
 
 Dtype = Any
@@ -80,8 +80,12 @@ class ColumnParallelDense(nn.Module):
                 nn.with_partitioning(nn.initializers.zeros, (self.axis,)),
                 (self.features,), jnp.float32)
             y = y + jnp.asarray(bias, self.dtype)
-        # Pin the activation layout so GSPMD keeps the shard (no gather).
-        return constrain(y, *([None] * (y.ndim - 1) + [self.axis]))
+        # Pin only the feature dim; leading (batch/seq) dims stay
+        # UNCONSTRAINED so the partitioner keeps whatever data/seq/expert
+        # sharding the surrounding activations carry (None here would
+        # force them replicated — a hidden all-gather, and an involuntary
+        # full rematerialization in the backward pass).
+        return constrain(y, *([UNCONSTRAINED] * (y.ndim - 1) + [self.axis]))
 
 
 class RowParallelDense(nn.Module):
@@ -101,7 +105,10 @@ class RowParallelDense(nn.Module):
             nn.with_partitioning(self.kernel_init, (self.axis, None)),
             (x.shape[-1], self.features), jnp.float32)
         y = jnp.asarray(x, self.dtype) @ jnp.asarray(kernel, self.dtype)
-        y = constrain(y, *([None] * y.ndim))  # replicated ⇒ psum inserted
+        # Feature dim pinned unsharded ⇒ the partial products over the
+        # ``model``-sharded contraction are psum-reduced here; leading
+        # dims stay UNCONSTRAINED to preserve data/seq sharding.
+        y = constrain(y, *([UNCONSTRAINED] * (y.ndim - 1) + [None]))
         if self.use_bias:
             # Bias replicated: added once, after the reduction.
             bias = self.param("bias", nn.initializers.zeros,

@@ -1,0 +1,37 @@
+"""Worker: compile the flagship LM train step over multi-axis meshes and
+exit 0 — run by test_transformer.py in a subprocess so the XLA SPMD
+partitioner's stderr can be asserted clean (no "Involuntary full
+rematerialization", the replicate-then-repartition fallback that hides an
+all-gather in the hot path).
+
+Reuses the dryrun bodies from ``__graft_entry__`` so this test and the
+driver's multichip check always cover the same configurations.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def main():
+    devices = jax.devices()[:8]
+    for axes, attn, moe, spec in [
+        (dict(data=2, seq=2, model=2), "ring", 0, ("data", "seq")),
+        (dict(data=2, expert=2, model=2), "blockwise", 2, ("data", None)),
+    ]:
+        loss = graft._dryrun_lm(devices, axes, attn, moe, spec)
+        assert np.isfinite(loss)
+        print(f"SPMD_CLEAN_OK {attn} moe={moe} loss={loss:.4f}")
+
+
+if __name__ == "__main__":
+    main()

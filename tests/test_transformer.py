@@ -266,3 +266,32 @@ class TestPipelineTransformer:
                 mesh, stage_fn, sp, mb))(stacked, x)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5)
+
+
+class TestSPMDCleanCompile:
+    """The multi-axis train step must compile without GSPMD's
+    replicate-then-repartition fallback ("Involuntary full
+    rematerialization" in the partitioner log) — the hidden all-gather
+    that destroys scaling (VERDICT r1 weak #1). Runs in a subprocess so
+    the C++ glog stderr can be captured."""
+
+    def test_no_involuntary_rematerialization(self):
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # worker sets its own device count
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        # The grep below is vacuous if W-level C++ logs are suppressed.
+        env["TF_CPP_MIN_LOG_LEVEL"] = "0"
+        res = subprocess.run(
+            [sys.executable, "tests/spmd_clean_worker.py"],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=420)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert res.stdout.count("SPMD_CLEAN_OK") == 2, res.stdout
+        assert "Involuntary full rematerialization" not in res.stderr, (
+            "\n".join(l for l in res.stderr.splitlines()
+                      if "Involuntary" in l))
