@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# CI entry point — the analogue of the reference's .travis.yml script
+# section: run the full test suite, then smoke-run two examples under
+# the launcher at np=2 (the reference runs tensorflow_mnist.py and a
+# shrunk keras_mnist_advanced.py under `mpirun -np 2`).
+set -euxo pipefail
+cd "$(dirname "$0")"
+
+JAX_PLATFORMS=cpu python -m pytest tests/ -q
+
+python -m horovod_tpu.runner -np 2 --platform cpu -- \
+    python examples/jax_mnist.py --steps 20
+
+python -m horovod_tpu.runner -np 2 --platform cpu -- \
+    python examples/jax_mnist_advanced.py --epochs 1
+
+echo "CI OK"

@@ -63,9 +63,8 @@ def main():
         drop_remainder=True)
     # Ranks may own different record counts when shards don't divide
     # evenly; every step issues collectives, so all ranks must run the
-    # same number — take the global minimum.
-    steps_per_epoch = int(np.min(np.asarray(
-        hvd.allgather(np.asarray([ds.steps_per_epoch()])))))
+    # same number — the global minimum, computed by the dataset.
+    steps_per_epoch = ds.global_steps_per_epoch()
 
     # LRWarmupCallback parity: warm from lr to size*lr over 2 epochs.
     schedule = lr_warmup_schedule(0.01, warmup_epochs=2,

@@ -123,6 +123,15 @@ def _norm_name(tensor) -> str:
 from horovod.common import Compression  # noqa: E402 — horovod-API name
 
 
+def _div_by_size(t):
+    """Divide preserving dtype: the reference's `tf.div` keeps integer
+    dtypes integer (reference `__init__.py:43-79`); `tf.divide` would
+    silently promote them to float."""
+    if t.dtype.is_integer:
+        return tf.math.floordiv(t, size())
+    return tf.divide(t, size())
+
+
 def allreduce(tensor, average=True, device_dense="", device_sparse="",
               compression=Compression.none):
     """Average (or sum) a tensor across ranks; `tf.IndexedSlices` takes
@@ -132,7 +141,7 @@ def allreduce(tensor, average=True, device_dense="", device_sparse="",
     if isinstance(tensor, tf.IndexedSlices):
         values = allgather(tensor.values)
         indices = allgather(tensor.indices)
-        new_values = tf.divide(values, size()) if average else values
+        new_values = _div_by_size(values) if average else values
         return tf.IndexedSlices(new_values, indices,
                                 dense_shape=tensor.dense_shape)
     if compression is not Compression.none:
@@ -148,7 +157,7 @@ def allreduce(tensor, average=True, device_dense="", device_sparse="",
         out.set_shape(tensor.shape)
         return out
     summed = _allreduce(tensor)
-    return tf.divide(summed, size()) if average else summed
+    return _div_by_size(summed) if average else summed
 
 
 def broadcast_global_variables(root_rank):

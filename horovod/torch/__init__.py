@@ -101,94 +101,139 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
 
 def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
                               root_rank: int = 0) -> None:
-    """Broadcast optimizer state tensors (momentum buffers etc.)."""
+    """Broadcast optimizer state (momentum buffers etc.) from root.
+
+    Root first broadcasts the *structure* of its state (which params
+    have which keys, tensor shapes/dtypes, scalar values), and other
+    ranks materialize any missing buffers before the tensor broadcasts
+    begin: after resume-from-checkpoint the state typically exists only
+    on root, and iterating each rank's own (empty) state would make the
+    ranks run different collective sequences and hang.
+    """
+    spec = None
+    if rank() == root_rank:
+        spec = []
+        for gi, group in enumerate(optimizer.param_groups):
+            for pi, p in enumerate(group["params"]):
+                state = optimizer.state.get(p, {})
+                entry = []
+                for key in sorted(state, key=str):
+                    val = state[key]
+                    if isinstance(val, torch.Tensor):
+                        entry.append((key, "tensor", tuple(val.shape),
+                                      str(val.dtype)))
+                    else:
+                        entry.append((key, "value", val))
+                if entry:
+                    spec.append(((gi, pi), entry))
+    spec = _hvd.broadcast_object(spec, root_rank)
+
+    by_index = {}
     for gi, group in enumerate(optimizer.param_groups):
         for pi, p in enumerate(group["params"]):
-            state = optimizer.state.get(p, {})
-            for key in sorted(state):
-                val = state[key]
-                if isinstance(val, torch.Tensor):
-                    broadcast_(val, root_rank,
-                               name=f"opt_{gi}_{pi}_{key}")
+            by_index[(gi, pi)] = p
+    for (gi, pi), entry in spec:
+        p = by_index[(gi, pi)]
+        state = optimizer.state[p]
+        for item in entry:
+            if item[1] == "tensor":
+                key, _, shape, dtype_name = item
+                dtype = getattr(torch, dtype_name.replace("torch.", ""))
+                val = state.get(key)
+                if (not isinstance(val, torch.Tensor)
+                        or tuple(val.shape) != shape
+                        or val.dtype != dtype):
+                    val = torch.zeros(shape, dtype=dtype,
+                                      device=p.device)
+                    state[key] = val
+                broadcast_(val, root_rank, name=f"opt_{gi}_{pi}_{key}")
+            else:
+                key, _, val = item
+                state[key] = val
 
 
-class DistributedOptimizer(torch.optim.Optimizer):
-    """Wraps a torch optimizer: every `step()` first allreduce-averages
-    each parameter's `.grad` across ranks — the torch analogue of the
-    reference's compute_gradients override
-    (`horovod/tensorflow/__init__.py:164-186`). Fusion-bucketed: grads
-    are packed same-dtype up to HOROVOD_FUSION_THRESHOLD bytes per
-    collective (`ops/fusion.py`), like the reference's fusion buffer."""
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Method bodies grafted by the `DistributedOptimizer` factory onto
+    a dynamic subclass of the wrapped optimizer's class — the same
+    trick as the keras adapter (`horovod/keras/__init__.py`, reference
+    keras `__init__.py:81-87`). Subclassing Optimizer here keeps
+    `__dict__`/`__weakref__` descriptors out of this class's namespace
+    so the dict copy below stays clean."""
 
-    def __init__(self, optimizer: torch.optim.Optimizer,
-                 named_parameters=None,
+    def __init__(self, params, named_parameters=None,
                  compression=Compression.none):
-        self._optimizer = optimizer
+        # Parent here is the user's optimizer class (e.g. SGD): its
+        # __init__ fills `defaults` and the step-hook registries, and
+        # per-group options ride in the param_group dicts.
+        super(self.__class__, self).__init__(params)
         self._compression = compression
         self._names = {}
         if named_parameters is not None:
             self._names = {id(p): n for n, p in named_parameters}
 
-    # -- gradient averaging ------------------------------------------------
-    def _averaged_grads(self):
+    def _allreduce_grads(self):
+        """Average every `.grad` across ranks, fusion-bucketed
+        same-dtype up to HOROVOD_FUSION_THRESHOLD bytes per collective
+        (`ops/fusion.py`), like the reference's fusion buffer."""
         grads, params = [], []
-        for group in self._optimizer.param_groups:
+        for group in self.param_groups:
             for p in group["params"]:
                 if p.grad is not None:
                     grads.append(_to_np(p.grad))
                     params.append(p)
-        return params, grads
+        if not grads:
+            return
+        from horovod_tpu.ops.fusion import plan_buckets
+        for bucket in plan_buckets(grads):
+            flat = np.concatenate([grads[i].ravel() for i in bucket])
+            flat, meta = self._compression.compress(flat)
+            red = np.asarray(_hvd.allreduce(
+                flat, average=True,
+                name=f"torch_grad_bucket_{bucket[0]}"))
+            red = np.asarray(self._compression.decompress(red, meta))
+            off = 0
+            for i in bucket:
+                n = grads[i].size
+                with torch.no_grad():
+                    params[i].grad.copy_(_like(
+                        red[off:off + n].reshape(grads[i].shape),
+                        params[i].grad))
+                off += n
 
     def step(self, closure=None):
         loss = None
         if closure is not None:
+            # Evaluate BEFORE the allreduce so the grads the closure
+            # produces are what gets averaged.
             with torch.enable_grad():
                 loss = closure()
         if _hvd.size() > 1:
-            params, grads = self._averaged_grads()
-            if grads:
-                from horovod_tpu.ops.fusion import plan_buckets
-                buckets = plan_buckets(grads)
-                for bucket in buckets:
-                    flat = np.concatenate(
-                        [grads[i].ravel() for i in bucket])
-                    flat, meta = self._compression.compress(flat)
-                    red = np.asarray(_hvd.allreduce(
-                        flat, average=True,
-                        name=f"torch_grad_bucket_{bucket[0]}"))
-                    red = np.asarray(
-                        self._compression.decompress(red, meta))
-                    off = 0
-                    for i in bucket:
-                        n = grads[i].size
-                        with torch.no_grad():
-                            params[i].grad.copy_(_like(
-                                red[off:off + n].reshape(
-                                    grads[i].shape), params[i].grad))
-                        off += n
-        self._optimizer.step()
+            self._allreduce_grads()
+        super(self.__class__, self).step()
         return loss
 
-    # -- delegation --------------------------------------------------------
-    def zero_grad(self, set_to_none: bool = True):
-        return self._optimizer.zero_grad(set_to_none=set_to_none)
 
-    @property
-    def param_groups(self):
-        return self._optimizer.param_groups
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none):
+    """Distributed step: every `step()` first allreduce-averages each
+    parameter's `.grad` across ranks — the torch analogue of the
+    reference's compute_gradients override
+    (`horovod/tensorflow/__init__.py:164-186`).
 
-    @property
-    def state(self):
-        return self._optimizer.state
-
-    def state_dict(self):
-        return self._optimizer.state_dict()
-
-    def load_state_dict(self, sd):
-        return self._optimizer.load_state_dict(sd)
-
-    def add_param_group(self, group):
-        return self._optimizer.add_param_group(group)
-
-    def __repr__(self):
-        return f"Distributed{self._optimizer!r}"
+    Returns an instance of a dynamically created subclass of the
+    wrapped optimizer's class, so `isinstance` checks (torch LR
+    schedulers demand a real `torch.optim.Optimizer`) and checkpoint
+    restore without horovod keep working. It shares the original's
+    param_group dicts but starts with fresh state — construct it before
+    training, or `broadcast_optimizer_state` after a restore.
+    """
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    dist = cls(optimizer.param_groups, named_parameters, compression)
+    # The grafted __init__ ran the parent's __init__ without the user's
+    # constructor kwargs, so `defaults` holds class defaults; restore
+    # the original's so a later add_param_group inherits the user's
+    # hyperparameters, not the class's.
+    dist.defaults = dict(optimizer.defaults)
+    return dist

@@ -218,6 +218,7 @@ class ShardedDataset:
         self.spec = list(spec)
         self._rb = record_bytes(spec)
         self.batch_size = batch_size
+        self.drop_remainder = drop_remainder
         impl = None
         if config.use_native:
             try:
@@ -250,7 +251,21 @@ class ShardedDataset:
         return self._impl.num_records()
 
     def steps_per_epoch(self) -> int:
-        return self.num_records() // self.batch_size
+        """Batches `epoch()` yields for THIS rank: includes the final
+        partial batch unless drop_remainder. Ranks can differ when
+        shards divide unevenly — multi-rank training loops must
+        truncate to the minimum across ranks (`global_steps_per_epoch`)
+        or the ranks deadlock in the step's collectives."""
+        n, b = self.num_records(), self.batch_size
+        return n // b if self.drop_remainder else -(-n // b)
+
+    def global_steps_per_epoch(self) -> int:
+        """min over ranks of steps_per_epoch — the step count every
+        rank can run in lockstep (the allgather-min the advanced
+        example previously open-coded). Requires hvd.init()."""
+        import horovod_tpu as hvd
+        return int(np.min(np.asarray(hvd.allgather(
+            np.asarray([self.steps_per_epoch()])))))
 
     def epoch(self, epoch_idx: int = 0):
         """Iterate one epoch of batches as {field: array} dicts."""

@@ -55,7 +55,9 @@ struct Loader {
   std::condition_variable not_full;
   std::deque<Batch> queue;
   bool epoch_done = false;          // producer finished current epoch
-  bool abort_epoch = false;         // unblock+stop producer early
+  // Atomic: written in hvd_dl_start_epoch under the mutex but read
+  // lock-free from the producer's hot loop via Stopping().
+  std::atomic<bool> abort_epoch{false};
   std::atomic<bool> closed{false};
   std::thread producer;
   std::string error;
@@ -69,7 +71,7 @@ struct Loader {
   }
 
   bool Stopping() const {
-    return closed.load() || abort_epoch;
+    return closed.load() || abort_epoch.load();
   }
 
   ~Loader() {

@@ -83,6 +83,28 @@ def main():
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
 
+    # torch adapter: broadcast_optimizer_state when state exists ONLY on
+    # root (the resume-from-checkpoint case) — non-root must materialize
+    # buffers from root's broadcast structure instead of skipping the
+    # collectives, or the ranks run mismatched collective sequences.
+    import torch
+
+    import horovod.torch as hvd_torch
+    model = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        model.weight.fill_(float(r))
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    if r == 0:
+        model(torch.ones(1, 2)).sum().backward()
+        opt.step()  # populates momentum_buffer on root only
+        opt.zero_grad()
+    hvd_torch.broadcast_optimizer_state(opt, 0)
+    st = opt.state[model.weight]
+    assert "momentum_buffer" in st, list(st)
+    root_buf = np.asarray(hvd.broadcast(
+        st["momentum_buffer"].numpy(), 0))
+    np.testing.assert_allclose(st["momentum_buffer"].numpy(), root_buf)
+
     hvd.shutdown()
     print(f"MC_OK rank={r}")
 

@@ -94,12 +94,33 @@ class TestShardedDataset:
         paths, _ = shards
         with hd.ShardedDataset(paths, SPEC, batch_size=24,
                                rank=0, world=1) as ds:
+            assert ds.steps_per_epoch() == 3  # counts the partial batch
             sizes = [len(b["label"]) for b in ds.epoch(0)]
         assert sizes == [24, 24, 16]
         with hd.ShardedDataset(paths, SPEC, batch_size=24, rank=0,
                                world=1, drop_remainder=True) as ds:
+            assert ds.steps_per_epoch() == 2
             sizes = [len(b["label"]) for b in ds.epoch(0)]
         assert sizes == [24, 24]
+
+    def test_steps_per_epoch_matches_yielded(self, shards):
+        """steps_per_epoch must equal len(list(epoch())) for every
+        (batch_size, drop_remainder) combination — the loop-count
+        contract multi-rank truncation builds on."""
+        paths, _ = shards
+        for bs in (7, 8, 24, 64, 100):
+            for drop in (False, True):
+                with hd.ShardedDataset(paths, SPEC, batch_size=bs,
+                                       rank=0, world=1,
+                                       drop_remainder=drop) as ds:
+                    n = sum(1 for _ in ds.epoch(0))
+                    assert ds.steps_per_epoch() == n, (bs, drop, n)
+
+    def test_global_steps_per_epoch(self, shards, hvd):
+        paths, _ = shards
+        with hd.ShardedDataset(paths, SPEC, batch_size=24,
+                               rank=0, world=1) as ds:
+            assert ds.global_steps_per_epoch() == ds.steps_per_epoch()
 
     def test_multiple_epochs_reusable(self, shards):
         paths, _ = shards

@@ -55,6 +55,17 @@ class TestTFCollectives:
         np.testing.assert_allclose(np.asarray(out), [1.0, 2.0],
                                    rtol=1e-6)
 
+    def test_allreduce_integer_keeps_dtype(self, hvd_tf):
+        """The reference's tf.div keeps integer allreduce integer
+        (reference __init__.py:43-79); tf.divide would promote to
+        float. average=True on ints must floor-divide."""
+        val = tf.constant([8, 16, 24], tf.int32)
+        avg = hvd_tf.allreduce(val, average=True)
+        assert avg.dtype == tf.int32
+        np.testing.assert_array_equal(np.asarray(avg), [8, 16, 24])
+        total = hvd_tf.allreduce(val, average=False)
+        assert total.dtype == tf.int32
+
     def test_allgather_session(self, hvd_tf):
         g = tf1.Graph()
         with g.as_default():

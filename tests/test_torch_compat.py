@@ -74,7 +74,7 @@ class TestTraining:
             opt.step()
             losses.append(float(loss))
         assert losses[-1] < 0.05 * losses[0], losses
-        hvd_torch.broadcast_optimizer_state(opt._optimizer, 0)
+        hvd_torch.broadcast_optimizer_state(opt, 0)
 
     def test_wrapped_step_matches_unwrapped(self, hvd_torch):
         """With replicated inputs the grad-average is the identity, so
@@ -96,20 +96,67 @@ class TestTraining:
         np.testing.assert_allclose(one_step(True), one_step(False),
                                    rtol=1e-6)
 
+    def test_optimizer_defaults_and_step_hooks(self, hvd_torch):
+        """Attributes the base Optimizer init provides (defaults, step
+        hook registries) must work on the distributed optimizer."""
+        model = torch.nn.Linear(2, 1)
+        inner = torch.optim.SGD(model.parameters(), lr=0.3)
+        opt = hvd_torch.DistributedOptimizer(inner)
+        assert opt.param_groups[0]["lr"] == 0.3
+        assert opt.defaults["lr"] == 0.3  # user's, not the class's
+        # groups added later inherit the user's hyperparameters
+        extra = torch.nn.Linear(2, 1)
+        opt.add_param_group({"params": list(extra.parameters())})
+        assert opt.param_groups[1]["lr"] == 0.3
+        calls = []
+        opt.register_step_pre_hook(lambda *a, **k: calls.append(1))
+        model(torch.randn(4, 2)).sum().backward()
+        opt.step()
+        # >= 1: the distributed step delegates to the parent's (also
+        # hook-wrapped) step, so hooks may observe both layers.
+        assert len(calls) >= 1
+
+    def test_optimizer_isinstance_and_scheduler(self, hvd_torch):
+        """LR schedulers type-check their optimizer; the distributed
+        optimizer must BE a torch.optim.Optimizer (and the wrapped
+        class) so `StepLR(hvd.DistributedOptimizer(sgd))` — the
+        standard Horovod idiom — works directly."""
+        model = torch.nn.Linear(2, 1)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.4))
+        assert isinstance(opt, torch.optim.Optimizer)
+        assert isinstance(opt, torch.optim.SGD)
+        assert type(opt).__name__ == "SGD"  # checkpoints restore clean
+        sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1,
+                                                gamma=0.5)
+        model(torch.randn(4, 2)).sum().backward()
+        opt.step()
+        sched.step()
+        assert abs(opt.param_groups[0]["lr"] - 0.2) < 1e-12
+
+    def test_broadcast_optimizer_state_materializes(self, hvd_torch):
+        model = torch.nn.Linear(2, 1, bias=False)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        model(torch.ones(1, 2)).sum().backward()
+        opt.step()
+        before = opt.state[model.weight]["momentum_buffer"].clone()
+        hvd_torch.broadcast_optimizer_state(opt, 0)
+        after = opt.state[model.weight]["momentum_buffer"]
+        np.testing.assert_allclose(after.numpy(), before.numpy())
+
     def test_optimizer_delegation(self, hvd_torch):
         model = torch.nn.Linear(2, 1)
         inner = torch.optim.Adam(model.parameters(), lr=1e-3)
         opt = hvd_torch.DistributedOptimizer(inner)
-        assert opt.param_groups is inner.param_groups
+        # Shares the original's group dicts (not a copy): external code
+        # holding the inner optimizer sees LR changes and vice versa.
+        assert opt.param_groups[0] is inner.param_groups[0]
         sd = opt.state_dict()
         opt.load_state_dict(sd)
-        # LR schedulers operate on param_groups through the wrapper.
-        sched = torch.optim.lr_scheduler.StepLR(inner, step_size=1)
         x = torch.randn(4, 2)
         model(x).sum().backward()
         opt.step()
-        sched.step()
-        assert opt.param_groups[0]["lr"] < 1e-3 + 1e-12
+        assert opt.state_dict()["state"], "Adam state after step"
 
 
 class TestCompression:
