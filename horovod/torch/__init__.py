@@ -160,12 +160,13 @@ class _DistributedOptimizer(torch.optim.Optimizer):
     `__dict__`/`__weakref__` descriptors out of this class's namespace
     so the dict copy below stays clean."""
 
-    def __init__(self, params, named_parameters=None,
+    def __init__(self, params, defaults, named_parameters=None,
                  compression=Compression.none):
-        # Parent here is the user's optimizer class (e.g. SGD): its
-        # __init__ fills `defaults` and the step-hook registries, and
-        # per-group options ride in the param_group dicts.
-        super(self.__class__, self).__init__(params)
+        # Base Optimizer.__init__ directly (NOT the user class's, whose
+        # required ctor args we can't reconstruct): it registers the
+        # already-built param_groups, sets `defaults` to the original
+        # optimizer's, and fills the step-hook registries.
+        torch.optim.Optimizer.__init__(self, params, dict(defaults))
         self._compression = compression
         self._names = {}
         if named_parameters is not None:
@@ -230,10 +231,5 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     """
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
-    dist = cls(optimizer.param_groups, named_parameters, compression)
-    # The grafted __init__ ran the parent's __init__ without the user's
-    # constructor kwargs, so `defaults` holds class defaults; restore
-    # the original's so a later add_param_group inherits the user's
-    # hyperparameters, not the class's.
-    dist.defaults = dict(optimizer.defaults)
-    return dist
+    return cls(optimizer.param_groups, optimizer.defaults,
+               named_parameters, compression)

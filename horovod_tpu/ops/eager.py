@@ -91,13 +91,21 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
     """Per-op metadata negotiation over the launcher's rendezvous server.
 
     The runtime equivalent of the reference's coordinator protocol
-    (SURVEY §3.2 right half): every process posts its request
-    (name/op/dtype/shape/root) to the KV store, reads all peers', and
-    validates — the same checks `ConstructMPIResponse` runs on rank 0
-    (`mpi_ops.cc:266-474`), executed symmetrically so every process
-    raises the same error instead of hanging. Returns per-process metas.
+    (SURVEY §3.2 right half), with the reference's topology: every
+    process posts its request (name/op/dtype/shape/root) once; process
+    0 gathers all N, validates them — the checks `ConstructMPIResponse`
+    runs on rank 0 (`mpi_ops.cc:266-474`) — and publishes ONE response
+    that every other process reads (the coordinator's response
+    broadcast, `mpi_ops.cc:1421-1427`). Non-coordinator traffic per op
+    is therefore 2 round-trips (1 write + 1 read) independent of world
+    size; the earlier all-read-all design cost N reads on each of N
+    processes against one TCP server. Validation failures are published
+    in the response so every process raises the same error instead of
+    hanging. Returns the per-process metas.
     """
     import json
+    from horovod_tpu.ops.validation import (CollectiveMismatchError,
+                                            validate_requests)
     if st.native is None:
         raise RuntimeError("multi-process eager collectives require the "
                            "native control plane")
@@ -118,35 +126,69 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
         raise RuntimeError(
             f"failed to post negotiation request for {opname} — "
             f"rendezvous connection lost")
+    resp_key = f"resp/{opname}/{cnt}"
+
+    if st.process_rank != 0:
+        # The coordinator's sequential gather may legitimately take up
+        # to N sequential per-peer waits when ranks arrive staggered,
+        # so the response wait scales with world size.
+        v = st.native.kv_get(resp_key,
+                             timeout_ms=60000 * st.num_processes)
+        if v is None:
+            raise RuntimeError(
+                f"negotiation timeout for {opname}: no response from "
+                f"the coordinator (see stall warnings)")
+        resp = json.loads(v.decode())
+        if resp["status"] != "ok":
+            if resp.get("kind") == "CollectiveMismatchError":
+                raise CollectiveMismatchError(resp["error"])
+            raise RuntimeError(resp["error"])
+        return resp["metas"]
+
+    # Coordinator: gather, validate, publish.
+    def publish_error(exc):
+        st.native.kv_set(resp_key, json.dumps(
+            {"status": "error", "kind": type(exc).__name__,
+             "error": str(exc)}).encode())
+
     metas = []
     for r in range(st.num_processes):
         v = st.native.kv_get(f"req/{opname}/{cnt}/{r}", timeout_ms=60000)
         if v is None:
-            raise RuntimeError(
+            exc = RuntimeError(
                 f"negotiation timeout for {opname}: process {r} never "
                 f"submitted a request (see stall warnings)")
+            publish_error(exc)
+            raise exc
         metas.append(json.loads(v.decode()))
-    # Uniform-ownership check on the *exchanged* counts so every process
-    # raises symmetrically (a local-only check would let the conforming
-    # process proceed into the collective and hang waiting for peers).
+    # Uniform-ownership check on the *exchanged* counts: uneven device
+    # ownership would make the duplication corrections in the mc
+    # kernels silently wrong.
     ndevs = [m.get("ndev") for m in metas]
     if None not in ndevs and (
             len(set(ndevs)) > 1
             or ndevs[0] * st.num_processes != st.size):
-        raise RuntimeError(
-            f"multi-process collectives require every process to own the "
-            f"same number of devices; per-process counts {ndevs} over "
-            f"world size {st.size}")
-    from horovod_tpu.ops.validation import validate_requests
-    validate_requests(
-        name=opname, op=op,
-        ops=[m["op"] for m in metas],
-        dtypes=[m["dtype"] for m in metas],
-        shapes=[tuple(m["shape"]) for m in metas],
-        root_ranks=([m["root"] for m in metas]
-                    if root_rank is not None else None),
-        allow_dim0_mismatch=allow_dim0,
-        native=st.native)
+        exc = RuntimeError(
+            f"multi-process collectives require every process to own "
+            f"the same number of devices; per-process counts {ndevs} "
+            f"over world size {st.size}")
+        publish_error(exc)
+        raise exc
+    try:
+        validate_requests(
+            name=opname, op=op,
+            ops=[m["op"] for m in metas],
+            dtypes=[m["dtype"] for m in metas],
+            shapes=[tuple(m["shape"]) for m in metas],
+            root_ranks=([m["root"] for m in metas]
+                        if root_rank is not None else None),
+            allow_dim0_mismatch=allow_dim0,
+            native=st.native)
+    except Exception as exc:
+        publish_error(exc)
+        raise
+    st.native.kv_set(resp_key, json.dumps(
+        {"status": "ok", "metas": metas}).encode())
     return metas
 
 

@@ -1,16 +1,36 @@
-"""Pipeline parallelism: microbatched GPipe schedule over the ``pipe`` axis.
+"""Pipeline parallelism: microbatched GPipe + interleaved schedules over
+the ``pipe`` axis.
 
 No reference equivalent (SURVEY §2.3 "PP: NO"). TPU-native design: every
 pipeline rank runs the SAME program (SPMD — XLA requires identical HLO on
 all devices), holding its own stage's weights; activations hand off to the
 next stage with a single-hop `lax.ppermute` each tick, which on a real
-slice is a neighbor transfer over ICI. The schedule is the classic GPipe
-fill-run-drain loop expressed as `lax.scan` (M + P - 1 ticks for M
-microbatches over P stages), so `jax.grad` through it yields the reversed
-drain-run-fill backward pipeline for free — no hand-written 1F1B state
-machine, the compiler schedules both directions.
+slice is a neighbor transfer over ICI. The schedule is expressed as
+`lax.scan`, so `jax.grad` through it yields the reversed backward
+pipeline for free — no hand-written backward state machine, the compiler
+schedules both directions.
 
-Bubble fraction is (P-1)/(M+P-1); pick M >= 4·P for >80 % utilization.
+Two schedules, selected by ``num_chunks`` (v):
+
+* v = 1 — classic GPipe fill-run-drain: M + P - 1 ticks for M
+  microbatches over P stages; bubble fraction (P-1)/(M+P-1). Pick
+  M >= 4·P for >80 % utilization.
+* v > 1 — interleaved ("circular" / Megatron interleaved-1F1B
+  placement): the layer stack is cut into S = v·P chunks and global
+  chunk s lives on device s mod P, so each microbatch circles the ring
+  v times. A tick now advances one *chunk* (1/v of the old stage work),
+  and the fill/drain cost is P-1 chunk-ticks instead of P-1
+  stage-ticks: bubble fraction (P-1)/(v·M + P - 1) — v× smaller than
+  GPipe for the same M. The schedule is chosen so every activation
+  produced at tick t is consumed by the ring neighbor at tick t+1
+  (device d, work-item k = t - d runs chunk (k % (v·P)) // P of
+  microbatch (k // (v·P))·P + k % P), which keeps the SPMD program a
+  single-slot relay — interleaving costs no activation buffering.
+  Requires M % P == 0 (microbatches are pumped in groups of P).
+
+The trade: v× more ppermute hops of the same total payload, one ring
+lap per chunk — on ICI these are neighbor transfers overlapped with
+compute, cheap relative to the bubble saved.
 """
 
 from __future__ import annotations
@@ -37,47 +57,83 @@ def _axis_size(axis_name: str) -> int:
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
                    stage_params: Any,
                    microbatches: jax.Array,
-                   *, axis_name: str = AXIS_PIPE) -> jax.Array:
-    """Run `microbatches` through the P-stage pipeline (SPMD; in shard_map).
+                   *, axis_name: str = AXIS_PIPE,
+                   num_chunks: int = 1) -> jax.Array:
+    """Run `microbatches` through the pipeline (SPMD; call in shard_map).
 
     Args:
-      stage_fn: `(params, x) -> y` applied by every stage to its resident
-        microbatch each tick; `y` must have `x`'s shape/dtype.
-      stage_params: THIS rank's stage weights (leading stage dim already
-        stripped by the shard_map in-spec).
+      stage_fn: `(params, x) -> y` applied to the resident microbatch
+        each tick; `y` must have `x`'s shape/dtype.
+      stage_params: THIS rank's weights (leading stage dim already
+        stripped by the shard_map in-spec). With ``num_chunks`` = v > 1,
+        every leaf carries a leading chunk dim [v, ...] where chunk c is
+        this device's slice of global stage c·P + d (see
+        `PipelineStage.stack_interleaved`).
       microbatches: [M, mb, ...] — the full microbatch stack, replicated
         across the ``pipe`` axis (only stage 0 reads it).
+      num_chunks: chunks per device (v). 1 = GPipe; >1 = interleaved
+        schedule with a v× smaller pipeline bubble (module docstring).
 
     Returns:
       [M, mb, ...] final-stage outputs, replicated across ``pipe``.
     """
     nstages = _axis_size(axis_name)
+    v = int(num_chunks)
     idx = lax.axis_index(axis_name)
     M = microbatches.shape[0]
-    ticks = M + nstages - 1
+    if v < 1:
+        raise ValueError(f"num_chunks must be >= 1, got {v}")
+    if v > 1 and M % nstages:
+        raise ValueError(
+            f"interleaved schedule needs microbatches % pipe == 0 "
+            f"(got M={M}, P={nstages}); pad the microbatch stack")
+    ticks = v * M + nstages - 1
     fwd = [(i, (i + 1) % nstages) for i in range(nstages)]
+    group = v * nstages  # work-items per P-microbatch group
 
     def tick(carry, t):
         state, outputs = carry
-        # Stage 0 consumes microbatch t (clamped; invalid ticks produce
-        # garbage that is never written — see validity algebra below).
+        # This device's work-item counter; within/group decompose it
+        # into (chunk, microbatch) per the relay schedule above.
+        k = t - idx
+        within = k % group          # non-negative (python semantics)
+        g = k // group              # microbatch group (floor for k<0)
+        c = within // nstages       # chunk this tick runs, in [0, v)
+        m_feed = g * nstages + (within % nstages)
+        # Stage 0 consumes a fresh microbatch only on chunk-0 items
+        # (clamped; invalid ticks produce garbage that is never
+        # written — see validity algebra below).
         feed = lax.dynamic_index_in_dim(
-            microbatches, jnp.clip(t, 0, M - 1), axis=0, keepdims=False)
-        x = jnp.where(idx == 0, feed, state)
-        y = stage_fn(stage_params, x)
-        # Stage s at tick t holds microbatch (t - s); the last stage's
-        # result is valid when 0 <= t - (P-1) < M. A microbatch that is
-        # invalid at stage s stays invalid at s+1, tick t+1, so garbage
-        # can never reach the output buffer.
-        out_ix = t - (nstages - 1)
-        valid = jnp.logical_and(idx == nstages - 1,
-                                jnp.logical_and(out_ix >= 0, out_ix < M))
-        slot = jnp.clip(out_ix, 0, M - 1)
+            microbatches, jnp.clip(m_feed, 0, M - 1), axis=0,
+            keepdims=False)
+        take_feed = jnp.logical_and(
+            idx == 0, jnp.logical_and(c == 0, jnp.logical_and(
+                m_feed >= 0, m_feed < M)))
+        x = jnp.where(take_feed, feed, state)
+        if v == 1:
+            params_c = stage_params
+        else:
+            params_c = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(
+                    a, c, axis=0, keepdims=False), stage_params)
+        y = stage_fn(params_c, x)
+        # The finished microbatch m_out leaves the pipeline at the last
+        # device's last chunk. A microbatch invalid at chunk (c, d)
+        # stays invalid at the next hop, so garbage can never reach the
+        # output buffer.
+        m_out = g * nstages + (within - (v - 1) * nstages)
+        valid = jnp.logical_and(
+            jnp.logical_and(idx == nstages - 1, c == v - 1),
+            jnp.logical_and(m_out >= 0, m_out < M))
+        slot = jnp.clip(m_out, 0, M - 1)
         cur = lax.dynamic_index_in_dim(outputs, slot, axis=0,
                                        keepdims=False)
         outputs = lax.dynamic_update_index_in_dim(
             outputs, jnp.where(valid, y, cur), slot, axis=0)
-        # Hand the activation to the next stage (single ICI hop).
+        # Hand the activation to the next stage (single ICI hop). The
+        # schedule guarantees the receiver consumes it next tick:
+        # device d<P-1 continues chunk c; the wrap P-1 -> 0 enters
+        # chunk c+1 with the same work-item phase.
         state = lax.ppermute(y, axis_name, fwd)
         return (state, outputs), None
 
@@ -94,12 +150,16 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
 
 
 def pipeline_apply_gspmd(mesh, stage_fn, stacked_params, microbatches,
-                         *, data_sharded: bool = True) -> jax.Array:
+                         *, data_sharded: bool = True,
+                         num_chunks: int = 1) -> jax.Array:
     """`pipeline_apply` as a shard_map region inside a pjit'ed step.
 
     `stacked_params`: pytree whose leaves have leading dim P (one slice
-    per stage), sharded over ``pipe`` by the in-spec; each rank sees its
-    slice with leading dim 1, squeezed before `stage_fn`.
+    per stage; `PipelineStage.stack`), sharded over ``pipe`` by the
+    in-spec; each rank sees its slice with leading dim 1, squeezed
+    before `stage_fn`. With ``num_chunks`` = v > 1, leaves are [P, v,
+    ...] (`PipelineStage.stack_interleaved`) and each rank keeps its
+    [v, ...] chunk stack.
     `microbatches`: [M, mb, ...], batch dim sharded over ``data`` when
     `data_sharded` (each data-parallel group runs its own pipeline).
     """
@@ -108,7 +168,8 @@ def pipeline_apply_gspmd(mesh, stage_fn, stacked_params, microbatches,
 
     def body(params, x):
         local = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)
-        return pipeline_apply(stage_fn, local, x)
+        return pipeline_apply(stage_fn, local, x,
+                              num_chunks=num_chunks)
 
     return jax.shard_map(
         body, mesh=mesh,
@@ -118,14 +179,31 @@ def pipeline_apply_gspmd(mesh, stage_fn, stacked_params, microbatches,
 
 
 class PipelineStage:
-    """Stack per-stage parameter pytrees into the [P, ...] layout
+    """Stack per-stage parameter pytrees into the layouts
     `pipeline_apply_gspmd` expects."""
 
     @staticmethod
     def stack(per_stage_params):
+        """[S] list (global stage order) -> leaves [S, ...] for the
+        GPipe layout (S = P)."""
         return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
 
     @staticmethod
     def unstack(stacked):
         n = jax.tree.leaves(stacked)[0].shape[0]
         return [jax.tree.map(lambda a: a[i], stacked) for i in range(n)]
+
+    @staticmethod
+    def stack_interleaved(per_stage_params, num_devices: int):
+        """[S] list (global stage order, S = v·P) -> leaves [P, v, ...]
+        where element [d, c] is global stage c·P + d — the interleaved
+        placement (device d owns every P-th chunk)."""
+        S = len(per_stage_params)
+        if S % num_devices:
+            raise ValueError(
+                f"{S} stages do not divide over {num_devices} devices")
+        v = S // num_devices
+        rows = [PipelineStage.stack(
+            [per_stage_params[c * num_devices + d] for c in range(v)])
+            for d in range(num_devices)]
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *rows)

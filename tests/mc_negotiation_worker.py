@@ -1,0 +1,62 @@
+"""Counts KV round-trips per negotiated eager op (run under hvdrun at
+any -np). Asserts the coordinator topology: a non-coordinator process
+does exactly 1 kv_set (its request) + 1 kv_get (the published
+response) per op — independent of world size — and the coordinator
+does 2 kv_set (request + response) + N kv_get. This pins the rank-0
+validate-and-publish design (the reference coordinator broadcast,
+mpi_ops.cc:1421-1427) against regressing to all-read-all.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu.runtime import state as _state
+
+
+def main():
+    hvd.init()
+    st = _state.global_state()
+    r, n = st.process_rank, st.num_processes
+    assert n >= 2, n
+
+    # Warm up the dispatch cache so the counted op is negotiation-only
+    # plus the collective itself.
+    np.asarray(hvd.allreduce(np.ones((4,), np.float32), average=False))
+
+    calls = {"set": 0, "get": 0}
+    orig_set, orig_get = st.native.kv_set, st.native.kv_get
+
+    def counting_set(key, value):
+        calls["set"] += 1
+        return orig_set(key, value)
+
+    def counting_get(key, timeout_ms=60000):
+        calls["get"] += 1
+        return orig_get(key, timeout_ms=timeout_ms)
+
+    st.native.kv_set = counting_set
+    st.native.kv_get = counting_get
+    try:
+        out = np.asarray(hvd.allreduce(np.full((4,), float(r + 1),
+                                               np.float32),
+                                       average=False))
+    finally:
+        st.native.kv_set, st.native.kv_get = orig_set, orig_get
+
+    np.testing.assert_allclose(out, n * (n + 1) / 2.0)
+    if r == 0:
+        assert calls == {"set": 2, "get": n}, (calls, n)
+    else:
+        assert calls == {"set": 1, "get": 1}, (calls, n)
+
+    hvd.shutdown()
+    print(f"NEG_OK rank={r} np={n} rt={calls}")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,18 @@ def test_hvdrun_multidev_process_ranks():
     assert "MCMD_OK rank=1" in res.stdout
 
 
+@pytest.mark.parametrize("np_", [2, 4])
+def test_negotiation_roundtrips_constant(np_):
+    """Non-coordinator KV round-trips per negotiated op must be 2
+    (1 request write + 1 response read) at every world size — the
+    rank-0 validate-and-publish topology, not all-read-all."""
+    res = _run(["-np", str(np_), "--", sys.executable,
+                "tests/mc_negotiation_worker.py"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    for r in range(np_):
+        assert f"NEG_OK rank={r} np={np_}" in res.stdout, res.stdout
+
+
 def test_hvdrun_multihost_rank_offsets():
     """Two hvdrun instances = two 'hosts' of the reference's
     `mpirun -H server1:4,server2:4` contract (README.md:136-144):

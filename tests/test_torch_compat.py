@@ -116,6 +116,20 @@ class TestTraining:
         # hook-wrapped) step, so hooks may observe both layers.
         assert len(calls) >= 1
 
+    def test_wraps_optimizer_with_required_ctor_args(self, hvd_torch):
+        """The factory must not re-run the user class's __init__ —
+        custom optimizers with required constructor args would fail."""
+        class MyOpt(torch.optim.SGD):
+            def __init__(self, params, lr):  # lr: required, no default
+                super().__init__(params, lr=lr)
+
+        model = torch.nn.Linear(2, 1)
+        opt = hvd_torch.DistributedOptimizer(
+            MyOpt(model.parameters(), 0.2))
+        assert opt.defaults["lr"] == 0.2
+        model(torch.randn(3, 2)).sum().backward()
+        opt.step()
+
     def test_optimizer_isinstance_and_scheduler(self, hvd_torch):
         """LR schedulers type-check their optimizer; the distributed
         optimizer must BE a torch.optim.Optimizer (and the wrapped
