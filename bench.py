@@ -275,12 +275,12 @@ def main():
             gflops = TRAIN_GFLOPS_PER_IMG[args.model] * scale
             mfu = round(img_s_chip * gflops * 1e9 / peak, 4)
 
-        flash_ms = None
+        flash_ms = flash_err = None
         if not args.no_flash:
             try:
                 flash_ms = flash_attention_proof(platform)
             except Exception as e:  # noqa: BLE001 — report, don't die
-                flash_ms = f"failed: {e!r}"
+                flash_err = repr(e)
 
         result = {
             "metric": metric,
@@ -298,6 +298,8 @@ def main():
             result["sweep_fusion_img_s_per_chip"] = sweep
         if flash_ms is not None:
             result["flash_attn_ms"] = flash_ms
+        if flash_err is not None:
+            result["flash_attn_error"] = flash_err
         emit(result)
     except SystemExit:
         raise

@@ -152,25 +152,14 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
                 state[key] = val
 
 
-class _DistributedOptimizer(torch.optim.Optimizer):
+class _DistributedOptimizer:
     """Method bodies grafted by the `DistributedOptimizer` factory onto
     a dynamic subclass of the wrapped optimizer's class — the same
     trick as the keras adapter (`horovod/keras/__init__.py`, reference
-    keras `__init__.py:81-87`). Subclassing Optimizer here keeps
-    `__dict__`/`__weakref__` descriptors out of this class's namespace
-    so the dict copy below stays clean."""
-
-    def __init__(self, params, defaults, named_parameters=None,
-                 compression=Compression.none):
-        # Base Optimizer.__init__ directly (NOT the user class's, whose
-        # required ctor args we can't reconstruct): it registers the
-        # already-built param_groups, sets `defaults` to the original
-        # optimizer's, and fills the step-hook registries.
-        torch.optim.Optimizer.__init__(self, params, dict(defaults))
-        self._compression = compression
-        self._names = {}
-        if named_parameters is not None:
-            self._names = {id(p): n for n, p in named_parameters}
+    keras `__init__.py:81-87`). No __init__: the factory rebrands the
+    user's already-constructed instance, so every attribute the user
+    class's constructor set (defaults, hook registries, LBFGS-style
+    private caches) is already in place."""
 
     def _allreduce_grads(self):
         """Average every `.grad` across ranks, fusion-bucketed
@@ -202,16 +191,23 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                 off += n
 
     def step(self, closure=None):
-        loss = None
-        if closure is not None:
-            # Evaluate BEFORE the allreduce so the grads the closure
-            # produces are what gets averaged.
+        if closure is None:
+            if _hvd.size() > 1:
+                self._allreduce_grads()
+            return super(self.__class__, self).step()
+
+        # Closure optimizers (LBFGS) re-evaluate the loss inside the
+        # parent's step, possibly several times; average the grads
+        # after every re-evaluation so each inner iteration sees the
+        # cross-rank gradient.
+        def distributed_closure():
             with torch.enable_grad():
                 loss = closure()
-        if _hvd.size() > 1:
-            self._allreduce_grads()
-        super(self.__class__, self).step()
-        return loss
+            if _hvd.size() > 1:
+                self._allreduce_grads()
+            return loss
+
+        return super(self.__class__, self).step(distributed_closure)
 
 
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
@@ -222,14 +218,21 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     reference's compute_gradients override
     (`horovod/tensorflow/__init__.py:164-186`).
 
-    Returns an instance of a dynamically created subclass of the
-    wrapped optimizer's class, so `isinstance` checks (torch LR
-    schedulers demand a real `torch.optim.Optimizer`) and checkpoint
-    restore without horovod keep working. It shares the original's
-    param_group dicts but starts with fresh state — construct it before
-    training, or `broadcast_optimizer_state` after a restore.
+    Returns the SAME optimizer instance, rebranded to a dynamically
+    created subclass of its own class that overrides `step`: isinstance
+    checks (torch LR schedulers demand a real `torch.optim.Optimizer`),
+    checkpoint restore without horovod (the class keeps its name), and
+    all existing state/defaults keep working.
     """
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
-               dict(_DistributedOptimizer.__dict__))
-    return cls(optimizer.param_groups, optimizer.defaults,
-               named_parameters, compression)
+               {"step": _DistributedOptimizer.step,
+                "_allreduce_grads": _DistributedOptimizer._allreduce_grads})
+    # Rebrand the user's instance instead of constructing a fresh one:
+    # keeps defaults, hook registries, and any private state the user
+    # class's __init__ set (LBFGS caches, fused-impl flags) without
+    # having to reproduce its constructor arguments.
+    optimizer.__class__ = cls
+    optimizer._compression = compression
+    optimizer._names = ({id(p): n for n, p in named_parameters}
+                        if named_parameters is not None else {})
+    return optimizer

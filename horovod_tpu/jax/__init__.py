@@ -235,4 +235,5 @@ def make_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
         check_vma=False,
     )
     donate_argnums = (0, 1) if donate else ()
-    return jax.jit(sharded, donate_argnums=donate_argnums)
+    from horovod_tpu.utils.timeline import step_bracket
+    return step_bracket(jax.jit(sharded, donate_argnums=donate_argnums))

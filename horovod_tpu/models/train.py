@@ -80,7 +80,8 @@ def make_cnn_train_step(model, tx: optax.GradientTransformation,
         check_vma=False,
     )
     donate_argnums = (0,) if donate else ()
-    return jax.jit(sharded, donate_argnums=donate_argnums)
+    from horovod_tpu.utils.timeline import step_bracket
+    return step_bracket(jax.jit(sharded, donate_argnums=donate_argnums))
 
 
 def init_cnn_state(model, tx: optax.GradientTransformation, rng,

@@ -266,7 +266,8 @@ def make_lm_train_step(model: TransformerLM,
         with use(mesh):
             return jitted(params, opt_state, tokens)
 
-    return wrapped
+    from horovod_tpu.utils.timeline import step_bracket
+    return step_bracket(wrapped)
 
 
 def init_lm_state(model: TransformerLM, tx: optax.GradientTransformation,

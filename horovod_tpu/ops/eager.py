@@ -153,6 +153,9 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
 
     metas = []
     for r in range(st.num_processes):
+        if r == st.process_rank:
+            metas.append(meta)  # no round-trip for our own request
+            continue
         v = st.native.kv_get(f"req/{opname}/{cnt}/{r}", timeout_ms=60000)
         if v is None:
             exc = RuntimeError(

@@ -179,3 +179,34 @@ def stop_timeline():
     if st.timeline is not None:
         st.timeline.close()
         st.timeline = None
+
+
+def step_bracket(fn, name: str = "train_step"):
+    """Wrap a jitted train step so every invocation emits a host-side
+    B/E span on the HOROVOD_TIMELINE trace.
+
+    Under SPMD the per-collective events the reference logs do not
+    exist at runtime — collectives are compiled into the XLA program
+    and are invisible to the host (device traces belong to
+    `jax.profiler`, see docs/timeline.md). What the host CAN see, and
+    what this bracket records, is the step cadence: dispatch duration,
+    gaps between steps (input pipeline stalls), and how eager
+    collectives interleave with the jitted hot path — all in the same
+    Chrome trace. No-op overhead when no timeline is configured.
+    """
+    import functools
+
+    from horovod_tpu.runtime import state as _state
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        tl = _state.global_state().timeline
+        if tl is None:
+            return fn(*args, **kwargs)
+        tl.record(name, "TOP_LEVEL", "DISPATCH")
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            tl.record(name, "DONE")
+
+    return wrapper

@@ -50,7 +50,9 @@ def main():
 
     np.testing.assert_allclose(out, n * (n + 1) / 2.0)
     if r == 0:
-        assert calls == {"set": 2, "get": n}, (calls, n)
+        # request + response writes; reads only the N-1 peers (its own
+        # request is used from local memory).
+        assert calls == {"set": 2, "get": n - 1}, (calls, n)
     else:
         assert calls == {"set": 1, "get": 1}, (calls, n)
 

@@ -26,6 +26,38 @@ def test_timeline_writes_chrome_trace(hvd, tmp_path):
     assert {"B", "E"} <= phases
 
 
+def test_timeline_step_bracket_covers_jitted_hot_path(hvd, tmp_path):
+    """The SPMD train step is invisible to per-collective tracing
+    (collectives live inside the compiled program); the host-side
+    step bracket records its cadence in the same trace."""
+    import optax
+
+    path = str(tmp_path / "timeline_step.json")
+    hvd.start_timeline(path)
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return ((x @ params["w"] - y) ** 2).mean()
+
+    params = {"w": np.zeros((3, 1), np.float32)}
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+    opt_state = tx.init(params)
+    step = hvd.make_train_step(loss_fn, tx)
+    rng = np.random.RandomState(0)
+    batch = (rng.randn(16, 3).astype(np.float32),
+             rng.randn(16, 1).astype(np.float32))
+    for _ in range(3):
+        params, opt_state, _ = step(params, opt_state, batch)
+    hvd.stop_timeline()
+
+    events = json.loads(open(path).read())
+    begins = [e for e in events
+              if e.get("name") == "train_step" and e.get("ph") == "B"]
+    assert len(begins) == 3, len(begins)
+    ends = [e for e in events if e.get("ph") == "E"]
+    assert ends, "step brackets must close"
+
+
 def test_stall_monitor_detects(hvd):
     """Pending op past threshold triggers the stall warning
     (mpi_ops.cc:1150-1193 parity, warning not fatal)."""

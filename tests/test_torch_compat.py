@@ -130,6 +130,27 @@ class TestTraining:
         model(torch.randn(3, 2)).sum().backward()
         opt.step()
 
+    def test_wraps_lbfgs_closure_and_instance_state(self, hvd_torch):
+        """Optimizers that set private state in __init__ (LBFGS's
+        _params cache) and require a closure must work through the
+        wrapper; the closure's grads are averaged on every inner
+        re-evaluation."""
+        model = torch.nn.Linear(2, 1)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.LBFGS(model.parameters(), max_iter=3))
+        x = torch.randn(16, 2)
+        y = x @ torch.tensor([[1.0], [2.0]])
+
+        def closure():
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()
+            return loss
+
+        l0 = float(opt.step(closure))
+        l1 = float(opt.step(closure))
+        assert l1 < l0, (l0, l1)
+
     def test_optimizer_isinstance_and_scheduler(self, hvd_torch):
         """LR schedulers type-check their optimizer; the distributed
         optimizer must BE a torch.optim.Optimizer (and the wrapped
