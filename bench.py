@@ -58,10 +58,18 @@ def emit(result):
 
 def fail(metric, unit, kind, detail, rc=1):
     """Diagnostic JSON: `error` distinguishes backend-unavailable from
-    benchmark-failed (VERDICT r1: bench must not die silently)."""
+    benchmark-failed (VERDICT r1: bench must not die silently).
+
+    Exits with os._exit: when the TPU plugin hangs, the watchdog's
+    stuck daemon thread (blocked in native PJRT init) can wedge normal
+    interpreter shutdown and turn our clean diagnostic into a driver
+    timeout."""
     emit({"metric": metric, "value": 0.0, "unit": unit,
           "vs_baseline": None, "error": f"{kind}: {detail}"})
-    sys.exit(rc)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    import os
+    os._exit(rc)
 
 
 def acquire_devices(timeout_s):
@@ -145,13 +153,67 @@ def flash_attention_proof(platform):
     return round(ms, 2)
 
 
+def run_transformer(args, devices, n_chips, log):
+    """Flagship transformer-LM throughput: tokens/sec/chip with the
+    Pallas flash-attention kernel in the hot path (no reference
+    analogue — the long-context extension's headline number)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models.transformer import (init_lm_state,
+                                                make_lm_train_step,
+                                                TransformerLM)
+    from horovod_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(devices=devices, data=n_chips)
+    model = TransformerLM(
+        vocab_size=32768, num_layers=args.layers,
+        num_heads=args.heads, head_dim=args.head_dim,
+        max_len=args.seq, dtype=jnp.bfloat16,
+        attn_impl=args.attn_impl)
+    toks = np.random.RandomState(0).randint(
+        0, 32768, (args.batch * n_chips, args.seq))
+    params, opt_state = init_lm_state(
+        model, tx := optax.adamw(3e-4), jax.random.PRNGKey(0), mesh,
+        toks)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(params))
+    log(f"transformer: {n_params / 1e6:.1f}M params, seq={args.seq}, "
+        f"global batch={args.batch * n_chips}")
+    step = make_lm_train_step(model, tx, mesh)
+
+    t0 = time.time()
+    for _ in range(max(1, args.warmup)):
+        params, opt_state, loss = step(params, opt_state, toks)
+    warm = float(loss)  # scalar readback = fence (see time_steps)
+    log(f"warmup done in {time.time() - t0:.1f}s (loss={warm:.3f})")
+    t0 = time.time()
+    for _ in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, toks)
+    float(loss)
+    dt = time.time() - t0
+
+    tokens = args.steps * args.batch * n_chips * args.seq
+    tok_s_chip = tokens / dt / n_chips
+    # 6·N·T (fwd+bwd matmul flops) + causal attention term
+    # 12·L·S·D·T/2; coarse analytic, stated as an estimate.
+    d_model = args.heads * args.head_dim
+    flops_per_tok = 6 * n_params + 6 * args.layers * args.seq * d_model
+    return {"tok_s_chip": tok_s_chip, "flops_per_tok": flops_per_tok,
+            "n_params": n_params,
+            "step_ms": dt / args.steps * 1e3}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet101",
                     choices=["resnet50", "resnet101", "vgg16",
-                             "inception3", "mnist"])
-    ap.add_argument("--batch", type=int, default=128,
-                    help="per-chip batch size")
+                             "inception3", "mnist", "transformer"])
+    ap.add_argument("--batch", type=int, default=None,
+                    help="per-chip batch size (default: 128 for CNNs, "
+                         "8 for the transformer)")
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=3)
@@ -165,10 +227,22 @@ def main():
     ap.add_argument("--init-timeout", type=float, default=90.0)
     ap.add_argument("--remat", action="store_true",
                     help="jax.checkpoint the forward (fit larger batch)")
+    ap.add_argument("--seq", type=int, default=2048,
+                    help="transformer sequence length")
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--heads", type=int, default=8)
+    # head_dim 128 fills the MXU lanes — measured 1.56x over 64.
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--attn-impl", default="flash",
+                    choices=["dot", "blockwise", "flash"])
     args = ap.parse_args()
 
-    metric = f"{args.model}_images_per_sec_per_chip"
-    unit = "images/sec/chip"
+    is_lm = args.model == "transformer"
+    if args.batch is None:
+        args.batch = 8 if is_lm else 128
+    metric = (f"transformer_tokens_per_sec_per_chip" if is_lm
+              else f"{args.model}_images_per_sec_per_chip")
+    unit = "tokens/sec/chip" if is_lm else "images/sec/chip"
 
     import os
     if "HOROVOD_RANK" in os.environ or os.environ.get("HOROVOD_PLATFORM"):
@@ -200,6 +274,28 @@ def main():
         device_kind = getattr(devices[0], "device_kind", platform)
         log(f"devices: {devices} (platform={platform}, "
             f"kind={device_kind}, world={n_chips})")
+
+        if is_lm:
+            r = run_transformer(args, devices, n_chips, log)
+            peak = PEAK_BF16.get(device_kind)
+            emit({
+                "metric": metric,
+                "value": round(r["tok_s_chip"], 1),
+                "unit": unit,
+                "vs_baseline": None,  # no LM in the reference (2017)
+                "platform": platform,
+                "device_kind": device_kind,
+                "chips": n_chips,
+                "per_chip_batch": args.batch,
+                "seq": args.seq,
+                "params_m": round(r["n_params"] / 1e6, 1),
+                "step_ms": round(r["step_ms"], 1),
+                "attn_impl": args.attn_impl,
+                "mfu_estimate": round(
+                    r["tok_s_chip"] * r["flops_per_tok"] / peak, 4)
+                if peak else None,
+            })
+            return
 
         if args.model == "mnist":
             model = models.MnistConvNet(dtype=jnp.float32)

@@ -14,4 +14,7 @@ python -m horovod_tpu.runner -np 2 --platform cpu -- \
 python -m horovod_tpu.runner -np 2 --platform cpu -- \
     python examples/jax_mnist_advanced.py --epochs 1
 
+python -m horovod_tpu.runner -np 2 --platform cpu -- \
+    python examples/torch_mnist.py --steps 20
+
 echo "CI OK"

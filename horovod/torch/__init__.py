@@ -177,9 +177,13 @@ class _DistributedOptimizer:
         for bucket in plan_buckets(grads):
             flat = np.concatenate([grads[i].ravel() for i in bucket])
             flat, meta = self._compression.compress(flat)
+            # Collective named after the bucket's first parameter when
+            # named_parameters was given (timeline/stall labels match
+            # the reference's per-tensor naming).
+            label = self._names.get(id(params[bucket[0]]),
+                                    f"bucket_{bucket[0]}")
             red = np.asarray(_hvd.allreduce(
-                flat, average=True,
-                name=f"torch_grad_bucket_{bucket[0]}"))
+                flat, average=True, name=f"torch_grad_{label}"))
             red = np.asarray(self._compression.decompress(red, meta))
             off = 0
             for i in bucket:
@@ -194,7 +198,9 @@ class _DistributedOptimizer:
         if closure is None:
             if _hvd.size() > 1:
                 self._allreduce_grads()
-            return super(self.__class__, self).step()
+            out = super(self.__class__, self).step()
+            self._count_step()
+            return out
 
         # Closure optimizers (LBFGS) re-evaluate the loss inside the
         # parent's step, possibly several times; average the grads
@@ -207,7 +213,17 @@ class _DistributedOptimizer:
                 self._allreduce_grads()
             return loss
 
-        return super(self.__class__, self).step(distributed_closure)
+        out = super(self.__class__, self).step(distributed_closure)
+        self._count_step()
+        return out
+
+    def _count_step(self):
+        # Stand-in for the LR scheduler's stripped step-counting patch
+        # (see the factory below); over-counting when the scheduler
+        # re-patches on top of us is harmless — the warning only fires
+        # on a zero count.
+        if hasattr(self, "_step_count"):
+            self._step_count += 1
 
 
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
@@ -226,12 +242,20 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     """
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                {"step": _DistributedOptimizer.step,
-                "_allreduce_grads": _DistributedOptimizer._allreduce_grads})
+                "_allreduce_grads": _DistributedOptimizer._allreduce_grads,
+                "_count_step": _DistributedOptimizer._count_step})
     # Rebrand the user's instance instead of constructing a fresh one:
     # keeps defaults, hook registries, and any private state the user
     # class's __init__ set (LBFGS caches, fused-impl flags) without
     # having to reproduce its constructor arguments.
     optimizer.__class__ = cls
+    # An LR scheduler attached BEFORE wrapping patches `step` as an
+    # instance attribute (its call-order counter) that captures the
+    # original class's step — it would shadow the distributed step and
+    # silently skip the allreduce. Drop the patch; the distributed
+    # step maintains `_step_count` itself so the scheduler's
+    # call-order warning logic stays sound.
+    optimizer.__dict__.pop("step", None)
     optimizer._compression = compression
     optimizer._names = ({id(p): n for n, p in named_parameters}
                         if named_parameters is not None else {})

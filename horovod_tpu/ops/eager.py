@@ -121,8 +121,11 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
     meta = {"dtype": str(arr.dtype), "shape": list(arr.shape),
             "op": op, "root": root_rank,
             "ndev": len(_mc_local_devices(st))}
-    if not st.native.kv_set(f"req/{opname}/{cnt}/{st.process_rank}",
-                            json.dumps(meta).encode()):
+    # The coordinator consumes its own request from local memory; only
+    # non-coordinator requests go over the wire.
+    if st.process_rank != 0 and not st.native.kv_set(
+            f"req/{opname}/{cnt}/{st.process_rank}",
+            json.dumps(meta).encode()):
         raise RuntimeError(
             f"failed to post negotiation request for {opname} — "
             f"rendezvous connection lost")

@@ -50,9 +50,9 @@ def main():
 
     np.testing.assert_allclose(out, n * (n + 1) / 2.0)
     if r == 0:
-        # request + response writes; reads only the N-1 peers (its own
-        # request is used from local memory).
-        assert calls == {"set": 2, "get": n - 1}, (calls, n)
+        # One response write; reads the N-1 peers' requests (its own
+        # request never touches the wire).
+        assert calls == {"set": 1, "get": n - 1}, (calls, n)
     else:
         assert calls == {"set": 1, "get": 1}, (calls, n)
 

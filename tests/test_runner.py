@@ -116,6 +116,17 @@ def test_hvdrun_rejects_misconfigured_multihost():
     assert res.returncode != 0 and "bad host entry" in res.stderr
 
 
+@pytest.mark.parametrize("example", ["examples/jax_mnist.py",
+                                     "examples/torch_mnist.py"])
+def test_examples_under_launcher(example):
+    """The canonical 5-line-change examples run to completion at np=2
+    (the reference's Travis contract runs its examples under mpirun)."""
+    res = _run(["-np", "2", "--", sys.executable, example,
+                "--steps", "5"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "final loss" in res.stdout
+
+
 def test_hvdrun_propagates_failure():
     res = _run(["-np", "2", "--", sys.executable, "-c",
                 "import sys; sys.exit(3)"])

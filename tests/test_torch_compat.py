@@ -130,6 +130,23 @@ class TestTraining:
         model(torch.randn(3, 2)).sum().backward()
         opt.step()
 
+    def test_scheduler_attached_before_wrapping(self, hvd_torch):
+        """torch LR schedulers patch `step` as an instance attribute;
+        attaching one BEFORE DistributedOptimizer must not shadow the
+        distributed step (which would silently skip the allreduce)."""
+        model = torch.nn.Linear(2, 1)
+        inner = torch.optim.SGD(model.parameters(), lr=0.4)
+        sched = torch.optim.lr_scheduler.StepLR(inner, step_size=1,
+                                                gamma=0.5)
+        opt = hvd_torch.DistributedOptimizer(inner)
+        ran = []
+        opt._allreduce_grads = lambda: ran.append(1)
+        model(torch.randn(4, 2)).sum().backward()
+        opt.step()
+        assert ran == [1], "distributed step was shadowed"
+        sched.step()
+        assert abs(opt.param_groups[0]["lr"] - 0.2) < 1e-12
+
     def test_wraps_lbfgs_closure_and_instance_state(self, hvd_torch):
         """Optimizers that set private state in __init__ (LBFGS's
         _params cache) and require a closure must work through the
