@@ -178,22 +178,21 @@ def run_transformer(args, devices, n_chips, log):
     params, opt_state = init_lm_state(
         model, tx := optax.adamw(3e-4), jax.random.PRNGKey(0), mesh,
         toks)
+    step_kwargs = ({"loss_chunk": args.loss_chunk}
+                   if args.loss_chunk else {})
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree.leaves(params))
     log(f"transformer: {n_params / 1e6:.1f}M params, seq={args.seq}, "
         f"global batch={args.batch * n_chips}")
-    step = make_lm_train_step(model, tx, mesh)
+    step = make_lm_train_step(model, tx, mesh, **step_kwargs)
 
-    t0 = time.time()
-    for _ in range(max(1, args.warmup)):
-        params, opt_state, loss = step(params, opt_state, toks)
-    warm = float(loss)  # scalar readback = fence (see time_steps)
-    log(f"warmup done in {time.time() - t0:.1f}s (loss={warm:.3f})")
-    t0 = time.time()
-    for _ in range(args.steps):
-        params, opt_state, loss = step(params, opt_state, toks)
-    float(loss)
-    dt = time.time() - t0
+    def lm_step(state, batch, rng):
+        params, opt_state = state
+        params, opt_state, loss = step(params, opt_state, batch)
+        return (params, opt_state), loss
+
+    _, _, dt, _ = time_steps(lm_step, (params, opt_state), toks, None,
+                             args.steps, args.warmup)
 
     tokens = args.steps * args.batch * n_chips * args.seq
     tok_s_chip = tokens / dt / n_chips
@@ -235,6 +234,9 @@ def main():
     ap.add_argument("--head-dim", type=int, default=128)
     ap.add_argument("--attn-impl", default="flash",
                     choices=["dot", "blockwise", "flash"])
+    ap.add_argument("--loss-chunk", type=int, default=None,
+                    help="transformer: fused head+loss scanned over "
+                         "seq chunks (no [B,S,V] logits)")
     args = ap.parse_args()
 
     is_lm = args.model == "transformer"

@@ -46,7 +46,10 @@ def _to_np(tensor: torch.Tensor) -> np.ndarray:
 
 
 def _like(arr: np.ndarray, ref: torch.Tensor) -> torch.Tensor:
-    return torch.from_numpy(np.ascontiguousarray(arr)).to(ref.dtype)
+    a = np.ascontiguousarray(arr)
+    if not a.flags.writeable:  # jax outputs are read-only buffers
+        a = a.copy()
+    return torch.from_numpy(a).to(ref.dtype)
 
 
 def allreduce(tensor: torch.Tensor, average: bool = True,
@@ -199,7 +202,7 @@ class _DistributedOptimizer:
             if _hvd.size() > 1:
                 self._allreduce_grads()
             out = super(self.__class__, self).step()
-            self._count_step()
+            self._opt_called = True  # LR scheduler call-order tracking
             return out
 
         # Closure optimizers (LBFGS) re-evaluate the loss inside the
@@ -214,16 +217,8 @@ class _DistributedOptimizer:
             return loss
 
         out = super(self.__class__, self).step(distributed_closure)
-        self._count_step()
+        self._opt_called = True  # LR scheduler call-order tracking
         return out
-
-    def _count_step(self):
-        # Stand-in for the LR scheduler's stripped step-counting patch
-        # (see the factory below); over-counting when the scheduler
-        # re-patches on top of us is harmless — the warning only fires
-        # on a zero count.
-        if hasattr(self, "_step_count"):
-            self._step_count += 1
 
 
 def DistributedOptimizer(optimizer: torch.optim.Optimizer,
@@ -242,8 +237,11 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     """
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                {"step": _DistributedOptimizer.step,
-                "_allreduce_grads": _DistributedOptimizer._allreduce_grads,
-                "_count_step": _DistributedOptimizer._count_step})
+                "_allreduce_grads": _DistributedOptimizer._allreduce_grads})
+    # The scheduler's "step() has been overridden" heuristic checks for
+    # this marker on the step function; the distributed step preserves
+    # the scheduler contract (it sets _opt_called), so claim it.
+    cls.step._wrapped_by_lr_sched = True
     # Rebrand the user's instance instead of constructing a fresh one:
     # keeps defaults, hook registries, and any private state the user
     # class's __init__ set (LBFGS caches, fused-impl flags) without
@@ -252,9 +250,8 @@ def DistributedOptimizer(optimizer: torch.optim.Optimizer,
     # An LR scheduler attached BEFORE wrapping patches `step` as an
     # instance attribute (its call-order counter) that captures the
     # original class's step — it would shadow the distributed step and
-    # silently skip the allreduce. Drop the patch; the distributed
-    # step maintains `_step_count` itself so the scheduler's
-    # call-order warning logic stays sound.
+    # silently skip the allreduce. Drop the patch (the class-level
+    # distributed step carries the scheduler marker instead).
     optimizer.__dict__.pop("step", None)
     optimizer._compression = compression
     optimizer._names = ({id(p): n for n, p in named_parameters}

@@ -159,7 +159,8 @@ class TransformerLM(nn.Module):
     remat: bool = False
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> jax.Array:
+    def __call__(self, tokens: jax.Array,
+                 return_hidden: bool = False) -> Any:
         B, S = tokens.shape
         d = self.num_heads * self.head_dim
         embed = self.param(
@@ -187,6 +188,10 @@ class TransformerLM(nn.Module):
             x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
 
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        if return_hidden:
+            # For the chunked fused head+loss (`chunked_lm_loss`): the
+            # [B, S, V] logits never materialize.
+            return x, embed
         # Tied LM head: logits sharded over ``model`` on vocab; the CE
         # loss reduces over it with GSPMD-inserted collectives.
         logits = jnp.einsum("bsd,vd->bsv", x,
@@ -228,10 +233,55 @@ def lm_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
         logits[:, :-1].astype(jnp.float32), tokens[:, 1:]).mean()
 
 
+def chunked_lm_loss(hidden: jax.Array, embed: jax.Array,
+                    tokens: jax.Array, *, chunk: int = 512) -> jax.Array:
+    """Next-token cross entropy fused with the LM head, scanned over
+    sequence chunks so the [B, S, V] logits tensor never materializes.
+
+    The plain path's logits are the LM's single biggest activation —
+    1 GiB at B8·S2048·V32k bf16, and the dominant allocation in the
+    OOM report that sank the blockwise config on a 16 GB chip. Here
+    each scan tick computes [B, chunk, V] logits, folds them into the
+    running CE sum, and `jax.checkpoint` recomputes them in the
+    backward, so peak memory drops by S/chunk at the cost of one extra
+    head matmul in the backward (a few % of total step FLOPs).
+
+    Composes with dp (use via `make_lm_train_step(loss_chunk=...)`);
+    with sequence parallelism keep the plain loss — the chunk reshape
+    would fight the ``seq`` sharding of `hidden`. The batch must
+    divide the ``data`` axis (the standard SPMD input contract — a
+    ragged batch can trip an XLA partitioner CHECK inside the scan).
+    """
+    B, S, D = hidden.shape
+    h = hidden[:, :-1]
+    y = tokens[:, 1:]
+    P = S - 1
+    nc = -(-P // chunk)
+    pad = nc * chunk - P
+    h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
+    y = jnp.pad(y, ((0, 0), (0, pad)))
+    mask = jnp.pad(jnp.ones((B, P), jnp.float32), ((0, 0), (0, pad)))
+    h = h.reshape(B, nc, chunk, D).transpose(1, 0, 2, 3)
+    y = y.reshape(B, nc, chunk).transpose(1, 0, 2)
+    mask = mask.reshape(B, nc, chunk).transpose(1, 0, 2)
+    w = embed.astype(hidden.dtype)
+
+    @jax.checkpoint
+    def tick(total, xs):
+        hc, yc, mc = xs
+        logits = jnp.einsum("bcd,vd->bcv", hc, w).astype(jnp.float32)
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, yc)
+        return total + (ce * mc).sum(), None
+
+    total, _ = lax.scan(tick, jnp.float32(0.0), (h, y, mask))
+    return total / (B * P)
+
+
 def make_lm_train_step(model: TransformerLM,
                        tx: optax.GradientTransformation, mesh,
                        *, moe_aux_weight: float = 0.01,
-                       donate: bool = True) -> Callable:
+                       donate: bool = True,
+                       loss_chunk: Optional[int] = None) -> Callable:
     """step(params, opt_state, tokens) -> (params, opt_state, loss).
 
     `params` = unboxed pytree placed by `init_lm_state` (TP/EP leaves
@@ -244,15 +294,25 @@ def make_lm_train_step(model: TransformerLM,
     """
     has_moe = model.moe_every > 0
 
+    def data_loss(params, tokens, mutable):
+        if loss_chunk:
+            out = model.apply({"params": params}, tokens,
+                              return_hidden=True, mutable=mutable)
+            (hidden, embed), col = out if mutable else (out, {})
+            return chunked_lm_loss(hidden, embed, tokens,
+                                   chunk=loss_chunk), col
+        out = model.apply({"params": params}, tokens, mutable=mutable)
+        logits, col = out if mutable else (out, {})
+        return lm_loss(logits, tokens), col
+
     def loss_fn(params, tokens):
         if has_moe:
-            logits, col = model.apply({"params": params}, tokens,
-                                      mutable=["losses"])
+            loss, col = data_loss(params, tokens, ["losses"])
             aux = sum(jnp.asarray(v).sum()
                       for v in jax.tree.leaves(col.get("losses", {})))
-            return lm_loss(logits, tokens) + moe_aux_weight * aux
-        logits = model.apply({"params": params}, tokens)
-        return lm_loss(logits, tokens)
+            return loss + moe_aux_weight * aux
+        loss, _ = data_loss(params, tokens, False)
+        return loss
 
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)

@@ -72,7 +72,7 @@ class TestTraining:
             loss = torch.nn.functional.mse_loss(model(x), y)
             loss.backward()
             opt.step()
-            losses.append(float(loss))
+            losses.append(loss.item())
         assert losses[-1] < 0.05 * losses[0], losses
         hvd_torch.broadcast_optimizer_state(opt, 0)
 
@@ -164,8 +164,8 @@ class TestTraining:
             loss.backward()
             return loss
 
-        l0 = float(opt.step(closure))
-        l1 = float(opt.step(closure))
+        l0 = opt.step(closure).item()
+        l1 = opt.step(closure).item()
         assert l1 < l0, (l0, l1)
 
     def test_optimizer_isinstance_and_scheduler(self, hvd_torch):
@@ -239,5 +239,5 @@ class TestCompression:
             loss = torch.nn.functional.mse_loss(model(x), y)
             loss.backward()
             opt.step()
-            l0 = l0 if l0 is not None else float(loss)
-        assert float(loss) < l0
+            l0 = l0 if l0 is not None else loss.item()
+        assert loss.item() < l0
