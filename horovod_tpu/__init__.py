@@ -49,6 +49,8 @@ from horovod_tpu.jax import (
     broadcast_parameters,
     broadcast_optimizer_state,
     broadcast_object,
+    allgather_object,
+    grouped_allreduce,
     make_train_step,
     make_global_batch,
 )
@@ -56,7 +58,7 @@ from horovod_tpu.ops.sparse import IndexedSlices
 from horovod_tpu.runtime.config import config
 from horovod_tpu.utils.timeline import start_timeline, stop_timeline
 
-__version__ = "0.1.0"
+__version__ = "0.10.0"  # mirrors the reference's version (setup.py:348)
 
 __all__ = [
     "init", "shutdown", "is_initialized",
@@ -67,6 +69,7 @@ __all__ = [
     "DistributedOptimizer", "DistributedGradientTape", "allreduce_gradients",
     "broadcast_global_variables", "broadcast_parameters",
     "broadcast_optimizer_state", "broadcast_object",
+    "allgather_object", "grouped_allreduce",
     "make_train_step", "make_global_batch", "IndexedSlices", "config",
     "start_timeline", "stop_timeline",
 ]

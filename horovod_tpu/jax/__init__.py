@@ -18,7 +18,7 @@ allgather path (reference `:61-72`).
 from __future__ import annotations
 
 import pickle
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -178,6 +178,72 @@ def broadcast_object(obj: Any, root_rank: int = 0) -> Any:
     out = np.asarray(eager.broadcast(buf, root_rank,
                                      name="bcast_object_payload"))
     return pickle.loads(out.tobytes())
+
+
+def allgather_object(obj: Any) -> list:
+    """Gather one picklable object per rank into a list ordered by rank
+    (parity with later Horovod's `hvd.allgather_object`; pairs with
+    `broadcast_object` for metric/metadata collection).
+
+    Rides the variable-dim-0 allgather (`MPI_Allgatherv` semantics,
+    reference `mpi_ops.cc:732-809`): each process contributes its
+    pickled payload as a [len, 1] uint8 block plus a length row, so
+    payloads of different sizes need no padding negotiation beyond the
+    size exchange the allgather already does.
+    """
+    st = _state.check_initialized()
+    world = st.num_processes if st.num_processes > 1 else st.size
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    if world <= 1:
+        return [obj]
+    if st.num_processes > 1:
+        sizes = np.asarray(eager.allgather(
+            np.asarray([payload.size], np.int64),
+            name="agather_object_len"))
+        blob = np.asarray(eager.allgather(payload,
+                                          name="agather_object_payload"))
+    else:
+        # Single-controller SPMD: every rank holds the same object.
+        sizes = np.full((world,), payload.size, np.int64)
+        blob = np.concatenate([payload] * world)
+    out, off = [], 0
+    for n in sizes:
+        out.append(pickle.loads(blob[off:off + int(n)].tobytes()))
+        off += int(n)
+    return out
+
+
+def grouped_allreduce(tensors: Sequence[Any], average: bool = True,
+                      name: Optional[str] = None) -> list:
+    """Allreduce a list of tensors as one fused operation (later
+    Horovod's `hvd.grouped_allreduce`): same-dtype tensors are packed
+    into a single flat collective — explicit access to the fusion the
+    `DistributedOptimizer` path applies automatically
+    (`ops/fusion.py`, docs/tensor-fusion.md).
+    """
+    if any(isinstance(t, eager.PerRank) for t in tensors):
+        raise TypeError(
+            "grouped_allreduce takes plain arrays (one per call site), "
+            "not per_rank inputs; allreduce each per_rank individually")
+    arrs = [np.asarray(t) for t in tensors]
+    out: list = [None] * len(arrs)
+    # One collective per dtype, order-independent: the caller asked for
+    # a grouped op, so all same-dtype tensors pack together even when
+    # interleaved with other dtypes.
+    by_dtype: dict = {}
+    for i, a in enumerate(arrs):
+        by_dtype.setdefault(a.dtype, []).append(i)
+    for dtype, bucket in by_dtype.items():
+        flat = np.concatenate([arrs[i].ravel() for i in bucket])
+        red = np.asarray(eager.allreduce(
+            flat, average=average,
+            name=name and f"{name}_{np.dtype(dtype).name}"))
+        off = 0
+        for i in bucket:
+            n = arrs[i].size
+            out[i] = red[off:off + n].reshape(arrs[i].shape)
+            off += n
+    return out
 
 
 def make_global_batch(batch: Any, *, axis_name: Optional[str] = None) -> Any:

@@ -50,6 +50,18 @@ def main():
     got = hvd.broadcast_object(obj, root_rank=0)
     assert got == {"epoch": 7, "rank": 0}, got
 
+    # allgather_object: differently-sized payloads per rank.
+    objs = hvd.allgather_object({"rank": r, "pad": "x" * (10 * (r + 1))})
+    assert [o["rank"] for o in objs] == list(range(n)), objs
+    assert len(objs[1]["pad"]) == 20
+
+    # grouped_allreduce: one fused collective over a list.
+    g = hvd.grouped_allreduce(
+        [np.full((3,), float(r + 1), np.float32),
+         np.full((2,), float(r), np.float32)], average=False)
+    np.testing.assert_allclose(np.asarray(g[0]), 3.0)  # 1+2
+    np.testing.assert_allclose(np.asarray(g[1]), 1.0)  # 0+1
+
     # mismatch must raise the precondition error on every process — with
     # an AUTO-generated name, so negotiation meets even though shapes
     # disagree (the content-free naming contract).

@@ -192,3 +192,42 @@ def test_broadcast_rank_mismatch(hvd):
 def test_wrong_world_size_rejected(hvd):
     with pytest.raises(ValueError):
         hvd.allreduce(hvd.per_rank([np.zeros(3)] * (hvd.size() - 1)))
+
+
+def test_allgather_object(hvd):
+    """later-Horovod `hvd.allgather_object`: one picklable object per
+    rank, returned as a rank-ordered list."""
+    out = hvd.allgather_object({"rank": 0, "tag": "x"})
+    assert len(out) == hvd.size()
+    assert all(o == {"rank": 0, "tag": "x"} for o in out)
+
+
+def test_grouped_allreduce(hvd):
+    """later-Horovod `hvd.grouped_allreduce`: a list reduced as one
+    fused collective; per-tensor results equal individual allreduces."""
+    import numpy as np
+    ts = [np.arange(4, dtype=np.float32),
+          np.ones((2, 3), np.float32) * 2,
+          np.arange(6, dtype=np.int32)]
+    outs = hvd.grouped_allreduce(ts, average=False)
+    assert len(outs) == 3
+    for t, o in zip(ts, outs):
+        assert o.shape == t.shape and o.dtype == t.dtype
+        np.testing.assert_allclose(
+            np.asarray(o), np.asarray(hvd.allreduce(t, average=False)))
+    avg = hvd.grouped_allreduce(ts[:2], average=True)
+    np.testing.assert_allclose(np.asarray(avg[0]), ts[0])
+
+
+def test_grouped_allreduce_interleaved_dtypes_and_per_rank(hvd):
+    import numpy as np
+    import pytest as _pytest
+    ts = [np.ones(2, np.float32), np.ones(3, np.int32),
+          np.ones(4, np.float32)]  # f32 tensors pack despite the i32
+    outs = hvd.grouped_allreduce(ts, average=False)
+    for t, o in zip(ts, outs):
+        np.testing.assert_allclose(np.asarray(o), hvd.size())
+        assert o.dtype == t.dtype
+    with _pytest.raises(TypeError, match="per_rank"):
+        hvd.grouped_allreduce(
+            [hvd.per_rank([np.ones(2, np.float32)] * hvd.size())])
