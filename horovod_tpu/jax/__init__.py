@@ -196,16 +196,16 @@ def allgather_object(obj: Any) -> list:
     payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
     if world <= 1:
         return [obj]
-    if st.num_processes > 1:
-        sizes = np.asarray(eager.allgather(
-            np.asarray([payload.size], np.int64),
-            name="agather_object_len"))
-        blob = np.asarray(eager.allgather(payload,
-                                          name="agather_object_payload"))
-    else:
-        # Single-controller SPMD: every rank holds the same object.
-        sizes = np.full((world,), payload.size, np.int64)
-        blob = np.concatenate([payload] * world)
+    if st.num_processes <= 1:
+        # Single-controller SPMD: every rank holds the same object;
+        # fresh copies, no gathered blob.
+        data = payload.tobytes()
+        return [pickle.loads(data) for _ in range(world)]
+    sizes = np.asarray(eager.allgather(
+        np.asarray([payload.size], np.int64),
+        name="agather_object_len"))
+    blob = np.asarray(eager.allgather(payload,
+                                      name="agather_object_payload"))
     out, off = [], 0
     for n in sizes:
         out.append(pickle.loads(blob[off:off + int(n)].tobytes()))
@@ -226,6 +226,20 @@ def grouped_allreduce(tensors: Sequence[Any], average: bool = True,
             "grouped_allreduce takes plain arrays (one per call site), "
             "not per_rank inputs; allreduce each per_rank individually")
     arrs = [np.asarray(t) for t in tensors]
+    st = _state.check_initialized()
+    if st.num_processes > 1:
+        # Packing erases per-tensor boundaries from the flat payload's
+        # metadata, so a cross-rank structure disagreement ((2,)+(4,)
+        # vs (4,)+(2,): same flat shape!) would silently sum misaligned
+        # elements. Exchange the exact structure first and raise the
+        # same error category individual allreduces would.
+        from horovod_tpu.ops.validation import CollectiveMismatchError
+        mine = [(tuple(a.shape), str(a.dtype)) for a in arrs]
+        descs = allgather_object(mine)
+        if any(d != descs[0] for d in descs):
+            raise CollectiveMismatchError(
+                f"Mismatched grouped_allreduce structure across ranks: "
+                f"{descs}")
     out: list = [None] * len(arrs)
     # One collective per dtype, order-independent: the caller asked for
     # a grouped op, so all same-dtype tensors pack together even when

@@ -1,0 +1,163 @@
+// ThreadSanitizer stress harness for the native control plane + data
+// loader — SURVEY §5.2 notes the reference has NO race-detection
+// tooling (thread safety is by hand); this goes beyond parity: the
+// same translation units Python loads are compiled with
+// -fsanitize=thread and hammered from many threads. Run by
+// tests/test_native.py::test_tsan_stress (skipped when TSan is
+// unavailable) and ci.sh.
+//
+// Build: g++ -std=c++17 -fsanitize=thread -g -O1 \
+//     control_plane.cc data_loader.cc stress_test.cc -o stress_test \
+//     -lpthread
+// Exit code 0 + "STRESS_OK" on stdout; TSan reports go to stderr and
+// force a nonzero exit (halt_on_error in TSAN_OPTIONS).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+int hvd_native_init(int rank, int size, int local_rank, int local_size);
+int hvd_native_shutdown();
+int hvd_native_rendezvous_serve(int port, int world);
+void hvd_native_rendezvous_stop();
+int hvd_native_client_connect(const char* host, int port, double timeout_s);
+void hvd_native_client_close();
+int hvd_native_kv_set(const char* key, const char* val, int vlen);
+int hvd_native_kv_get(const char* key, long timeout_ms, char* out, int cap);
+int hvd_native_barrier(const char* id, long timeout_ms);
+int hvd_native_ping();
+int hvd_native_timeline_start(const char* path);
+void hvd_native_timeline_record(const char* tensor, const char* phase,
+                                const char* activity);
+void hvd_native_timeline_mark(const char* tensor, const char* name);
+void hvd_native_timeline_stop();
+void hvd_native_stall_configure(double warning_s, double check_every_s);
+void hvd_native_stall_start_thread();
+void hvd_native_stall_stop_thread();
+void hvd_native_stall_begin(const char* name);
+void hvd_native_stall_end(const char* name);
+
+void* hvd_dl_open(const char** paths, int64_t nfiles, int64_t record_bytes,
+                  int64_t batch_records, int64_t capacity, int shuffle,
+                  uint64_t seed, int64_t rank, int64_t world,
+                  int drop_remainder);
+int hvd_dl_start_epoch(void* handle, uint64_t epoch);
+int64_t hvd_dl_next(void* handle, uint8_t* out);
+int64_t hvd_dl_num_records(void* handle);
+const char* hvd_dl_error(void* handle);
+void hvd_dl_close(void* handle);
+}
+
+static std::atomic<int> failures{0};
+
+#define CHECK(cond)                                                     \
+  do {                                                                  \
+    if (!(cond)) {                                                      \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,     \
+                   __LINE__, #cond);                                    \
+      failures.fetch_add(1);                                            \
+    }                                                                   \
+  } while (0)
+
+// Control plane: N threads share the process-global KV client (the
+// Python binding's threading model) while the server runs in-process.
+static void stress_control_plane() {
+  CHECK(hvd_native_init(0, 1, 0, 1) == 0);
+  int port = hvd_native_rendezvous_serve(0, 1);
+  CHECK(port > 0);
+  CHECK(hvd_native_client_connect("127.0.0.1", port, 10.0) == 0);
+
+  hvd_native_timeline_start("/tmp/hvd_stress_timeline.json");
+  hvd_native_stall_configure(0.001, 0.001);
+  hvd_native_stall_start_thread();
+
+  const int kThreads = 8, kOps = 200;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([t] {
+      char buf[64];
+      for (int i = 0; i < kOps; ++i) {
+        std::string key = "k" + std::to_string(t) + "_" +
+                          std::to_string(i % 16);
+        std::string val = "v" + std::to_string(i);
+        CHECK(hvd_native_kv_set(key.c_str(), val.data(),
+                                static_cast<int>(val.size())) == 0);
+        int n = hvd_native_kv_get(key.c_str(), 2000, buf, sizeof(buf));
+        CHECK(n > 0);
+        CHECK(hvd_native_ping() == 0);
+        std::string tensor = "t" + std::to_string(i % 4);
+        hvd_native_timeline_record(tensor.c_str(), "NEGOTIATING",
+                                   nullptr);
+        hvd_native_timeline_record(tensor.c_str(), "TOP_LEVEL",
+                                   "ALLREDUCE");
+        hvd_native_timeline_mark(tensor.c_str(), "QUEUE");
+        hvd_native_timeline_record(tensor.c_str(), "DONE", nullptr);
+        hvd_native_stall_begin(tensor.c_str());
+        hvd_native_stall_end(tensor.c_str());
+        if (i % 32 == 0) {
+          std::string b = "bar" + std::to_string(t) + "_" +
+                          std::to_string(i);
+          CHECK(hvd_native_barrier(b.c_str(), 2000) == 0);
+        }
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+
+  hvd_native_stall_stop_thread();
+  hvd_native_timeline_stop();
+  hvd_native_client_close();
+  hvd_native_rendezvous_stop();
+  hvd_native_shutdown();
+}
+
+// Data loader: producer thread vs consumer, abandoned epochs with a
+// full prefetch queue, close() racing production — the surface where
+// the round-1 advisor found the non-atomic abort_epoch flag.
+static void stress_data_loader() {
+  const int64_t kRecBytes = 64, kRecs = 256;
+  char path[] = "/tmp/hvd_stress_shard.bin";
+  FILE* f = fopen(path, "wb");
+  CHECK(f != nullptr);
+  std::vector<char> rec(kRecBytes, 7);
+  for (int64_t i = 0; i < kRecs; ++i)
+    fwrite(rec.data(), 1, rec.size(), f);
+  fclose(f);
+
+  const char* paths[] = {path};
+  for (int round = 0; round < 6; ++round) {
+    void* L = hvd_dl_open(paths, 1, kRecBytes, 8, /*capacity=*/2,
+                          /*shuffle=*/1, /*seed=*/round, 0, 1,
+                          /*drop_remainder=*/round % 2);
+    CHECK(L != nullptr);
+    CHECK(hvd_dl_num_records(L) == kRecs);
+    std::vector<uint8_t> out(8 * kRecBytes);
+    for (uint64_t e = 0; e < 4; ++e) {
+      CHECK(hvd_dl_start_epoch(L, e) == 0);
+      // Abandon some epochs mid-drain with the producer parked on the
+      // full queue; drain others fully.
+      int take = (e % 2 == 0) ? 3 : 1 << 20;
+      int64_t n;
+      while (take-- > 0 && (n = hvd_dl_next(L, out.data())) > 0) {
+      }
+    }
+    hvd_dl_close(L);  // close with producer possibly mid-epoch
+  }
+  std::remove(path);
+}
+
+int main() {
+  stress_control_plane();
+  stress_data_loader();
+  if (failures.load() != 0) {
+    std::fprintf(stderr, "STRESS_FAILED: %d checks\n", failures.load());
+    return 1;
+  }
+  std::printf("STRESS_OK\n");
+  return 0;
+}

@@ -62,6 +62,17 @@ def main():
     np.testing.assert_allclose(np.asarray(g[0]), 3.0)  # 1+2
     np.testing.assert_allclose(np.asarray(g[1]), 1.0)  # 0+1
 
+    # grouped_allreduce structure mismatch: IDENTICAL flat payloads but
+    # different per-tensor boundaries must raise, not sum misaligned.
+    from horovod_tpu.ops.validation import CollectiveMismatchError
+    shapes = [(2, 4), (4, 2)] if r == 0 else [(4, 2), (2, 4)]
+    try:
+        hvd.grouped_allreduce(
+            [np.ones(s, np.float32) for s in shapes], average=False)
+        raise AssertionError("expected grouped structure mismatch")
+    except CollectiveMismatchError:
+        pass
+
     # mismatch must raise the precondition error on every process — with
     # an AUTO-generated name, so negotiation meets even though shapes
     # disagree (the content-free naming contract).
