@@ -1,0 +1,87 @@
+"""Checkpoint/resume flow — the reference's §5.4 contract end-to-end.
+
+The reference delegates checkpointing to TF but pins two rules
+(`README.md:74-81`): (a) save on rank 0 only, (b) on restore, broadcast
+rank-0's state so every worker resumes identically. This example runs
+that flow with the TPU-native pieces: `save_step`/`restore_latest`
+(Orbax under the hood, rank-0-only with step discovery + pruning) and
+`broadcast_global_variables`.
+
+Run it twice with the same --ckpt-dir to see the resume path:
+    hvdrun -np 2 python examples/jax_checkpoint_resume.py --steps 30
+    hvdrun -np 2 python examples/jax_checkpoint_resume.py --steps 60
+The second run discovers step 30, restores, broadcasts, and continues
+from there.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.utils import checkpoint as ckpt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30,
+                    help="total steps (including restored progress)")
+    ap.add_argument("--ckpt-dir", default="/tmp/hvd_tpu_resume_example")
+    ap.add_argument("--save-every", type=int, default=10)
+    ap.add_argument("--lr", type=float, default=0.05)
+    args = ap.parse_args()
+
+    hvd.init()
+
+    def loss_fn(params, batch):
+        x, y = batch
+        return ((x @ params["w"] - y) ** 2).mean()
+
+    tx = hvd.DistributedOptimizer(optax.sgd(args.lr))
+    params = {"w": jnp.zeros((3, 1), jnp.float32)}
+    opt_state = tx.init(params)
+
+    # Resume discovery: restore the newest step and broadcast rank-0's
+    # copy so every worker starts from identical state (reference rule
+    # b). `like` gives Orbax the dtype/structure template.
+    start = 0
+    latest = ckpt.latest_step(args.ckpt_dir)
+    if latest is not None:
+        state = ckpt.restore_latest(
+            args.ckpt_dir, like={"params": params, "opt": opt_state,
+                                 "step": 0},
+            broadcast=hvd.num_processes() > 1)
+        params, opt_state = state["params"], state["opt"]
+        start = int(np.asarray(state["step"]))
+        if hvd.rank() == 0:
+            print(f"resumed from step {start}")
+    else:
+        params = hvd.broadcast_global_variables(params, 0)
+
+    step = hvd.make_train_step(loss_fn, tx)
+    rng = np.random.RandomState(7 + hvd.rank())
+    w_true = np.asarray([[1.0], [-2.0], [0.5]], np.float32)
+    loss = None
+    for i in range(start, args.steps):
+        x = rng.randn(32, 3).astype(np.float32)
+        batch = hvd.make_global_batch((x, x @ w_true))
+        params, opt_state, loss = step(params, opt_state, batch)
+        if (i + 1) % args.save_every == 0:
+            # Rank-0-only save (reference rule a); keep the newest 3.
+            ckpt.save_step(args.ckpt_dir, i + 1,
+                           {"params": params, "opt": opt_state,
+                            "step": i + 1})
+    if hvd.rank() == 0 and loss is not None:
+        print(f"final loss {float(loss):.6f} at step {args.steps} "
+              f"(checkpoints in {args.ckpt_dir})")
+
+
+if __name__ == "__main__":
+    main()

@@ -226,20 +226,6 @@ def grouped_allreduce(tensors: Sequence[Any], average: bool = True,
             "grouped_allreduce takes plain arrays (one per call site), "
             "not per_rank inputs; allreduce each per_rank individually")
     arrs = [np.asarray(t) for t in tensors]
-    st = _state.check_initialized()
-    if st.num_processes > 1:
-        # Packing erases per-tensor boundaries from the flat payload's
-        # metadata, so a cross-rank structure disagreement ((2,)+(4,)
-        # vs (4,)+(2,): same flat shape!) would silently sum misaligned
-        # elements. Exchange the exact structure first and raise the
-        # same error category individual allreduces would.
-        from horovod_tpu.ops.validation import CollectiveMismatchError
-        mine = [(tuple(a.shape), str(a.dtype)) for a in arrs]
-        descs = allgather_object(mine)
-        if any(d != descs[0] for d in descs):
-            raise CollectiveMismatchError(
-                f"Mismatched grouped_allreduce structure across ranks: "
-                f"{descs}")
     out: list = [None] * len(arrs)
     # One collective per dtype, order-independent: the caller asked for
     # a grouped op, so all same-dtype tensors pack together even when
@@ -249,9 +235,16 @@ def grouped_allreduce(tensors: Sequence[Any], average: bool = True,
         by_dtype.setdefault(a.dtype, []).append(i)
     for dtype, bucket in by_dtype.items():
         flat = np.concatenate([arrs[i].ravel() for i in bucket])
+        # Packing erases per-tensor boundaries from the flat payload's
+        # metadata ((2,)+(4,) vs (4,)+(2,): same flat shape!), so the
+        # boundary list rides the control-plane negotiation as an
+        # opaque descriptor validated for cross-rank equality — no
+        # extra data-plane collectives.
+        desc = repr([tuple(arrs[i].shape) for i in bucket])
         red = np.asarray(eager.allreduce(
             flat, average=average,
-            name=name and f"{name}_{np.dtype(dtype).name}"))
+            name=name and f"{name}_{np.dtype(dtype).name}",
+            _meta_extra=desc))
         off = 0
         for i in bucket:
             n = arrs[i].size

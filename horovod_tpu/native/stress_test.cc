@@ -54,6 +54,7 @@ void hvd_dl_close(void* handle);
 }
 
 static std::atomic<int> failures{0};
+static std::string g_dir = "/tmp";  // scratch dir (argv[1] overrides)
 
 #define CHECK(cond)                                                     \
   do {                                                                  \
@@ -72,7 +73,8 @@ static void stress_control_plane() {
   CHECK(port > 0);
   CHECK(hvd_native_client_connect("127.0.0.1", port, 10.0) == 0);
 
-  hvd_native_timeline_start("/tmp/hvd_stress_timeline.json");
+  std::string tl = g_dir + "/hvd_stress_timeline.json";
+  hvd_native_timeline_start(tl.c_str());
   hvd_native_stall_configure(0.001, 0.001);
   hvd_native_stall_start_thread();
 
@@ -121,7 +123,8 @@ static void stress_control_plane() {
 // the round-1 advisor found the non-atomic abort_epoch flag.
 static void stress_data_loader() {
   const int64_t kRecBytes = 64, kRecs = 256;
-  char path[] = "/tmp/hvd_stress_shard.bin";
+  std::string shard = g_dir + "/hvd_stress_shard.bin";
+  const char* path = shard.c_str();
   FILE* f = fopen(path, "wb");
   CHECK(f != nullptr);
   std::vector<char> rec(kRecBytes, 7);
@@ -151,7 +154,8 @@ static void stress_data_loader() {
   std::remove(path);
 }
 
-int main() {
+int main(int argc, char** argv) {
+  if (argc > 1) g_dir = argv[1];
   stress_control_plane();
   stress_data_loader();
   if (failures.load() != 0) {

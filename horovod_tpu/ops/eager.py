@@ -87,7 +87,8 @@ def _is_multicontroller(st) -> bool:
 
 
 def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
-                  root_rank: Optional[int], allow_dim0: bool):
+                  root_rank: Optional[int], allow_dim0: bool,
+                  extra: Optional[str] = None):
     """Per-op metadata negotiation over the launcher's rendezvous server.
 
     The runtime equivalent of the reference's coordinator protocol
@@ -121,6 +122,11 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
     meta = {"dtype": str(arr.dtype), "shape": list(arr.shape),
             "op": op, "root": root_rank,
             "ndev": len(_mc_local_devices(st))}
+    if extra is not None:
+        # Caller-supplied descriptor validated for cross-rank equality
+        # (e.g. grouped_allreduce's per-tensor boundaries, which the
+        # flat payload's shape cannot express).
+        meta["extra"] = extra
     # The coordinator consumes its own request from local memory; only
     # non-coordinator requests go over the wire.
     if st.process_rank != 0 and not st.native.kv_set(
@@ -190,6 +196,11 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
                         if root_rank is not None else None),
             allow_dim0_mismatch=allow_dim0,
             native=st.native)
+        extras = [m.get("extra") for m in metas]
+        if any(e != extras[0] for e in extras):
+            raise CollectiveMismatchError(
+                f"Mismatched collective descriptor for {opname} "
+                f"across ranks: {extras}")
     except Exception as exc:
         publish_error(exc)
         raise
@@ -282,13 +293,15 @@ def _run_collective(st, key, fn, data):
     return jitted(data)
 
 
-def allreduce(tensor, average: bool = True, name: Optional[str] = None):
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              _meta_extra: Optional[str] = None):
     """Eager allreduce. Parity: `horovod/tensorflow/__init__.py:43-79`
     (dense path) — sum over ranks, divided by size when `average`.
 
     Accepts a `PerRank`, a plain (replicated) array, or an
     `IndexedSlices` (sparse path: allgather of values+indices,
-    `__init__.py:61-72`).
+    `__init__.py:61-72`). `_meta_extra`: internal — an opaque
+    descriptor validated for cross-rank equality during negotiation.
     """
     from horovod_tpu.ops.sparse import IndexedSlices, allreduce_indexed_slices
     st = _state.check_initialized()
@@ -320,7 +333,8 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None):
             # overcounts by exactly k — divide it back out; ranks are
             # processes here, matching Horovod's process-rank model.
             x = np.asarray(tensor)
-            _mc_negotiate(st, opname, "allreduce", x, None, False)
+            _mc_negotiate(st, opname, "allreduce", x, None, False,
+                          extra=_meta_extra)
             _timeline(st, opname, "TOP_LEVEL", "ALLREDUCE")
             k = st.size // st.num_processes
             nproc = st.num_processes

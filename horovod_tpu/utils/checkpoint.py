@@ -22,8 +22,24 @@ import jax
 import numpy as np
 
 
-def _checkpointer():
+def _checkpointer(solo: bool = False):
+    """Orbax pytree checkpointer.
+
+    ``solo``: restrict Orbax's multihost sync barriers to THIS process.
+    Required for the rank-0-only save path when `jax.distributed` is
+    active: the default checkpointer synchronizes across ALL processes
+    after the write, so a save only rank 0 executes would park rank 0
+    in a barrier the other ranks never join — deadlock (observed with
+    the resume example under hvdrun -np 2).
+    """
     import orbax.checkpoint as ocp
+    if solo and jax.process_count() > 1:
+        me = jax.process_index()
+        return ocp.Checkpointer(
+            ocp.PyTreeCheckpointHandler(),
+            multiprocessing_options=ocp.options.MultiprocessingOptions(
+                primary_host=me, active_processes={me},
+                barrier_sync_key_prefix=f"solo{me}"))
     return ocp.PyTreeCheckpointer()
 
 
@@ -41,7 +57,8 @@ def save(path: str, state: Any, *, force: bool = True,
         return False
     state = jax.tree.map(
         lambda x: np.asarray(x) if not distributed else x, state)
-    _checkpointer().save(os.path.abspath(path), state, force=force)
+    _checkpointer(solo=not distributed).save(
+        os.path.abspath(path), state, force=force)
     return True
 
 
@@ -59,7 +76,9 @@ def restore(path: str, *, like: Optional[Any] = None,
     if like is not None:
         import orbax.checkpoint as ocp
         restore_args = ocp.checkpoint_utils.construct_restore_args(like)
-    restored = _checkpointer().restore(
+    # solo: every process reads the full tree independently (read-only;
+    # no cross-process barriers), then `broadcast` re-synchronizes.
+    restored = _checkpointer(solo=True).restore(
         os.path.abspath(path), item=like, restore_args=restore_args)
     if broadcast:
         import horovod_tpu as hvd

@@ -129,6 +129,22 @@ def test_examples_under_launcher(example):
     assert "final loss" in res.stdout
 
 
+def test_checkpoint_resume_across_launches(tmp_path):
+    """The §5.4 contract under the launcher: run 1 saves on rank 0
+    only; run 2 discovers the newest step, restores, broadcasts, and
+    continues. Regression for the multi-controller deadlock where the
+    rank-0-only Orbax save engaged all-process sync barriers."""
+    common = ["-np", "2", "--", sys.executable,
+              "examples/jax_checkpoint_resume.py",
+              "--save-every", "6", "--ckpt-dir", str(tmp_path)]
+    first = _run(common + ["--steps", "12"])
+    assert first.returncode == 0, first.stdout + first.stderr
+    assert "final loss" in first.stdout
+    second = _run(common + ["--steps", "18"])
+    assert second.returncode == 0, second.stdout + second.stderr
+    assert "resumed from step 12" in second.stdout
+
+
 def test_hvdrun_propagates_failure():
     res = _run(["-np", "2", "--", sys.executable, "-c",
                 "import sys; sys.exit(3)"])
