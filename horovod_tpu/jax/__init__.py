@@ -233,17 +233,21 @@ def grouped_allreduce(tensors: Sequence[Any], average: bool = True,
     by_dtype: dict = {}
     for i, a in enumerate(arrs):
         by_dtype.setdefault(a.dtype, []).append(i)
-    for dtype, bucket in by_dtype.items():
+    # Packing erases per-tensor boundaries from the flat payload's
+    # metadata ((2,)+(4,) vs (4,)+(2,): same flat shape!), so the FULL
+    # group composition rides the control-plane negotiation of every
+    # bucket as an opaque descriptor validated for cross-rank equality
+    # — no extra data-plane collectives, and any disagreement (tensor
+    # boundaries, dtype composition, ordering) raises crisply on the
+    # first bucket. Buckets are named by ordinal, never by dtype, so
+    # disagreeing ranks still negotiate under matching keys instead of
+    # timing out on keys the peer never posts.
+    desc = repr([(tuple(a.shape), str(a.dtype)) for a in arrs])
+    for j, bucket in enumerate(by_dtype.values()):
         flat = np.concatenate([arrs[i].ravel() for i in bucket])
-        # Packing erases per-tensor boundaries from the flat payload's
-        # metadata ((2,)+(4,) vs (4,)+(2,): same flat shape!), so the
-        # boundary list rides the control-plane negotiation as an
-        # opaque descriptor validated for cross-rank equality — no
-        # extra data-plane collectives.
-        desc = repr([tuple(arrs[i].shape) for i in bucket])
         red = np.asarray(eager.allreduce(
             flat, average=average,
-            name=name and f"{name}_{np.dtype(dtype).name}",
+            name=name and f"{name}_g{j}",
             _meta_extra=desc))
         off = 0
         for i in bucket:

@@ -73,6 +73,18 @@ def main():
     except CollectiveMismatchError:
         pass
 
+    # dtype-composition disagreement on a NAMED grouped op must raise
+    # just as crisply: buckets are ordinal-named so disagreeing ranks
+    # negotiate under matching keys, and every bucket carries the full
+    # group descriptor.
+    comp = ([np.ones(2, np.float32), np.ones(2, np.float64)] if r == 0
+            else [np.ones(2, np.float64), np.ones(2, np.float32)])
+    try:
+        hvd.grouped_allreduce(comp, average=False, name="gmix")
+        raise AssertionError("expected grouped composition mismatch")
+    except CollectiveMismatchError:
+        pass
+
     # mismatch must raise the precondition error on every process — with
     # an AUTO-generated name, so negotiation meets even though shapes
     # disagree (the content-free naming contract).

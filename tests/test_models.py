@@ -123,3 +123,18 @@ def test_resnet_train_step_updates_batch_stats(hvd):
     changed = any(not np.allclose(np.asarray(a), np.asarray(b))
                   for a, b in zip(stats_before, stats_after))
     assert changed
+
+
+def test_graft_entry_lowers(hvd):
+    """The driver compile-checks `entry()` on the real chip; this
+    guards its tracing path (model build, example args, jit lowering)
+    on the CPU mesh so a refactor can't silently break the driver's
+    only single-chip signal."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import __graft_entry__
+    fn, args = __graft_entry__.entry()
+    import jax
+    jax.jit(fn).lower(*args)  # tracing + lowering; no compile
