@@ -75,9 +75,13 @@ def main():
         params, opt_state, loss = step(params, opt_state, batch)
         if (i + 1) % args.save_every == 0:
             # Rank-0-only save (reference rule a); keep the newest 3.
+            # block=False: the write runs on background threads so the
+            # step loop keeps the device busy (atexit fences the last
+            # one; ckpt.wait_pending() fences explicitly).
             ckpt.save_step(args.ckpt_dir, i + 1,
                            {"params": params, "opt": opt_state,
-                            "step": i + 1})
+                            "step": i + 1}, block=False)
+    ckpt.wait_pending()  # fence the last async save before exiting
     if hvd.rank() == 0 and loss is not None:
         print(f"final loss {float(loss):.6f} at step {args.steps} "
               f"(checkpoints in {args.ckpt_dir})")

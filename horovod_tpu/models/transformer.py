@@ -331,18 +331,43 @@ def make_lm_train_step(model: TransformerLM,
 
 
 def init_lm_state(model: TransformerLM, tx: optax.GradientTransformation,
-                  rng, mesh, sample_tokens) -> Tuple[Any, Any]:
+                  rng, mesh, sample_tokens, *,
+                  sharded_init: bool = False) -> Tuple[Any, Any]:
     """Initialize and mesh-place (params, opt_state).
 
-    Params are initialized on the default device (`model.init`), unboxed,
-    and placed per their partition annotations (`shard_params`); optimizer
-    state inherits placement from params through `tx.init` under jit.
-    Models too large for one device's HBM need sharded-at-birth init
-    (`jax.jit(model.init, out_shardings=...)`) — not wired up yet.
+    Default path: params are initialized on the default device
+    (`model.init`), unboxed, and placed per their partition annotations
+    (`shard_params`); optimizer state inherits placement from params
+    through `tx.init` under jit.
+
+    ``sharded_init=True``: sharded-at-birth — the init computation
+    itself is jitted with `out_shardings` from the partition
+    annotations, so every device materializes only its own shard and
+    no single device ever holds the full parameter tree. Required once
+    the model outgrows one device's HBM (TP/EP models at scale); same
+    values as the default path (same keys, same program, partitioned
+    by GSPMD).
     """
-    variables = model.init(rng, sample_tokens)
+    if not sharded_init:
+        variables = model.init(rng, sample_tokens)
+        with use(mesh):
+            params = shard_params(mesh, variables["params"])
+            opt_state = jax.jit(tx.init)(params)
+        return params, opt_state
+
+    from jax.sharding import NamedSharding
+    toks = jnp.asarray(sample_tokens)
+    shapes = jax.eval_shape(model.init, rng, toks)
+    specs = param_specs(shapes["params"])
+    out_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs)
+
+    def init_fn(r):
+        return unbox(model.init(r, toks)["params"])
+
     with use(mesh):
-        params = shard_params(mesh, variables["params"])
+        params = jax.jit(init_fn,
+                         out_shardings=out_shardings)(rng)
         opt_state = jax.jit(tx.init)(params)
     return params, opt_state
 

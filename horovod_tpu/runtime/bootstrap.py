@@ -172,6 +172,12 @@ def init(devices: Optional[Sequence] = None,
 
 def shutdown() -> None:
     """Graceful shutdown (parity with `mpi_ops.cc:207-215`, SURVEY §5.3)."""
+    import sys
+    ckpt_mod = sys.modules.get("horovod_tpu.utils.checkpoint")
+    if ckpt_mod is not None:
+        # Fence any in-flight async checkpoint while the interpreter is
+        # still fully alive (atexit is too late for Orbax finalization).
+        ckpt_mod.wait_pending()
     st = _state.global_state()
     with st.lock:
         if not st.initialized:

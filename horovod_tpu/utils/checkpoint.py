@@ -43,20 +43,83 @@ def _checkpointer(solo: bool = False):
     return ocp.PyTreeCheckpointer()
 
 
+_async_state = {"ckpt": None}
+
+
+def _async_checkpointer():
+    """Lazily-built async pytree checkpointer (solo sync scope, like
+    `_checkpointer`); one in-flight save at a time."""
+    import orbax.checkpoint as ocp
+    if _async_state["ckpt"] is None:
+        kwargs = {}
+        if jax.process_count() > 1:
+            me = jax.process_index()
+            kwargs["multiprocessing_options"] = \
+                ocp.options.MultiprocessingOptions(
+                    primary_host=me, active_processes={me},
+                    barrier_sync_key_prefix=f"asolo{me}")
+        _async_state["ckpt"] = ocp.AsyncCheckpointer(
+            ocp.PyTreeCheckpointHandler(), **kwargs)
+        import atexit
+        atexit.register(wait_pending)
+    return _async_state["ckpt"]
+
+
+def wait_pending() -> None:
+    """Block until any in-flight async save commits (no-op otherwise).
+
+    Call it from normal program flow (end of training, before reading
+    the directory); `hvd.shutdown()` calls it too. The atexit
+    registration is best-effort only — Orbax finalization submits new
+    executor work, which the interpreter refuses once shutdown has
+    begun, so a save still in flight when the process simply falls off
+    main() may be discarded (Orbax commits atomically: the directory
+    either appears complete or not at all).
+    """
+    if _async_state["ckpt"] is not None:
+        try:
+            _async_state["ckpt"].wait_until_finished()
+        except Exception as e:  # noqa: BLE001 — shutdown must proceed
+            # Interpreter-shutdown executor race, or the background
+            # write itself failed (ENOSPC, ...). Either way the fence
+            # must not abort hvd.shutdown() mid-teardown (the native
+            # control plane still has to close or peers hang).
+            import sys
+            print(f"horovod_tpu: async checkpoint fence failed ({e!r});"
+                  f" the last save may not have committed",
+                  file=sys.stderr)
+
+
 def save(path: str, state: Any, *, force: bool = True,
-         distributed: bool = False) -> bool:
+         distributed: bool = False, block: bool = True) -> bool:
     """Write `state` (any pytree of arrays) to `path`.
 
     Rank-0-only unless ``distributed`` (Orbax multi-host mode where all
     processes participate in writing their own shards). Returns True if
-    this process wrote.
+    this process wrote (or started writing).
+
+    ``block=False``: async save — the write proceeds on background
+    threads so the train loop keeps stepping (the standard TPU recipe:
+    checkpoint IO must not stall the device). At most one save is in
+    flight; a new one first waits for the previous. `wait_pending()`
+    (also registered atexit) fences explicitly.
     """
     from horovod_tpu.runtime import bootstrap as bs
 
+    if not block and distributed:
+        raise NotImplementedError(
+            "async distributed save is not supported: the all-process "
+            "Orbax commit barrier cannot run on background threads; "
+            "use block=True with distributed=True")
     if not distributed and bs.is_initialized() and bs.rank() != 0:
         return False
     state = jax.tree.map(
         lambda x: np.asarray(x) if not distributed else x, state)
+    if not block and not distributed:
+        ckpt = _async_checkpointer()
+        ckpt.wait_until_finished()
+        ckpt.save(os.path.abspath(path), state, force=force)
+        return True
     _checkpointer(solo=not distributed).save(
         os.path.abspath(path), state, force=force)
     return True
@@ -110,12 +173,16 @@ def latest_step(directory: str) -> Optional[int]:
 
 
 def save_step(directory: str, step: int, state: Any, *,
-              keep: int = 3) -> bool:
+              keep: int = 3, block: bool = True) -> bool:
     """`save()` into `directory/step_{step:08d}`, then prune the lowest
     steps down to `keep` entries — never the one just written (rank 0
-    only)."""
+    only). ``block=False`` saves asynchronously; Orbax commits the
+    directory atomically, so pruning only ever sees finished steps —
+    which also means the in-flight save isn't counted yet and the
+    directory can transiently hold `keep + 1` entries until the next
+    call (or `wait_pending()` + another `save_step`) prunes it."""
     current = f"step_{step:08d}"
-    wrote = save(os.path.join(directory, current), state)
+    wrote = save(os.path.join(directory, current), state, block=block)
     if wrote and keep > 0:
         import shutil
         entries = _step_entries(directory)

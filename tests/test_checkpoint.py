@@ -136,3 +136,41 @@ class TestDiscoveryEdgeCases:
                 "step": jnp.asarray(0)}
         out = ckpt.restore(str(tmp_path / "d"), like=like)
         assert out["params"]["w"].dtype == jnp.bfloat16
+
+
+class TestAsyncSave:
+    def test_async_save_then_restore(self, tmp_path, state, hvd):
+        """block=False returns immediately; wait_pending fences the
+        commit; the restored tree equals what was saved."""
+        import numpy as np
+        assert ckpt.save(str(tmp_path / "a"), state, block=False)
+        ckpt.wait_pending()
+        out = ckpt.restore(str(tmp_path / "a"))
+        np.testing.assert_allclose(np.asarray(out["params"]["w"]),
+                                   np.asarray(state["params"]["w"]))
+
+    def test_async_save_step_discovery_and_pruning(self, tmp_path,
+                                                   state, hvd):
+        for s in (10, 20, 30, 40):
+            ckpt.save_step(str(tmp_path), s, state, keep=2,
+                           block=False)
+        ckpt.wait_pending()
+        assert ckpt.latest_step(str(tmp_path)) == 40
+        # successive saves waited for each other; newest 2-3 remain
+        import os
+        names = [n for n in os.listdir(str(tmp_path))
+                 if n.startswith("step_")]
+        assert "step_00000040" in names and len(names) <= 3
+
+    def test_async_then_sync_interleave(self, tmp_path, state, hvd):
+        ckpt.save(str(tmp_path / "x"), state, block=False)
+        ckpt.wait_pending()
+        ckpt.save(str(tmp_path / "y"), state)  # sync after async
+        out = ckpt.restore(str(tmp_path / "y"))
+        assert int(out["step"]) == int(state["step"])
+
+    def test_async_distributed_rejected(self, tmp_path, state, hvd):
+        import pytest as _pytest
+        with _pytest.raises(NotImplementedError, match="async"):
+            ckpt.save(str(tmp_path / "z"), state, distributed=True,
+                      block=False)

@@ -168,6 +168,32 @@ class TestTransformerLM:
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
             p_plain, p_chunk)
 
+    def test_sharded_at_birth_init(self, hvd):
+        """init_lm_state(sharded_init=True) jits the init with
+        out_shardings so no device materializes the full tree; values
+        must equal the default init path and TP leaves must actually
+        land sharded over ``model``."""
+        import optax
+        toks = np.asarray(_tokens(B=8, S=16, seed=11))
+        mesh = make_mesh(data=2, model=4)
+        model = _tiny_model("blockwise")
+        tx = optax.sgd(0.1)
+        rng = jax.random.PRNGKey(3)
+        p_ref, _ = init_lm_state(model, tx, rng, mesh, toks)
+        p_sh, opt_sh = init_lm_state(model, tx, rng, mesh, toks,
+                                     sharded_init=True)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
+            p_ref, p_sh)
+        embed = p_sh["embed"]
+        spec = embed.sharding.spec
+        assert "model" in str(spec), spec  # vocab-sharded at birth
+        # and the state is usable: one train step runs.
+        step = make_lm_train_step(model, tx, mesh)
+        _, _, loss = step(p_sh, opt_sh, toks)
+        assert np.isfinite(float(loss))
+
     @pytest.mark.parametrize("axes,attn_impl", [
         (dict(data=2, model=2, seq=2), "ring"),
         (dict(data=2, model=2, seq=2), "ulysses"),
