@@ -176,8 +176,9 @@ def shutdown() -> None:
     ckpt_mod = sys.modules.get("horovod_tpu.utils.checkpoint")
     if ckpt_mod is not None:
         # Fence any in-flight async checkpoint while the interpreter is
-        # still fully alive (atexit is too late for Orbax finalization).
-        ckpt_mod.wait_pending()
+        # still fully alive (atexit is too late for Orbax finalization);
+        # swallowing variant — teardown must proceed past a failed save.
+        ckpt_mod._fence_swallowing()
     st = _state.global_state()
     with st.lock:
         if not st.initialized:

@@ -22,24 +22,28 @@ import jax
 import numpy as np
 
 
-def _checkpointer(solo: bool = False):
-    """Orbax pytree checkpointer.
-
-    ``solo``: restrict Orbax's multihost sync barriers to THIS process.
-    Required for the rank-0-only save path when `jax.distributed` is
-    active: the default checkpointer synchronizes across ALL processes
-    after the write, so a save only rank 0 executes would park rank 0
-    in a barrier the other ranks never join — deadlock (observed with
-    the resume example under hvdrun -np 2).
+def _solo_mp_options(prefix: str):
+    """Orbax MultiprocessingOptions restricting sync barriers to THIS
+    process. Required for the rank-0-only save path when
+    `jax.distributed` is active: the default checkpointer synchronizes
+    across ALL processes after the write, so a save only rank 0
+    executes would park rank 0 in a barrier the other ranks never join
+    — deadlock (observed with the resume example under hvdrun -np 2).
     """
     import orbax.checkpoint as ocp
+    me = jax.process_index()
+    return ocp.options.MultiprocessingOptions(
+        primary_host=me, active_processes={me},
+        barrier_sync_key_prefix=f"{prefix}{me}")
+
+
+def _checkpointer(solo: bool = False):
+    """Orbax pytree checkpointer (`solo`: see `_solo_mp_options`)."""
+    import orbax.checkpoint as ocp
     if solo and jax.process_count() > 1:
-        me = jax.process_index()
         return ocp.Checkpointer(
             ocp.PyTreeCheckpointHandler(),
-            multiprocessing_options=ocp.options.MultiprocessingOptions(
-                primary_host=me, active_processes={me},
-                barrier_sync_key_prefix=f"solo{me}"))
+            multiprocessing_options=_solo_mp_options("solo"))
     return ocp.PyTreeCheckpointer()
 
 
@@ -53,41 +57,43 @@ def _async_checkpointer():
     if _async_state["ckpt"] is None:
         kwargs = {}
         if jax.process_count() > 1:
-            me = jax.process_index()
-            kwargs["multiprocessing_options"] = \
-                ocp.options.MultiprocessingOptions(
-                    primary_host=me, active_processes={me},
-                    barrier_sync_key_prefix=f"asolo{me}")
+            kwargs["multiprocessing_options"] = _solo_mp_options("asolo")
         _async_state["ckpt"] = ocp.AsyncCheckpointer(
             ocp.PyTreeCheckpointHandler(), **kwargs)
         import atexit
-        atexit.register(wait_pending)
+        atexit.register(_fence_swallowing)
     return _async_state["ckpt"]
 
 
 def wait_pending() -> None:
     """Block until any in-flight async save commits (no-op otherwise).
 
-    Call it from normal program flow (end of training, before reading
-    the directory); `hvd.shutdown()` calls it too. The atexit
-    registration is best-effort only — Orbax finalization submits new
-    executor work, which the interpreter refuses once shutdown has
-    begun, so a save still in flight when the process simply falls off
-    main() may be discarded (Orbax commits atomically: the directory
-    either appears complete or not at all).
+    STRICT fence: a failed background write (ENOSPC, permissions)
+    re-raises here — this is the user's success signal for the last
+    save, so it must not report success silently. Call it from normal
+    program flow (end of training, before reading the directory).
+    `hvd.shutdown()` and atexit use the swallowing variant instead,
+    because teardown must proceed (the native control plane still has
+    to close or peers hang) and Orbax finalization cannot schedule
+    executor work once interpreter shutdown has begun — a save still
+    in flight when the process simply falls off main() may be
+    discarded (Orbax commits atomically: the directory either appears
+    complete or not at all).
     """
     if _async_state["ckpt"] is not None:
-        try:
-            _async_state["ckpt"].wait_until_finished()
-        except Exception as e:  # noqa: BLE001 — shutdown must proceed
-            # Interpreter-shutdown executor race, or the background
-            # write itself failed (ENOSPC, ...). Either way the fence
-            # must not abort hvd.shutdown() mid-teardown (the native
-            # control plane still has to close or peers hang).
-            import sys
-            print(f"horovod_tpu: async checkpoint fence failed ({e!r});"
-                  f" the last save may not have committed",
-                  file=sys.stderr)
+        _async_state["ckpt"].wait_until_finished()
+
+
+def _fence_swallowing() -> None:
+    """`wait_pending` for teardown paths: never raises."""
+    try:
+        wait_pending()
+    except Exception as e:  # noqa: BLE001 — shutdown must proceed
+        import sys
+        print(f"horovod_tpu: async checkpoint fence failed ({e!r}); "
+              f"the last save may not have committed — call "
+              f"wait_pending() before exiting to surface this",
+              file=sys.stderr)
 
 
 def save(path: str, state: Any, *, force: bool = True,
@@ -120,6 +126,10 @@ def save(path: str, state: Any, *, force: bool = True,
         ckpt.wait_until_finished()
         ckpt.save(os.path.abspath(path), state, force=force)
         return True
+    # The sync path must also fence any in-flight async save: an async
+    # write committing AFTER a sync write to the same path would
+    # silently replace the newer data with the stale save.
+    wait_pending()
     _checkpointer(solo=not distributed).save(
         os.path.abspath(path), state, force=force)
     return True

@@ -18,7 +18,8 @@ def test_timeline_writes_chrome_trace(hvd, tmp_path):
     hvd.allreduce(hvd.per_rank(
         [np.ones((4,), np.float32)] * hvd.size()), name="tl_tensor")
     hvd.stop_timeline()
-    events = json.loads(open(path).read())
+    with open(path) as f:
+        events = json.load(f)
     names = {e.get("name") for e in events}
     assert "process_name" in names      # tensor modeled as a process
     assert "NEGOTIATE" in names
@@ -50,7 +51,8 @@ def test_timeline_step_bracket_covers_jitted_hot_path(hvd, tmp_path):
         params, opt_state, _ = step(params, opt_state, batch)
     hvd.stop_timeline()
 
-    events = json.loads(open(path).read())
+    with open(path) as f:
+        events = json.load(f)
     begins = [e for e in events
               if e.get("name") == "train_step" and e.get("ph") == "B"]
     assert len(begins) == 3, len(begins)
