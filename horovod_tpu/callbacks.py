@@ -50,13 +50,19 @@ class MetricAverager:
         self._st = _state.check_initialized()
 
     def __call__(self, logs: Dict[str, float]) -> Dict[str, float]:
-        from horovod_tpu.ops import eager
+        from horovod_tpu.jax import grouped_allreduce
         out = dict(logs)
         # Sorted for deterministic collective order across ranks
-        # (callbacks.py:71-72).
-        for k in sorted(logs):
-            v = np.asarray(logs[k], np.float64)
-            out[k] = float(np.asarray(eager.allreduce(v, average=True)))
+        # (callbacks.py:71-72); one fused collective for all metrics
+        # instead of one per metric.
+        keys = sorted(logs)
+        if not keys:
+            return out
+        vals = grouped_allreduce(
+            [np.asarray(logs[k], np.float64) for k in keys],
+            average=True, name="metric_avg")
+        for k, v in zip(keys, vals):
+            out[k] = float(np.asarray(v))
         return out
 
 

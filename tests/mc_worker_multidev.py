@@ -37,6 +37,16 @@ def main():
     except ValueError:
         pass
 
+    # Object/grouped APIs under multi-device ownership (k-duplication
+    # corrections must count processes, not devices).
+    objs = hvd.allgather_object({"r": r})
+    assert [o["r"] for o in objs] == [0, 1], objs
+    g = hvd.grouped_allreduce(
+        [np.full((2,), float(r + 1), np.float32),
+         np.full((3,), 2.0 * r, np.float32)], average=False)
+    np.testing.assert_allclose(np.asarray(g[0]), 3.0)  # 1+2
+    np.testing.assert_allclose(np.asarray(g[1]), 2.0)  # 0+2
+
     print(f"MCMD_OK rank={r}")
 
 
