@@ -281,7 +281,8 @@ def make_lm_train_step(model: TransformerLM,
                        tx: optax.GradientTransformation, mesh,
                        *, moe_aux_weight: float = 0.01,
                        donate: bool = True,
-                       loss_chunk: Optional[int] = None) -> Callable:
+                       loss_chunk: Optional[int] = None,
+                       param_pspecs: Any = None) -> Callable:
     """step(params, opt_state, tokens) -> (params, opt_state, loss).
 
     `params` = unboxed pytree placed by `init_lm_state` (TP/EP leaves
@@ -291,6 +292,12 @@ def make_lm_train_step(model: TransformerLM,
     SURVEY §3.2) is inserted by GSPMD because params carry no ``data``
     axis, and XLA's collective combiner provides the tensor-fusion
     batching the reference implements by hand (`docs/tensor-fusion.md`).
+
+    ``param_pspecs``: optional PartitionSpec pytree (e.g. from
+    `lm_fsdp_specs`) pinning the UPDATED params — with FSDP this keeps
+    the new params born ``data``-sharded so donation reuses the sharded
+    buffers and GSPMD lowers the gradient sync as reduce-scatter, not
+    all-reduce-then-slice.
     """
     has_moe = model.moe_every > 0
 
@@ -314,10 +321,17 @@ def make_lm_train_step(model: TransformerLM,
         loss, _ = data_loss(params, tokens, False)
         return loss
 
+    if param_pspecs is not None:
+        from horovod_tpu.parallel.fsdp import constrain_tree
+
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        if param_pspecs is not None:
+            grads = constrain_tree(grads, param_pspecs)
         updates, new_opt = tx.update(grads, opt_state, params)
         new_params = optax.apply_updates(params, updates)
+        if param_pspecs is not None:
+            new_params = constrain_tree(new_params, param_pspecs)
         return new_params, new_opt, loss
 
     jitted = jax.jit(step, donate_argnums=(0, 1) if donate else ())
@@ -332,13 +346,15 @@ def make_lm_train_step(model: TransformerLM,
 
 def init_lm_state(model: TransformerLM, tx: optax.GradientTransformation,
                   rng, mesh, sample_tokens, *,
-                  sharded_init: bool = False) -> Tuple[Any, Any]:
+                  sharded_init: bool = False,
+                  param_pspecs: Any = None) -> Tuple[Any, Any]:
     """Initialize and mesh-place (params, opt_state).
 
     Default path: params are initialized on the default device
     (`model.init`), unboxed, and placed per their partition annotations
-    (`shard_params`); optimizer state inherits placement from params
-    through `tx.init` under jit.
+    (`shard_params`); optimizer slots are pinned to their param's
+    placement (`init_opt_state_sharded` — a bare `jit(tx.init)` would
+    materialize them replicated).
 
     ``sharded_init=True``: sharded-at-birth — the init computation
     itself is jitted with `out_shardings` from the partition
@@ -347,20 +363,32 @@ def init_lm_state(model: TransformerLM, tx: optax.GradientTransformation,
     the model outgrows one device's HBM (TP/EP models at scale); same
     values as the default path (same keys, same program, partitioned
     by GSPMD).
+
+    ``param_pspecs``: explicit PartitionSpec pytree overriding the
+    annotation-derived specs — THE handle for FSDP/ZeRO. Compute it
+    once with `lm_fsdp_specs(...)` and pass the same tree here and to
+    `make_lm_train_step(param_pspecs=)`; one source of truth means the
+    born sharding and the per-step pinning can't drift apart. Implies
+    sharded-at-birth.
     """
-    if not sharded_init:
+    from horovod_tpu.parallel.fsdp import init_opt_state_sharded
+    if not sharded_init and param_pspecs is None:
         variables = model.init(rng, sample_tokens)
         with use(mesh):
             params = shard_params(mesh, variables["params"])
-            opt_state = jax.jit(tx.init)(params)
+            opt_state = init_opt_state_sharded(tx, params)
         return params, opt_state
 
     from jax.sharding import NamedSharding
     toks = jnp.asarray(sample_tokens)
-    shapes = jax.eval_shape(model.init, rng, toks)
-    specs = param_specs(shapes["params"])
+    if param_pspecs is not None:
+        specs = param_pspecs
+    else:
+        shapes = jax.eval_shape(model.init, rng, toks)
+        specs = param_specs(shapes["params"])
     out_shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), specs)
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda s: isinstance(s, P))
 
     def init_fn(r):
         return unbox(model.init(r, toks)["params"])
@@ -368,8 +396,25 @@ def init_lm_state(model: TransformerLM, tx: optax.GradientTransformation,
     with use(mesh):
         params = jax.jit(init_fn,
                          out_shardings=out_shardings)(rng)
-        opt_state = jax.jit(tx.init)(params)
+        opt_state = init_opt_state_sharded(tx, params)
     return params, opt_state
+
+
+def lm_fsdp_specs(model: TransformerLM, rng, sample_tokens, mesh, *,
+                  fsdp_min_elems: Optional[int] = None):
+    """The FSDP-overlaid PartitionSpec pytree for the model's params.
+
+    The single source of truth for a ZeRO run — pass the SAME tree to
+    `init_lm_state(param_pspecs=...)` and
+    `make_lm_train_step(param_pspecs=...)`."""
+    from horovod_tpu.parallel.fsdp import (
+        DEFAULT_MIN_ELEMS, fsdp_param_specs)
+    shapes = jax.eval_shape(model.init, rng,
+                            jnp.asarray(sample_tokens))
+    return fsdp_param_specs(
+        param_specs(shapes["params"]), unbox(shapes["params"]), mesh,
+        min_elems=(DEFAULT_MIN_ELEMS if fsdp_min_elems is None
+                   else fsdp_min_elems))
 
 
 def lm_param_specs(model: TransformerLM, rng, sample_tokens):

@@ -56,6 +56,11 @@ from horovod_tpu.parallel.pipeline import (
     pipeline_apply,
     pipeline_apply_gspmd,
 )
+from horovod_tpu.parallel.fsdp import (
+    fsdp_spec,
+    fsdp_param_specs,
+    fsdp_shardings,
+)
 from horovod_tpu.parallel.expert import (
     MoELayer,
     top_k_gating,
@@ -74,6 +79,7 @@ __all__ = [
     "ring_attention", "ring_attention_gspmd", "ulysses_attention",
     "ulysses_attention_gspmd", "blockwise_attention",
     "PipelineStage", "pipeline_apply", "pipeline_apply_gspmd",
+    "fsdp_spec", "fsdp_param_specs", "fsdp_shardings",
     "MoELayer", "top_k_gating", "expert_alltoall_dispatch",
     "expert_alltoall_combine",
 ]

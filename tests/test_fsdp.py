@@ -1,0 +1,166 @@
+"""FSDP / ZeRO sharding tests (`horovod_tpu.parallel.fsdp`).
+
+Strategy follows the suite's oracle style (SURVEY §4): the FSDP-sharded
+train step must train identically to the replicated-params step — same
+losses, same updated params — while every large leaf (params AND
+optimizer state) is physically 1/|data| per device.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.models.transformer import (
+    TransformerLM, init_lm_state, lm_fsdp_specs, make_lm_train_step,
+)
+from horovod_tpu.parallel.fsdp import (
+    fsdp_param_specs, fsdp_spec,
+)
+from horovod_tpu.parallel.mesh import make_mesh
+
+
+def _tiny_model(attn_impl="blockwise", dtype=jnp.float32):
+    return TransformerLM(vocab_size=64, num_layers=2, num_heads=4,
+                         head_dim=8, max_len=32, dtype=dtype,
+                         attn_impl=attn_impl)
+
+
+def _tokens(B=8, S=16, seed=0):
+    return jnp.asarray(np.random.RandomState(seed).randint(0, 64, (B, S)))
+
+
+class TestFsdpSpec:
+    def test_shards_largest_free_dim(self):
+        s = fsdp_spec(P(None, None), (64, 512), 8, min_elems=1)
+        assert s == P(None, "data")
+
+    def test_small_params_stay_replicated(self):
+        s = fsdp_spec(P(), (16,), 8, min_elems=2 ** 16)
+        assert s == P()
+
+    def test_skips_dims_claimed_by_tp(self):
+        # dim1 is model-sharded; overlay must land on dim0.
+        s = fsdp_spec(P(None, "model"), (128, 256), 8, min_elems=1)
+        assert s == P("data", "model")
+
+    def test_no_divisible_dim_is_noop(self):
+        s = fsdp_spec(P(None, None), (9, 7), 8, min_elems=1)
+        assert s == P(None, None)
+
+    def test_already_data_sharded_is_noop(self):
+        s = fsdp_spec(P("data", None), (64, 64), 8, min_elems=1)
+        assert s == P("data", None)
+
+    def test_short_spec_padded(self):
+        # jax convention: entries past the spec length are unsharded.
+        s = fsdp_spec(P("model"), (64, 256), 8, min_elems=1)
+        assert s == P("model", "data")
+
+    def test_axis_size_one_is_noop(self):
+        s = fsdp_spec(P(None, None), (64, 512), 1, min_elems=1)
+        assert s == P(None, None)
+
+    def test_tree_overlay(self):
+        specs = {"big": P(None, None), "tiny": P()}
+        shapes = {"big": jax.ShapeDtypeStruct((64, 128), jnp.float32),
+                  "tiny": jax.ShapeDtypeStruct((8,), jnp.float32)}
+        mesh = make_mesh(data=8)
+        out = fsdp_param_specs(specs, shapes, mesh, min_elems=1024)
+        assert out["big"] == P(None, "data")
+        assert out["tiny"] == P()
+
+
+def _leaf_frac(x):
+    """Fraction of the global array held by one device."""
+    shard = x.addressable_shards[0].data
+    return shard.size / x.size
+
+
+class TestFsdpTraining:
+    def test_matches_replicated_oracle_and_shards_state(self, hvd):
+        """FSDP step == replicated-DP step for 3 steps, while embed /
+        MLP params and Adam mu/nu are physically 1/8 per device."""
+        mesh = make_mesh(data=8)
+        model = _tiny_model()
+        tx = optax.adam(1e-2)
+        rng = jax.random.PRNGKey(0)
+        toks = _tokens()
+
+        # Replicated-DP oracle.
+        p_ref, o_ref = init_lm_state(model, tx, rng, mesh, toks)
+        step_ref = make_lm_train_step(model, tx, mesh)
+
+        # FSDP path: ONE specs tree drives init and step alike.
+        specs = lm_fsdp_specs(model, rng, toks, mesh,
+                              fsdp_min_elems=512)
+        p_f, o_f = init_lm_state(model, tx, rng, mesh, toks,
+                                 param_pspecs=specs)
+        step_f = make_lm_train_step(model, tx, mesh,
+                                    param_pspecs=specs)
+
+        # Born sharded: embed d-dim over data, 1/8 per device …
+        assert "data" in str(p_f["embed"].sharding.spec)
+        assert _leaf_frac(p_f["embed"]) == pytest.approx(1 / 8)
+        # … and so is the optimizer state (ZeRO-1 for free).
+        sharded_opt = [x for x in jax.tree.leaves(o_f)
+                       if hasattr(x, "sharding")
+                       and "data" in str(x.sharding.spec)]
+        assert sharded_opt, "no optimizer slot is data-sharded"
+
+        toks_sh = jax.device_put(
+            toks, NamedSharding(mesh, P("data", None)))
+        for i in range(3):
+            p_ref, o_ref, l_ref = step_ref(p_ref, o_ref, toks_sh)
+            p_f, o_f, l_f = step_f(p_f, o_f, toks_sh)
+            np.testing.assert_allclose(float(l_f), float(l_ref),
+                                       rtol=1e-4,
+                                       err_msg=f"step {i}")
+        # Updated params still sharded (donation-stable layout) …
+        assert "data" in str(p_f["embed"].sharding.spec)
+        # … and numerically equal to the replicated oracle.
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+            p_f, p_ref)
+
+    def test_composes_with_tensor_parallel(self, hvd):
+        """fsdp(data=4) × tp(model=2): runs, converges with finite loss,
+        TP axes intact on the TP leaves."""
+        mesh = make_mesh(data=4, model=2)
+        model = _tiny_model()
+        tx = optax.sgd(0.1)
+        rng = jax.random.PRNGKey(1)
+        toks = _tokens(seed=3)
+
+        specs = lm_fsdp_specs(model, rng, toks, mesh,
+                              fsdp_min_elems=512)
+        # embed: vocab over model (TP) + d over data (FSDP).
+        assert specs["embed"] == P("model", "data")
+        p, o = init_lm_state(model, tx, rng, mesh, toks,
+                             param_pspecs=specs)
+        step = make_lm_train_step(model, tx, mesh, param_pspecs=specs)
+        toks_sh = jax.device_put(
+            toks, NamedSharding(mesh, P("data", None)))
+        losses = []
+        for _ in range(3):
+            p, o, loss = step(p, o, toks_sh)
+            losses.append(float(loss))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]  # it actually trains
+        spec = p["embed"].sharding.spec
+        assert "model" in str(spec) and "data" in str(spec)
+
+    def test_small_leaves_stay_replicated(self, hvd):
+        mesh = make_mesh(data=8)
+        model = _tiny_model()
+        toks = _tokens()
+        rng = jax.random.PRNGKey(0)
+        specs = lm_fsdp_specs(model, rng, toks, mesh,
+                              fsdp_min_elems=512)
+        # LayerNorm scale (32 elems) is below the threshold.
+        ln = specs["block_0"]["ln_attn"]["scale"]
+        assert ln == P()
