@@ -224,6 +224,9 @@ def main():
                     help="skip the Pallas flash-attention hardware "
                          "proof")
     ap.add_argument("--init-timeout", type=float, default=90.0)
+    ap.add_argument("--retries", type=int, default=2,
+                    help="re-attempts after a transient tunnel/backend "
+                         "error (remote_compile drops mid-run)")
     ap.add_argument("--remat", action="store_true",
                     help="jax.checkpoint the forward (fit larger batch)")
     ap.add_argument("--seq", type=int, default=2048,
@@ -259,14 +262,8 @@ def main():
 
     try:
         import jax
-        import jax.numpy as jnp
-        import numpy as np
-        import optax
 
         import horovod_tpu as hvd
-        from horovod_tpu import models
-        from horovod_tpu.models import make_cnn_train_step
-        from horovod_tpu.models.train import init_cnn_state
 
         hvd.init(devices=devices)
         n_chips = hvd.size()
@@ -277,134 +274,166 @@ def main():
         log(f"devices: {devices} (platform={platform}, "
             f"kind={device_kind}, world={n_chips})")
 
-        if is_lm:
-            r = run_transformer(args, devices, n_chips, log)
-            peak = PEAK_BF16.get(device_kind)
-            emit({
-                "metric": metric,
-                "value": round(r["tok_s_chip"], 1),
-                "unit": unit,
-                "vs_baseline": None,  # no LM in the reference (2017)
-                "platform": platform,
-                "device_kind": device_kind,
-                "chips": n_chips,
-                "per_chip_batch": args.batch,
-                "seq": args.seq,
-                "params_m": round(r["n_params"] / 1e6, 1),
-                "step_ms": round(r["step_ms"], 1),
-                "attn_impl": args.attn_impl,
-                "mfu_estimate": round(
-                    r["tok_s_chip"] * r["flops_per_tok"] / peak, 4)
-                if peak else None,
-            })
-            return
-
-        if args.model == "mnist":
-            model = models.MnistConvNet(dtype=jnp.float32)
-            shape = (1, 28, 28, 1)
-            num_classes = 10
-        elif args.model == "vgg16":
-            model = models.VGG16(num_classes=1000)
-            shape = (1, args.image_size, args.image_size, 3)
-            num_classes = 1000
-        elif args.model == "inception3":
-            model = models.InceptionV3(num_classes=1000)
-            shape = (1, max(args.image_size, 299),
-                     max(args.image_size, 299), 3)
-            num_classes = 1000
-        else:
-            cls = (models.ResNet50 if args.model == "resnet50"
-                   else models.ResNet101)
-            model = cls(num_classes=1000)
-            shape = (1, args.image_size, args.image_size, 3)
-            num_classes = 1000
-
-        tx = optax.sgd(0.1, momentum=0.9)
-        rng = jax.random.PRNGKey(0)
-        log("initializing params...")
-        state = init_cnn_state(model, tx, rng,
-                               jnp.zeros(shape, jnp.bfloat16))
-
-        global_batch = args.batch * n_chips
-        x = np.random.RandomState(0).randn(
-            global_batch, *shape[1:]).astype(np.float32)
-        y = np.random.RandomState(1).randint(
-            0, num_classes, size=(global_batch,))
-        x = jnp.asarray(x, jnp.bfloat16)
-        y = jnp.asarray(y)
-
-        def run(threshold):
-            step = make_cnn_train_step(model, tx,
-                                       fusion_threshold=threshold,
-                                       remat=args.remat)
-            # Fresh state per run: the step donates its input buffers,
-            # so a sweep's second run would otherwise read deleted
-            # arrays.
-            st0 = jax.tree.map(jnp.array, state)
-            st, loss, dt, compile_s = time_steps(
-                step, st0, (x, y), rng, args.steps, args.warmup)
-            img_s = args.steps * global_batch / dt
-            log(f"{args.model} thr={threshold}: {img_s:.1f} img/s "
-                f"({img_s / n_chips:.1f}/chip, "
-                f"step {dt / args.steps * 1e3:.1f} ms, "
-                f"warmup {compile_s:.1f}s, loss={loss:.3f})")
-            return img_s
-
-        sweep = None
-        if args.sweep_fusion:
-            sweep = {}
-            for tok in args.sweep_fusion.split(","):
-                thr = int(tok)
-                sweep[str(thr)] = round(run(thr) / n_chips, 2)
-            img_s_chip = max(sweep.values())
-        else:
-            img_s_chip = run(args.fusion_threshold) / n_chips
-
-        # MFU estimate: analytic training FLOPs over the chip's bf16
-        # peak — coarse but honest (stated per VERDICT r1 next-#2).
-        mfu = None
-        peak = PEAK_BF16.get(device_kind)
-        if peak:
-            # Analytic table assumes the canonical resolution; conv
-            # FLOPs scale with pixel count.
-            base = 299 if args.model == "inception3" else 224
-            scale = 1.0 if args.model == "mnist" else \
-                (shape[1] / base) ** 2
-            gflops = TRAIN_GFLOPS_PER_IMG[args.model] * scale
-            mfu = round(img_s_chip * gflops * 1e9 / peak, 4)
-
-        flash_ms = flash_err = None
-        if not args.no_flash:
+        # The tunneled backend's remote_compile can drop mid-run
+        # ("read body: response body closed…", observed r2) — an
+        # infrastructure flake, not a benchmark failure. Retry before
+        # reporting.
+        transient = ("remote_compile", "read body", "UNAVAILABLE",
+                     "DEADLINE_EXCEEDED", "Connection reset")
+        for attempt in range(max(1, args.retries + 1)):
             try:
-                flash_ms = flash_attention_proof(platform)
-            except Exception as e:  # noqa: BLE001 — report, don't die
-                flash_err = repr(e)
-
-        result = {
-            "metric": metric,
-            "value": round(img_s_chip, 2),
-            "unit": unit,
-            "vs_baseline": round(img_s_chip / P100_RESNET101_IMG_S, 3)
-            if args.model == "resnet101" else None,
-            "platform": platform,
-            "device_kind": device_kind,
-            "chips": n_chips,
-            "per_chip_batch": args.batch,
-            "mfu_estimate": mfu,
-        }
-        if sweep is not None:
-            result["sweep_fusion_img_s_per_chip"] = sweep
-        if flash_ms is not None:
-            result["flash_attn_ms"] = flash_ms
-        if flash_err is not None:
-            result["flash_attn_error"] = flash_err
-        emit(result)
+                _bench_body(args, devices, n_chips, metric, unit)
+                return
+            except Exception as e:  # noqa: BLE001 — retry filter
+                if (attempt < args.retries
+                        and any(t in repr(e) for t in transient)):
+                    log(f"transient backend error (attempt "
+                        f"{attempt + 1}): {e!r}; retrying")
+                    continue
+                raise
     except SystemExit:
         raise
     except Exception as e:  # noqa: BLE001 — diagnostic path
         import traceback
         traceback.print_exc(file=sys.stderr)
         fail(metric, unit, "benchmark_failed", repr(e))
+
+
+def _bench_body(args, devices, n_chips, metric, unit):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu import models
+    from horovod_tpu.models import make_cnn_train_step
+    from horovod_tpu.models.train import init_cnn_state
+
+    is_lm = args.model == "transformer"
+    platform = devices[0].platform
+    device_kind = getattr(devices[0], "device_kind", platform)
+    if is_lm:
+        r = run_transformer(args, devices, n_chips, log)
+        peak = PEAK_BF16.get(device_kind)
+        emit({
+            "metric": metric,
+            "value": round(r["tok_s_chip"], 1),
+            "unit": unit,
+            "vs_baseline": None,  # no LM in the reference (2017)
+            "platform": platform,
+            "device_kind": device_kind,
+            "chips": n_chips,
+            "per_chip_batch": args.batch,
+            "seq": args.seq,
+            "params_m": round(r["n_params"] / 1e6, 1),
+            "step_ms": round(r["step_ms"], 1),
+            "attn_impl": args.attn_impl,
+            "mfu_estimate": round(
+                r["tok_s_chip"] * r["flops_per_tok"] / peak, 4)
+            if peak else None,
+        })
+        return
+
+    if args.model == "mnist":
+        model = models.MnistConvNet(dtype=jnp.float32)
+        shape = (1, 28, 28, 1)
+        num_classes = 10
+    elif args.model == "vgg16":
+        model = models.VGG16(num_classes=1000)
+        shape = (1, args.image_size, args.image_size, 3)
+        num_classes = 1000
+    elif args.model == "inception3":
+        model = models.InceptionV3(num_classes=1000)
+        shape = (1, max(args.image_size, 299),
+                 max(args.image_size, 299), 3)
+        num_classes = 1000
+    else:
+        cls = (models.ResNet50 if args.model == "resnet50"
+               else models.ResNet101)
+        model = cls(num_classes=1000)
+        shape = (1, args.image_size, args.image_size, 3)
+        num_classes = 1000
+
+    tx = optax.sgd(0.1, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    log("initializing params...")
+    state = init_cnn_state(model, tx, rng,
+                           jnp.zeros(shape, jnp.bfloat16))
+
+    global_batch = args.batch * n_chips
+    x = np.random.RandomState(0).randn(
+        global_batch, *shape[1:]).astype(np.float32)
+    y = np.random.RandomState(1).randint(
+        0, num_classes, size=(global_batch,))
+    x = jnp.asarray(x, jnp.bfloat16)
+    y = jnp.asarray(y)
+
+    def run(threshold):
+        step = make_cnn_train_step(model, tx,
+                                   fusion_threshold=threshold,
+                                   remat=args.remat)
+        # Fresh state per run: the step donates its input buffers,
+        # so a sweep's second run would otherwise read deleted
+        # arrays.
+        st0 = jax.tree.map(jnp.array, state)
+        st, loss, dt, compile_s = time_steps(
+            step, st0, (x, y), rng, args.steps, args.warmup)
+        img_s = args.steps * global_batch / dt
+        log(f"{args.model} thr={threshold}: {img_s:.1f} img/s "
+            f"({img_s / n_chips:.1f}/chip, "
+            f"step {dt / args.steps * 1e3:.1f} ms, "
+            f"warmup {compile_s:.1f}s, loss={loss:.3f})")
+        return img_s
+
+    sweep = None
+    if args.sweep_fusion:
+        sweep = {}
+        for tok in args.sweep_fusion.split(","):
+            thr = int(tok)
+            sweep[str(thr)] = round(run(thr) / n_chips, 2)
+        img_s_chip = max(sweep.values())
+    else:
+        img_s_chip = run(args.fusion_threshold) / n_chips
+
+    # MFU estimate: analytic training FLOPs over the chip's bf16
+    # peak — coarse but honest (stated per VERDICT r1 next-#2).
+    mfu = None
+    peak = PEAK_BF16.get(device_kind)
+    if peak:
+        # Analytic table assumes the canonical resolution; conv
+        # FLOPs scale with pixel count.
+        base = 299 if args.model == "inception3" else 224
+        scale = 1.0 if args.model == "mnist" else \
+            (shape[1] / base) ** 2
+        gflops = TRAIN_GFLOPS_PER_IMG[args.model] * scale
+        mfu = round(img_s_chip * gflops * 1e9 / peak, 4)
+
+    flash_ms = flash_err = None
+    if not args.no_flash:
+        try:
+            flash_ms = flash_attention_proof(platform)
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            flash_err = repr(e)
+
+    result = {
+        "metric": metric,
+        "value": round(img_s_chip, 2),
+        "unit": unit,
+        "vs_baseline": round(img_s_chip / P100_RESNET101_IMG_S, 3)
+        if args.model == "resnet101" else None,
+        "platform": platform,
+        "device_kind": device_kind,
+        "chips": n_chips,
+        "per_chip_batch": args.batch,
+        "mfu_estimate": mfu,
+    }
+    if sweep is not None:
+        result["sweep_fusion_img_s_per_chip"] = sweep
+    if flash_ms is not None:
+        result["flash_attn_ms"] = flash_ms
+    if flash_err is not None:
+        result["flash_attn_error"] = flash_err
+    emit(result)
 
 
 if __name__ == "__main__":
