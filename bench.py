@@ -282,7 +282,8 @@ def main():
                      "DEADLINE_EXCEEDED", "Connection reset")
         for attempt in range(max(1, args.retries + 1)):
             try:
-                _bench_body(args, devices, n_chips, metric, unit)
+                _bench_body(args, devices, n_chips, metric, unit,
+                            platform, device_kind)
                 return
             except Exception as e:  # noqa: BLE001 — retry filter
                 if (attempt < args.retries
@@ -299,7 +300,8 @@ def main():
         fail(metric, unit, "benchmark_failed", repr(e))
 
 
-def _bench_body(args, devices, n_chips, metric, unit):
+def _bench_body(args, devices, n_chips, metric, unit,
+                platform, device_kind):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -310,8 +312,6 @@ def _bench_body(args, devices, n_chips, metric, unit):
     from horovod_tpu.models.train import init_cnn_state
 
     is_lm = args.model == "transformer"
-    platform = devices[0].platform
-    device_kind = getattr(devices[0], "device_kind", platform)
     if is_lm:
         r = run_transformer(args, devices, n_chips, log)
         peak = PEAK_BF16.get(device_kind)

@@ -10,6 +10,7 @@ Run (8 virtual CPU devices or a v5e-8 host):
   python examples/transformer_lm.py --steps 20
   python examples/transformer_lm.py --attn ulysses --data 2 --seq 2 --model 2
   python examples/transformer_lm.py --moe-every 2 --expert 2 --seq 1
+  python examples/transformer_lm.py --fsdp --data 4 --seq 1 --model 2
 """
 
 import argparse
@@ -39,6 +40,9 @@ def main():
     ap.add_argument("--model", type=int, default=2)
     ap.add_argument("--expert", type=int, default=1)
     ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--fsdp", action="store_true",
+                    help="ZeRO: shard params + optimizer state over "
+                         "the data axis (parallel/fsdp.py)")
     args = ap.parse_args()
 
     import jax
@@ -49,7 +53,8 @@ def main():
     import horovod_tpu as hvd
     from horovod_tpu import parallel as par
     from horovod_tpu.models.transformer import (
-        TransformerLM, init_lm_state, make_lm_train_step)
+        TransformerLM, init_lm_state, lm_fsdp_specs,
+        make_lm_train_step)
 
     hvd.init()
     mesh = par.make_mesh(data=args.data, seq=args.seq,
@@ -67,9 +72,13 @@ def main():
     tx = optax.adamw(args.lr)
     rng = np.random.RandomState(0)
     sample = rng.randint(0, args.vocab, (args.batch, args.seq_len))
+    # One specs tree drives both init placement and per-step pinning.
+    pspecs = (lm_fsdp_specs(model, jax.random.PRNGKey(0), sample, mesh)
+              if args.fsdp else None)
     params, opt_state = init_lm_state(
-        model, tx, jax.random.PRNGKey(0), mesh, sample)
-    step = make_lm_train_step(model, tx, mesh)
+        model, tx, jax.random.PRNGKey(0), mesh, sample,
+        param_pspecs=pspecs)
+    step = make_lm_train_step(model, tx, mesh, param_pspecs=pspecs)
 
     tok_sharding = NamedSharding(mesh, P("data", "seq"))
     t0 = time.time()
