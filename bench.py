@@ -153,6 +153,46 @@ def flash_attention_proof(platform):
     return round(ms, 2)
 
 
+def run_decode(args, devices, n_chips, log):
+    """Autoregressive inference throughput (tokens/sec/chip): the
+    KV-cache `generate` loop on the flagship LM — the serving-side
+    number the training tokens/sec pairs with."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from horovod_tpu.models.transformer import TransformerLM, generate
+    from horovod_tpu.parallel.tensor import unbox
+
+    model = TransformerLM(
+        vocab_size=32768, num_layers=args.layers,
+        num_heads=args.heads, head_dim=args.head_dim,
+        max_len=args.seq, dtype=jnp.bfloat16,
+        attn_impl=args.attn_impl)
+    B, P, steps = args.batch, 32, args.decode_steps
+    params = unbox(model.init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((B, 64), jnp.int32))["params"])
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(params))
+    prompt = np.random.RandomState(0).randint(0, 32768, (B, P))
+    log(f"decode: {n_params / 1e6:.1f}M params, B={B}, prompt={P}, "
+        f"steps={steps}")
+    t0 = time.time()
+    out = generate(model, params, prompt, steps=steps)
+    np.asarray(out)  # full device->host fence (see time_steps)
+    log(f"decode compiled+first run in {time.time() - t0:.1f}s")
+    t0 = time.time()
+    out = generate(model, params, prompt, steps=steps)
+    np.asarray(out)
+    dt = time.time() - t0
+    tok_s = B * steps / dt
+    log(f"decode: {tok_s:.1f} tokens/s "
+        f"({dt / steps * 1e3:.2f} ms/tick at B={B})")
+    return {"tok_s_chip": tok_s / n_chips, "n_params": n_params,
+            "ms_per_tick": dt / steps * 1e3}
+
+
 def run_transformer(args, devices, n_chips, log):
     """Flagship transformer-LM throughput: tokens/sec/chip with the
     Pallas flash-attention kernel in the hot path (no reference
@@ -240,13 +280,18 @@ def main():
     ap.add_argument("--loss-chunk", type=int, default=None,
                     help="transformer: fused head+loss scanned over "
                          "seq chunks (no [B,S,V] logits)")
+    ap.add_argument("--decode", action="store_true",
+                    help="transformer: benchmark KV-cache inference "
+                         "(generate) instead of training")
+    ap.add_argument("--decode-steps", type=int, default=256)
     args = ap.parse_args()
 
     is_lm = args.model == "transformer"
     if args.batch is None:
         args.batch = 8 if is_lm else 128
-    metric = (f"transformer_tokens_per_sec_per_chip" if is_lm
-              else f"{args.model}_images_per_sec_per_chip")
+    metric = (("transformer_decode_tokens_per_sec_per_chip"
+               if args.decode else "transformer_tokens_per_sec_per_chip")
+              if is_lm else f"{args.model}_images_per_sec_per_chip")
     unit = "tokens/sec/chip" if is_lm else "images/sec/chip"
 
     import os
@@ -312,6 +357,23 @@ def _bench_body(args, devices, n_chips, metric, unit,
     from horovod_tpu.models.train import init_cnn_state
 
     is_lm = args.model == "transformer"
+    if is_lm and args.decode:
+        r = run_decode(args, devices, n_chips, log)
+        emit({
+            "metric": metric,
+            "value": round(r["tok_s_chip"], 1),
+            "unit": unit,
+            "vs_baseline": None,  # reference has no inference path
+            "platform": platform,
+            "device_kind": device_kind,
+            "chips": n_chips,
+            "per_chip_batch": args.batch,
+            "seq": args.seq,
+            "params_m": round(r["n_params"] / 1e6, 1),
+            "ms_per_tick": round(r["ms_per_tick"], 2),
+            "decode_steps": args.decode_steps,
+        })
+        return
     if is_lm:
         r = run_transformer(args, devices, n_chips, log)
         peak = PEAK_BF16.get(device_kind)

@@ -112,24 +112,31 @@ class TransformerBlock(nn.Module):
     moe: bool = False
     num_experts: int = 8
     moe_k: int = 2
+    moe_capacity_factor: float = 1.25
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         d = x.shape[-1]
-        attn_fn = make_attn_fn(self.attn_impl)
+        # Decode ticks attend against the KV cache inside the attention
+        # module; the training attn_fn (flash/ring/...) is bypassed.
+        attn_fn = None if self.decode else make_attn_fn(self.attn_impl)
         mask = None
-        if attn_fn is None:  # dot baseline materializes the causal mask
+        if attn_fn is None and not self.decode:
+            # dot baseline materializes the causal mask
             S = x.shape[-2]
             mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
         h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
         h = ParallelSelfAttention(
             num_heads=self.num_heads, head_dim=self.head_dim,
-            dtype=self.dtype, attn_fn=attn_fn, name="attn")(h, mask)
+            dtype=self.dtype, attn_fn=attn_fn, decode=self.decode,
+            name="attn")(h, mask)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
         if self.moe:
             h = MoELayer(num_experts=self.num_experts,
                          hidden=self.mlp_ratio * d, k=self.moe_k,
+                         capacity_factor=self.moe_capacity_factor,
                          dtype=self.dtype, name="moe")(h)
         else:
             h = ParallelMLP(hidden=self.mlp_ratio * d, out=d,
@@ -156,7 +163,9 @@ class TransformerLM(nn.Module):
     moe_every: int = 0          # 0 = dense; n = every n-th block is MoE
     num_experts: int = 8
     moe_k: int = 2
+    moe_capacity_factor: float = 1.25
     remat: bool = False
+    decode: bool = False        # autoregressive inference w/ KV cache
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
@@ -170,7 +179,18 @@ class TransformerLM(nn.Module):
             (self.vocab_size, d), jnp.float32)
         pos = self.param("pos", nn.initializers.normal(0.02),
                          (self.max_len, d), jnp.float32)
-        x = jnp.take(embed, tokens, axis=0) + pos[:S]
+        if self.decode:
+            # Position comes from the running cache index, not the
+            # input offset (tokens arrive one tick at a time).
+            idx = self.variable("cache", "pos_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            p = lax.dynamic_slice_in_dim(pos, idx.value, S, axis=0)
+            if self.has_variable("cache", "pos_index") and \
+                    not self.is_initializing():
+                idx.value = idx.value + S
+        else:
+            p = pos[:S]
+        x = jnp.take(embed, tokens, axis=0) + p
         x = x.astype(self.dtype)
         x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
 
@@ -184,7 +204,8 @@ class TransformerLM(nn.Module):
                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                 attn_impl=self.attn_impl, moe=moe,
                 num_experts=self.num_experts, moe_k=self.moe_k,
-                name=f"block_{i}")(x)
+                moe_capacity_factor=self.moe_capacity_factor,
+                decode=self.decode, name=f"block_{i}")(x)
             x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
 
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
@@ -415,6 +436,97 @@ def lm_fsdp_specs(model: TransformerLM, rng, sample_tokens, mesh, *,
         param_specs(shapes["params"]), unbox(shapes["params"]), mesh,
         min_elems=(DEFAULT_MIN_ELEMS if fsdp_min_elems is None
                    else fsdp_min_elems))
+
+
+def generate(model: TransformerLM, params, prompt, steps: int, *,
+             mesh=None, temperature: float = 0.0, rng=None) -> jax.Array:
+    """Autoregressive generation with a KV cache.
+
+    The reference's inference story is a docs recipe for stripping
+    Horovod ops out of a frozen graph (`docs/inference.md` there); this
+    is the TPU-native inference path in full: a decode-mode clone of the
+    trained model (`decode=True` — K/V cached per block, one
+    `dynamic_update_slice` per tick), driven by one `lax.scan` over
+    prompt + generated positions inside a single jit, TP-composable
+    (pass ``mesh``; the cache keeps heads on ``model``).
+
+    `prompt` [B, P] int tokens; returns [B, P + steps]. Greedy at
+    ``temperature=0``; otherwise softmax sampling with ``rng``.
+    The prompt is teacher-forced tick by tick (prefill and generation
+    share one compiled program — the right trade at small batch; a
+    separate full-prefix prefill pass is the classic follow-up
+    optimization).
+    """
+    prompt = jnp.asarray(prompt)
+    B, P = prompt.shape
+    if temperature > 0 and rng is None:
+        raise ValueError("sampling (temperature > 0) requires rng")
+    if P + steps - 1 > model.max_len:
+        # dynamic_update_slice would clamp writes past the cache end —
+        # plausible-looking garbage, so refuse loudly instead.
+        raise ValueError(
+            f"prompt ({P}) + steps ({steps}) - 1 exceeds "
+            f"max_len={model.max_len}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    dec_model = model.clone(decode=True)
+    # The cache is deterministically zeros; eval_shape gives its
+    # structure without running a full-length forward or materializing
+    # a second copy of the params.
+    shapes = jax.eval_shape(
+        dec_model.init, jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((B, model.max_len), prompt.dtype))
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                         shapes["cache"])
+
+    # Tick i feeds token i; ticks 0..P-2 are teacher-forced to the
+    # prompt, the rest sample freely. Outputs P-1..P+steps-2 are the
+    # generated tokens.
+    n_ticks = P + steps - 1
+    forced = jnp.concatenate(
+        [prompt[:, 1:].T,
+         jnp.zeros((n_ticks - (P - 1), B), prompt.dtype)], axis=0)
+    is_forced = jnp.arange(n_ticks) < (P - 1)
+
+    args = (dec_model, params, cache, prompt, forced, is_forced, rng,
+            P, float(temperature))
+    if mesh is not None:
+        with use(mesh):
+            gen = _generate_scan(*args)
+    else:
+        gen = _generate_scan(*args)
+    return jnp.concatenate([prompt, gen], axis=1)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("dec_model", "P", "temperature"))
+def _generate_scan(dec_model, params, cache, prompt, forced, is_forced,
+                   rng, P, temperature):
+    """The compiled prompt+decode loop — module-level so the jit cache
+    persists across `generate` calls (flax Modules hash by their
+    dataclass fields, so same model config ⇒ cache hit)."""
+    B = prompt.shape[0]
+
+    def tick(carry, inp):
+        cache, tok, r = carry
+        forced_tok, forced_flag = inp
+        logits, mut = dec_model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            mutable=["cache"])
+        logits = logits[:, -1].astype(jnp.float32)
+        r, r_tick = jax.random.split(r)
+        if temperature > 0:
+            nxt = jax.random.categorical(r_tick, logits / temperature)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = jnp.where(forced_flag, forced_tok, nxt)
+        nxt = nxt.astype(prompt.dtype)
+        return (mut["cache"], nxt, r), nxt
+
+    (_, _, _), outs = lax.scan(
+        tick, (cache, prompt[:, 0], rng),
+        (forced, is_forced[:, None].repeat(B, 1)))
+    return outs[P - 1:].T  # [B, steps]
 
 
 def lm_param_specs(model: TransformerLM, rng, sample_tokens):

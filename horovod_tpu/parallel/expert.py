@@ -17,6 +17,7 @@ over ICI. Two surfaces:
 
 from __future__ import annotations
 
+import math
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -94,7 +95,7 @@ class MoELayer(nn.Module):
         for s in lead:
             T *= s
         E = self.num_experts
-        capacity = max(1, int(self.capacity_factor * self.k * T / E))
+        capacity = max(1, math.ceil(self.capacity_factor * self.k * T / E))
 
         router = self.param("router", nn.initializers.lecun_normal(),
                             (d, E), jnp.float32)

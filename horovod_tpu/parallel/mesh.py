@@ -154,7 +154,9 @@ def constrain(x, *spec):
     unsharded reference path in tests), so annotated modules run
     unchanged off-mesh. Axes absent from the context mesh are dropped
     from the spec (a mesh built without ``model`` simply doesn't shard
-    that dim).
+    that dim), and so are axes whose sizes don't divide the dimension
+    (GSPMD cannot shard it — e.g. a batch-1 decode on a data-parallel
+    mesh keeps its activations replicated instead of erroring).
     """
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
@@ -166,13 +168,24 @@ def constrain(x, *spec):
              if t == jax.sharding.AxisType.Auto}
     if not names:
         return x
+    sizes = dict(mesh.shape)
+    assert len(spec) <= x.ndim, (
+        f"constrain spec {spec} has more entries than array rank "
+        f"{x.ndim} (shape {x.shape})")
 
-    def keep(entry):
+    def keep(entry, dim):
         if entry is None or entry is P.UNCONSTRAINED:
             return entry
         if isinstance(entry, (tuple, list)):
-            kept = tuple(e for e in entry if e in names)
-            return kept if kept else None
-        return entry if entry in names else None
+            kept, degree = [], 1
+            for e in entry:
+                if e in names and dim % (degree * sizes[e]) == 0:
+                    kept.append(e)
+                    degree *= sizes[e]
+            return tuple(kept) if kept else None
+        if entry in names and dim % sizes[entry] == 0:
+            return entry
+        return None
 
-    return jax.lax.with_sharding_constraint(x, P(*(keep(s) for s in spec)))
+    return jax.lax.with_sharding_constraint(
+        x, P(*(keep(s, d) for s, d in zip(spec, x.shape))))

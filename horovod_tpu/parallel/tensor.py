@@ -142,12 +142,20 @@ class ParallelSelfAttention(nn.Module):
     `attn_fn` plugs in the inner attention (full softmax by default; a
     Pallas flash kernel or ring attention from
     `horovod_tpu.parallel.sequence` in the flagship model).
+
+    ``decode=True``: autoregressive inference — K/V land in a "cache"
+    collection ([B, max_len, H, D], head dim still ``model``-sharded so
+    TP decode needs no resharding), each call appends the new token at
+    `cache_index` via `dynamic_update_slice` and attends the 1-token
+    query against the filled prefix. Initialize the cache by calling
+    `model.init` on a [B, max_len] dummy (the flax convention).
     """
 
     num_heads: int
     head_dim: int
     dtype: Optional[Dtype] = None
     attn_fn: Optional[Callable] = None
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -170,7 +178,9 @@ class ParallelSelfAttention(nn.Module):
                              AXIS_SEQ, AXIS_MODEL, None)
 
         q, k, v = heads(q), heads(k), heads(v)
-        if self.attn_fn is not None:
+        if self.decode:
+            o = self._decode_attention(q, k, v)
+        elif self.attn_fn is not None:
             o = self.attn_fn(q, k, v, mask)
         else:
             o = dot_product_attention(q, k, v, mask)
@@ -182,6 +192,40 @@ class ParallelSelfAttention(nn.Module):
                           AXIS_SEQ, AXIS_MODEL)
         return RowParallelDense(features, use_bias=False, dtype=self.dtype,
                                 name="out")(o)
+
+    def _decode_attention(self, q, k, v):
+        """One decode tick: append k/v at `cache_index`, attend q
+        against the filled prefix. At cache-init time (`model.init` on
+        a [B, max_len] dummy) the cache is shaped from the full-length
+        k/v and a plain causal forward runs instead."""
+        is_init = self.has_variable("cache", "cached_key")
+        cached_k = self.variable("cache", "cached_key",
+                                 jnp.zeros, k.shape, k.dtype)
+        cached_v = self.variable("cache", "cached_value",
+                                 jnp.zeros, v.shape, v.dtype)
+        index = self.variable("cache", "cache_index",
+                              lambda: jnp.zeros((), jnp.int32))
+        if not is_init:
+            S = q.shape[-3]
+            causal = jnp.tril(jnp.ones((S, S), bool))[None, None]
+            return dot_product_attention(q, k, v, causal)
+
+        S = q.shape[-3]
+        L = cached_k.value.shape[-3]
+        i = index.value
+        z = jnp.zeros((), i.dtype)  # match index dtype under x64
+        key = lax.dynamic_update_slice(cached_k.value, k, (z, i, z, z))
+        val = lax.dynamic_update_slice(cached_v.value, v, (z, i, z, z))
+        cached_k.value = key
+        cached_v.value = val
+        index.value = i + S
+        # Valid positions: the prefix plus the causal part of the new
+        # block — position p attends to cached positions <= i + its
+        # own offset.
+        pos = jnp.arange(L)[None, :]                   # [1, L]
+        qpos = i + jnp.arange(S)[:, None]              # [S, 1]
+        mask = (pos <= qpos)[None, None]               # [1, 1, S, L]
+        return dot_product_attention(q, key, val, mask)
 
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,
