@@ -156,7 +156,9 @@ def flash_attention_proof(platform):
 def run_decode(args, devices, n_chips, log):
     """Autoregressive inference throughput (tokens/sec/chip): the
     KV-cache `generate` loop on the flagship LM — the serving-side
-    number the training tokens/sec pairs with."""
+    number the training tokens/sec pairs with. Runs on the default
+    device only (serving is per-replica), so the result is per-chip by
+    construction regardless of world size."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -189,7 +191,7 @@ def run_decode(args, devices, n_chips, log):
     tok_s = B * steps / dt
     log(f"decode: {tok_s:.1f} tokens/s "
         f"({dt / steps * 1e3:.2f} ms/tick at B={B})")
-    return {"tok_s_chip": tok_s / n_chips, "n_params": n_params,
+    return {"tok_s_chip": tok_s, "n_params": n_params,
             "ms_per_tick": dt / steps * 1e3}
 
 
@@ -366,7 +368,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "vs_baseline": None,  # reference has no inference path
             "platform": platform,
             "device_kind": device_kind,
-            "chips": n_chips,
+            "chips": 1,  # decode runs on the default device only
             "per_chip_batch": args.batch,
             "seq": args.seq,
             "params_m": round(r["n_params"] / 1e6, 1),

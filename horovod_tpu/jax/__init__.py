@@ -93,13 +93,21 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          *, average: bool = True,
                          axis_name: Optional[str] = None,
                          fusion_threshold: Optional[int] = None,
-                         reduce_dtype: Optional[Any] = None
+                         reduce_dtype: Optional[Any] = None,
+                         backward_passes_per_step: int = 1
                          ) -> optax.GradientTransformation:
     """Wrap an optax transformation with gradient allreduce.
 
     Parity: `hvd.DistributedOptimizer` (`horovod/tensorflow/__init__.py:
     127-186`) — same contract (allreduce-average gradients, then delegate
     every other behavior to the wrapped optimizer), SPMD mechanics.
+
+    ``backward_passes_per_step=k`` (later Horovod's gradient
+    accumulation): local gradients accumulate for k microbatch steps
+    (`optax.MultiSteps`) and the allreduce runs ONCE per k, on the
+    accumulated mean — the bandwidth contract the name promises. The
+    returned transformation is marked distributed either way, so
+    `make_train_step` never adds a second allreduce on top.
     """
     def init_fn(params):
         return optimizer.init(params)
@@ -110,7 +118,12 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
             threshold=fusion_threshold, reduce_dtype=reduce_dtype)
         return optimizer.update(updates, opt_state, params, **extra)
 
-    return _DistributedTransformation(init_fn, update_fn)
+    inner = _DistributedTransformation(init_fn, update_fn)
+    if backward_passes_per_step > 1:
+        ms = optax.MultiSteps(
+            inner, every_k_schedule=backward_passes_per_step)
+        return _DistributedTransformation(ms.init, ms.update)
+    return inner
 
 
 class _DistributedTransformation(optax.GradientTransformation):

@@ -185,8 +185,7 @@ class TransformerLM(nn.Module):
             idx = self.variable("cache", "pos_index",
                                 lambda: jnp.zeros((), jnp.int32))
             p = lax.dynamic_slice_in_dim(pos, idx.value, S, axis=0)
-            if self.has_variable("cache", "pos_index") and \
-                    not self.is_initializing():
+            if not self.is_initializing():
                 idx.value = idx.value + S
         else:
             p = pos[:S]
@@ -452,10 +451,9 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
 
     `prompt` [B, P] int tokens; returns [B, P + steps]. Greedy at
     ``temperature=0``; otherwise softmax sampling with ``rng``.
-    The prompt is teacher-forced tick by tick (prefill and generation
-    share one compiled program — the right trade at small batch; a
-    separate full-prefix prefill pass is the classic follow-up
-    optimization).
+    The prompt is prefilled in ONE forward pass (the decode-mode
+    attention masks S>1 blocks causally against the cached prefix), so
+    only the generated tokens pay the per-tick latency.
     """
     prompt = jnp.asarray(prompt)
     B, P = prompt.shape
@@ -479,17 +477,8 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
     cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                          shapes["cache"])
 
-    # Tick i feeds token i; ticks 0..P-2 are teacher-forced to the
-    # prompt, the rest sample freely. Outputs P-1..P+steps-2 are the
-    # generated tokens.
-    n_ticks = P + steps - 1
-    forced = jnp.concatenate(
-        [prompt[:, 1:].T,
-         jnp.zeros((n_ticks - (P - 1), B), prompt.dtype)], axis=0)
-    is_forced = jnp.arange(n_ticks) < (P - 1)
-
-    args = (dec_model, params, cache, prompt, forced, is_forced, rng,
-            P, float(temperature))
+    args = (dec_model, params, cache, prompt, rng, steps,
+            float(temperature))
     if mesh is not None:
         with use(mesh):
             gen = _generate_scan(*args)
@@ -499,34 +488,40 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("dec_model", "P", "temperature"))
-def _generate_scan(dec_model, params, cache, prompt, forced, is_forced,
-                   rng, P, temperature):
-    """The compiled prompt+decode loop — module-level so the jit cache
+                   static_argnames=("dec_model", "steps", "temperature"))
+def _generate_scan(dec_model, params, cache, prompt, rng, steps,
+                   temperature):
+    """The compiled prefill+decode loop — module-level so the jit cache
     persists across `generate` calls (flax Modules hash by their
     dataclass fields, so same model config ⇒ cache hit)."""
-    B = prompt.shape[0]
 
-    def tick(carry, inp):
+    def pick(logits, r):
+        logits = logits[:, -1].astype(jnp.float32)
+        if temperature > 0:
+            nxt = jax.random.categorical(r, logits / temperature)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        return nxt.astype(prompt.dtype)
+
+    # Prefill: the whole prompt in one forward (fills every block's
+    # cache, yields the first generated token).
+    rng, r0 = jax.random.split(rng)
+    logits, mut = dec_model.apply(
+        {"params": params, "cache": cache}, prompt, mutable=["cache"])
+    tok0 = pick(logits, r0)
+
+    def tick(carry, _):
         cache, tok, r = carry
-        forced_tok, forced_flag = inp
+        r, r_tick = jax.random.split(r)
         logits, mut = dec_model.apply(
             {"params": params, "cache": cache}, tok[:, None],
             mutable=["cache"])
-        logits = logits[:, -1].astype(jnp.float32)
-        r, r_tick = jax.random.split(r)
-        if temperature > 0:
-            nxt = jax.random.categorical(r_tick, logits / temperature)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
-        nxt = jnp.where(forced_flag, forced_tok, nxt)
-        nxt = nxt.astype(prompt.dtype)
+        nxt = pick(logits, r_tick)
         return (mut["cache"], nxt, r), nxt
 
     (_, _, _), outs = lax.scan(
-        tick, (cache, prompt[:, 0], rng),
-        (forced, is_forced[:, None].repeat(B, 1)))
-    return outs[P - 1:].T  # [B, steps]
+        tick, (mut["cache"], tok0, rng), None, length=steps - 1)
+    return jnp.concatenate([tok0[:, None], outs.T], axis=1)  # [B, steps]
 
 
 def lm_param_specs(model: TransformerLM, rng, sample_tokens):

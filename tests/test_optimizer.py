@@ -144,3 +144,37 @@ def test_sparse_gradients_allgather_path(hvd):
         np.asarray(gv)[0], np.full((4,), 0.0 / n), rtol=1e-6)
     np.testing.assert_allclose(
         np.asarray(gv)[-1], np.full((4,), (n - 1) / n), rtol=1e-6)
+
+
+def test_multisteps_grad_accumulation(hvd):
+    """`DistributedOptimizer(backward_passes_per_step=k)` (later
+    Horovod's gradient accumulation): k microbatch steps apply exactly
+    one update equal to a single step on the k-fold batch, with the
+    allreduce inside the k-th accumulated update (the marker skips
+    make_train_step's per-microbatch allreduce)."""
+    n = hvd.size()
+    x, y = _make_data(n, per_dev=8)  # 8n rows
+    params = {"w": jnp.zeros((3, 1)), "b": jnp.zeros((1,))}
+
+    # Oracle: one plain step on the full batch.
+    tx_ref = optax.sgd(0.1)
+    _, grads_ref = jax.value_and_grad(_loss_fn)(params, (x, y))
+    updates, _ = tx_ref.update(grads_ref, tx_ref.init(params), params)
+    p_ref = optax.apply_updates(params, updates)
+
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                  backward_passes_per_step=2)
+    step = hvd.make_train_step(_loss_fn, tx)
+    opt_state = tx.init(params)
+    # Snapshot before stepping: the step donates its input buffers.
+    p0 = {k: np.asarray(v) for k, v in params.items()}
+    half = x.shape[0] // 2
+    p, s, _ = step(params, opt_state, (x[:half], y[:half]))
+    # After the first microbatch the update is all-zero (accumulating).
+    for k in p0:
+        np.testing.assert_allclose(np.asarray(p[k]), p0[k])
+    p, s, _ = step(p, s, (x[half:], y[half:]))
+    for k in params:
+        np.testing.assert_allclose(np.asarray(p[k]),
+                                   np.asarray(p_ref[k]),
+                                   rtol=1e-4, atol=1e-6)
