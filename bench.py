@@ -168,7 +168,8 @@ def run_decode(args, devices, n_chips, log):
 
     model = TransformerLM(
         vocab_size=32768, num_layers=args.layers,
-        num_heads=args.heads, head_dim=args.head_dim,
+        num_heads=args.heads, num_kv_heads=args.kv_heads,
+        head_dim=args.head_dim,
         max_len=args.seq, dtype=jnp.bfloat16,
         attn_impl=args.attn_impl)
     B, P, steps = args.batch, 32, args.decode_steps
@@ -212,7 +213,8 @@ def run_transformer(args, devices, n_chips, log):
     mesh = make_mesh(devices=devices, data=n_chips)
     model = TransformerLM(
         vocab_size=32768, num_layers=args.layers,
-        num_heads=args.heads, head_dim=args.head_dim,
+        num_heads=args.heads, num_kv_heads=args.kv_heads,
+        head_dim=args.head_dim,
         max_len=args.seq, dtype=jnp.bfloat16,
         attn_impl=args.attn_impl)
     toks = np.random.RandomState(0).randint(
@@ -275,6 +277,8 @@ def main():
                     help="transformer sequence length")
     ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="GQA: fewer K/V heads (shrinks the KV cache)")
     # head_dim 128 fills the MXU lanes — measured 1.56x over 64.
     ap.add_argument("--head-dim", type=int, default=128)
     ap.add_argument("--attn-impl", default="flash",

@@ -29,6 +29,8 @@ def main():
     ap.add_argument("--vocab", type=int, default=1024)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--kv-heads", type=int, default=None,
+                    help="GQA: fewer K/V heads than query heads")
     ap.add_argument("--head-dim", type=int, default=64)
     ap.add_argument("--attn", default="ring",
                     choices=["dot", "blockwise", "flash", "ring",
@@ -65,7 +67,8 @@ def main():
 
     model = TransformerLM(
         vocab_size=args.vocab, num_layers=args.layers,
-        num_heads=args.heads, head_dim=args.head_dim,
+        num_heads=args.heads, num_kv_heads=args.kv_heads,
+        head_dim=args.head_dim,
         max_len=args.seq_len, attn_impl=args.attn,
         moe_every=args.moe_every, remat=args.remat)
 

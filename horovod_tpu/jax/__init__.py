@@ -122,7 +122,23 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     if backward_passes_per_step > 1:
         ms = optax.MultiSteps(
             inner, every_k_schedule=backward_passes_per_step)
-        return _DistributedTransformation(ms.init, ms.update)
+
+        def ms_update(updates, opt_state, params=None, **extra):
+            # MultiSteps accumulates into dense zeros_like buffers;
+            # an IndexedSlices leaf would hit an opaque tree-arith
+            # error deep inside optax — refuse clearly instead.
+            from horovod_tpu.ops.sparse import IndexedSlices
+            leaves = jax.tree.leaves(
+                updates,
+                is_leaf=lambda x: isinstance(x, IndexedSlices))
+            if any(isinstance(l, IndexedSlices) for l in leaves):
+                raise NotImplementedError(
+                    "backward_passes_per_step > 1 does not support "
+                    "sparse IndexedSlices gradients (densify them or "
+                    "accumulate at k=1)")
+            return ms.update(updates, opt_state, params, **extra)
+
+        return _DistributedTransformation(ms.init, ms_update)
     return inner
 
 

@@ -106,6 +106,7 @@ class TransformerBlock(nn.Module):
 
     num_heads: int
     head_dim: int
+    num_kv_heads: Optional[int] = None
     mlp_ratio: int = 4
     dtype: Optional[Dtype] = jnp.bfloat16
     attn_impl: str = "blockwise"
@@ -129,6 +130,7 @@ class TransformerBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
         h = ParallelSelfAttention(
             num_heads=self.num_heads, head_dim=self.head_dim,
+            num_kv_heads=self.num_kv_heads,
             dtype=self.dtype, attn_fn=attn_fn, decode=self.decode,
             name="attn")(h, mask)
         x = x + h
@@ -156,6 +158,7 @@ class TransformerLM(nn.Module):
     num_layers: int
     num_heads: int
     head_dim: int
+    num_kv_heads: Optional[int] = None   # GQA: fewer K/V heads
     mlp_ratio: int = 4
     max_len: int = 2048
     dtype: Optional[Dtype] = jnp.bfloat16
@@ -200,6 +203,7 @@ class TransformerLM(nn.Module):
             moe = self.moe_every > 0 and (i + 1) % self.moe_every == 0
             x = block_cls(
                 num_heads=self.num_heads, head_dim=self.head_dim,
+                num_kv_heads=self.num_kv_heads,
                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                 attn_impl=self.attn_impl, moe=moe,
                 num_experts=self.num_experts, moe_k=self.moe_k,
@@ -227,6 +231,7 @@ class TransformerBlockStack(nn.Module):
 
     num_heads: int
     head_dim: int
+    num_kv_heads: Optional[int] = None
     layers_per_stage: int = 1
     mlp_ratio: int = 4
     dtype: Optional[Dtype] = jnp.bfloat16
@@ -237,6 +242,7 @@ class TransformerBlockStack(nn.Module):
         for i in range(self.layers_per_stage):
             x = TransformerBlock(
                 num_heads=self.num_heads, head_dim=self.head_dim,
+                num_kv_heads=self.num_kv_heads,
                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                 attn_impl=self.attn_impl, name=f"block_{i}")(x)
         return x
@@ -457,6 +463,8 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
     """
     prompt = jnp.asarray(prompt)
     B, P = prompt.shape
+    if steps <= 0:
+        return prompt
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
     if P + steps - 1 > model.max_len:
@@ -495,8 +503,19 @@ def _generate_scan(dec_model, params, cache, prompt, rng, steps,
     persists across `generate` calls (flax Modules hash by their
     dataclass fields, so same model config ⇒ cache hit)."""
 
+    def last_logits(cache, toks):
+        """Apply one decode call and project ONLY the last position
+        through the LM head — prefill never materializes the
+        [B, P, vocab] logits tensor (the LM's biggest activation, the
+        same one chunked_lm_loss exists to avoid)."""
+        (hidden, embed), mut = dec_model.apply(
+            {"params": params, "cache": cache}, toks,
+            return_hidden=True, mutable=["cache"])
+        logits = jnp.einsum("bd,vd->bv", hidden[:, -1],
+                            embed.astype(hidden.dtype))
+        return logits.astype(jnp.float32), mut["cache"]
+
     def pick(logits, r):
-        logits = logits[:, -1].astype(jnp.float32)
         if temperature > 0:
             nxt = jax.random.categorical(r, logits / temperature)
         else:
@@ -506,21 +525,18 @@ def _generate_scan(dec_model, params, cache, prompt, rng, steps,
     # Prefill: the whole prompt in one forward (fills every block's
     # cache, yields the first generated token).
     rng, r0 = jax.random.split(rng)
-    logits, mut = dec_model.apply(
-        {"params": params, "cache": cache}, prompt, mutable=["cache"])
+    logits, cache = last_logits(cache, prompt)
     tok0 = pick(logits, r0)
 
     def tick(carry, _):
         cache, tok, r = carry
         r, r_tick = jax.random.split(r)
-        logits, mut = dec_model.apply(
-            {"params": params, "cache": cache}, tok[:, None],
-            mutable=["cache"])
+        logits, cache = last_logits(cache, tok[:, None])
         nxt = pick(logits, r_tick)
-        return (mut["cache"], nxt, r), nxt
+        return (cache, nxt, r), nxt
 
     (_, _, _), outs = lax.scan(
-        tick, (mut["cache"], tok0, rng), None, length=steps - 1)
+        tick, (cache, tok0, rng), None, length=steps - 1)
     return jnp.concatenate([tok0[:, None], outs.T], axis=1)  # [B, steps]
 
 

@@ -149,6 +149,14 @@ class ParallelSelfAttention(nn.Module):
     `cache_index` via `dynamic_update_slice` and attends the 1-token
     query against the filled prefix. Initialize the cache by calling
     `model.init` on a [B, max_len] dummy (the flax convention).
+
+    ``num_kv_heads`` (GQA, Ainslie et al. 2023): K/V carry only
+    H_kv < H heads, shared by groups of H/H_kv query heads. The QKV
+    projection and — crucially — the decode KV cache shrink by
+    H/H_kv; K/V are broadcast to the full head count right at the
+    attention (`_repeat_kv`), so every attention kernel (dot, flash,
+    ring, ...) runs unchanged. H_kv = H (default None) is exact MHA
+    with identical parameters.
     """
 
     num_heads: int
@@ -156,34 +164,48 @@ class ParallelSelfAttention(nn.Module):
     dtype: Optional[Dtype] = None
     attn_fn: Optional[Callable] = None
     decode: bool = False
+    num_kv_heads: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array,
                  mask: Optional[jax.Array] = None) -> jax.Array:
-        features = self.num_heads * self.head_dim
-        qkv = ColumnParallelDense(3 * features, use_bias=False,
+        H = self.num_heads
+        Hkv = self.num_kv_heads or H
+        if H % Hkv:
+            raise ValueError(
+                f"num_heads={H} not divisible by num_kv_heads={Hkv}")
+        features = H * self.head_dim
+        kv_features = Hkv * self.head_dim
+        qkv = ColumnParallelDense(features + 2 * kv_features,
+                                  use_bias=False,
                                   dtype=self.dtype, name="qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = qkv[..., :features]
+        k = qkv[..., features:features + kv_features]
+        v = qkv[..., features + kv_features:]
 
-        def heads(t):
-            # [B, ..., S, H*D] -> [B, ..., S, H, D], keeping batch on
+        def heads(t, n):
+            # [B, ..., S, n*D] -> [B, ..., S, n, D], keeping batch on
             # ``data`` and sequence on ``seq`` (a fully-specified
             # constraint with None there would force batch/seq
-            # replication — an all-gather per block). Unbatched [S, H*D]
+            # replication — an all-gather per block). Unbatched [S, n*D]
             # input has no data dim to pin.
-            t = t.reshape(*t.shape[:-1], self.num_heads, self.head_dim)
+            t = t.reshape(*t.shape[:-1], n, self.head_dim)
             if t.ndim == 3:
                 return constrain(t, AXIS_SEQ, AXIS_MODEL, None)
             return constrain(t, AXIS_DATA, *([None] * (t.ndim - 4)),
                              AXIS_SEQ, AXIS_MODEL, None)
 
-        q, k, v = heads(q), heads(k), heads(v)
+        q, k, v = heads(q, H), heads(k, Hkv), heads(v, Hkv)
         if self.decode:
+            # Cache stores the UNREPEATED Hkv heads (the GQA memory
+            # win); _decode_attention broadcasts after the cache read.
             o = self._decode_attention(q, k, v)
         elif self.attn_fn is not None:
-            o = self.attn_fn(q, k, v, mask)
+            o = self.attn_fn(q, self._repeat_kv(k), self._repeat_kv(v),
+                             mask)
         else:
-            o = dot_product_attention(q, k, v, mask)
+            o = dot_product_attention(q, self._repeat_kv(k),
+                                      self._repeat_kv(v), mask)
         o = o.reshape(*o.shape[:-2], features)
         if o.ndim == 2:
             o = constrain(o, AXIS_SEQ, AXIS_MODEL)
@@ -192,6 +214,14 @@ class ParallelSelfAttention(nn.Module):
                           AXIS_SEQ, AXIS_MODEL)
         return RowParallelDense(features, use_bias=False, dtype=self.dtype,
                                 name="out")(o)
+
+    def _repeat_kv(self, t: jax.Array) -> jax.Array:
+        """Broadcast Hkv KV heads to the full H query heads (no-op for
+        MHA). Head axis is -2: [..., S, Hkv, D] -> [..., S, H, D]."""
+        reps = self.num_heads // (self.num_kv_heads or self.num_heads)
+        if reps == 1:
+            return t
+        return jnp.repeat(t, reps, axis=-2)
 
     def _decode_attention(self, q, k, v):
         """One decode tick: append k/v at `cache_index`, attend q
@@ -208,7 +238,8 @@ class ParallelSelfAttention(nn.Module):
         if not is_init:
             S = q.shape[-3]
             causal = jnp.tril(jnp.ones((S, S), bool))[None, None]
-            return dot_product_attention(q, k, v, causal)
+            return dot_product_attention(
+                q, self._repeat_kv(k), self._repeat_kv(v), causal)
 
         S = q.shape[-3]
         L = cached_k.value.shape[-3]
@@ -225,7 +256,8 @@ class ParallelSelfAttention(nn.Module):
         pos = jnp.arange(L)[None, :]                   # [1, L]
         qpos = i + jnp.arange(S)[:, None]              # [S, 1]
         mask = (pos <= qpos)[None, None]               # [1, 1, S, L]
-        return dot_product_attention(q, key, val, mask)
+        return dot_product_attention(q, self._repeat_kv(key),
+                                     self._repeat_kv(val), mask)
 
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,

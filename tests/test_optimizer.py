@@ -178,3 +178,19 @@ def test_multisteps_grad_accumulation(hvd):
         np.testing.assert_allclose(np.asarray(p[k]),
                                    np.asarray(p_ref[k]),
                                    rtol=1e-4, atol=1e-6)
+
+
+def test_backward_passes_rejects_sparse(hvd):
+    """backward_passes_per_step>1 cannot accumulate IndexedSlices into
+    MultiSteps' dense buffers — must refuse clearly, not die inside
+    optax tree arithmetic."""
+    from horovod_tpu.ops.sparse import IndexedSlices
+    tx = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                  backward_passes_per_step=2)
+    params = {"emb": jnp.zeros((4, 2))}
+    state = tx.init(params)
+    sparse = {"emb": IndexedSlices(jnp.ones((1, 2)),
+                                   jnp.array([0], jnp.int32),
+                                   dense_shape=(4, 2))}
+    with pytest.raises(NotImplementedError):
+        tx.update(sparse, state, params)
