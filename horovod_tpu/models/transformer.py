@@ -107,6 +107,8 @@ class TransformerBlock(nn.Module):
     num_heads: int
     head_dim: int
     num_kv_heads: Optional[int] = None
+    pos_emb: str = "none"        # "none" | "rope"
+    rope_theta: float = 10000.0
     mlp_ratio: int = 4
     dtype: Optional[Dtype] = jnp.bfloat16
     attn_impl: str = "blockwise"
@@ -130,7 +132,8 @@ class TransformerBlock(nn.Module):
         h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
         h = ParallelSelfAttention(
             num_heads=self.num_heads, head_dim=self.head_dim,
-            num_kv_heads=self.num_kv_heads,
+            num_kv_heads=self.num_kv_heads, pos_emb=self.pos_emb,
+            rope_theta=self.rope_theta,
             dtype=self.dtype, attn_fn=attn_fn, decode=self.decode,
             name="attn")(h, mask)
         x = x + h
@@ -159,6 +162,8 @@ class TransformerLM(nn.Module):
     num_heads: int
     head_dim: int
     num_kv_heads: Optional[int] = None   # GQA: fewer K/V heads
+    pos_emb: str = "learned"             # "learned" | "rope"
+    rope_theta: float = 10000.0
     mlp_ratio: int = 4
     max_len: int = 2048
     dtype: Optional[Dtype] = jnp.bfloat16
@@ -173,6 +178,10 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens: jax.Array,
                  return_hidden: bool = False) -> Any:
+        if self.pos_emb not in ("learned", "rope"):
+            raise ValueError(
+                f"pos_emb must be 'learned' or 'rope', "
+                f"got {self.pos_emb!r}")
         B, S = tokens.shape
         d = self.num_heads * self.head_dim
         embed = self.param(
@@ -180,19 +189,25 @@ class TransformerLM(nn.Module):
             nn.with_partitioning(nn.initializers.normal(0.02),
                                  (AXIS_MODEL, None)),
             (self.vocab_size, d), jnp.float32)
-        pos = self.param("pos", nn.initializers.normal(0.02),
-                         (self.max_len, d), jnp.float32)
-        if self.decode:
-            # Position comes from the running cache index, not the
-            # input offset (tokens arrive one tick at a time).
-            idx = self.variable("cache", "pos_index",
-                                lambda: jnp.zeros((), jnp.int32))
-            p = lax.dynamic_slice_in_dim(pos, idx.value, S, axis=0)
-            if not self.is_initializing():
-                idx.value = idx.value + S
+        if self.pos_emb == "rope":
+            # Rotary positions live inside the attention (applied to
+            # q/k at absolute positions); no learned table, no
+            # position state outside the per-block KV cache index.
+            x = jnp.take(embed, tokens, axis=0)
         else:
-            p = pos[:S]
-        x = jnp.take(embed, tokens, axis=0) + p
+            pos = self.param("pos", nn.initializers.normal(0.02),
+                             (self.max_len, d), jnp.float32)
+            if self.decode:
+                # Position comes from the running cache index, not the
+                # input offset (tokens arrive one tick at a time).
+                idx = self.variable("cache", "pos_index",
+                                    lambda: jnp.zeros((), jnp.int32))
+                p = lax.dynamic_slice_in_dim(pos, idx.value, S, axis=0)
+                if not self.is_initializing():
+                    idx.value = idx.value + S
+            else:
+                p = pos[:S]
+            x = jnp.take(embed, tokens, axis=0) + p
         x = x.astype(self.dtype)
         x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
 
@@ -204,6 +219,8 @@ class TransformerLM(nn.Module):
             x = block_cls(
                 num_heads=self.num_heads, head_dim=self.head_dim,
                 num_kv_heads=self.num_kv_heads,
+                pos_emb=("rope" if self.pos_emb == "rope" else "none"),
+                rope_theta=self.rope_theta,
                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                 attn_impl=self.attn_impl, moe=moe,
                 num_experts=self.num_experts, moe_k=self.moe_k,
@@ -232,6 +249,8 @@ class TransformerBlockStack(nn.Module):
     num_heads: int
     head_dim: int
     num_kv_heads: Optional[int] = None
+    pos_emb: str = "none"        # "none" | "rope"
+    rope_theta: float = 10000.0
     layers_per_stage: int = 1
     mlp_ratio: int = 4
     dtype: Optional[Dtype] = jnp.bfloat16
@@ -243,6 +262,7 @@ class TransformerBlockStack(nn.Module):
             x = TransformerBlock(
                 num_heads=self.num_heads, head_dim=self.head_dim,
                 num_kv_heads=self.num_kv_heads,
+                pos_emb=self.pos_emb, rope_theta=self.rope_theta,
                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                 attn_impl=self.attn_impl, name=f"block_{i}")(x)
         return x

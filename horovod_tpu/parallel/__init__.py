@@ -39,6 +39,7 @@ from horovod_tpu.parallel.tensor import (
     RowParallelDense,
     ParallelMLP,
     ParallelSelfAttention,
+    apply_rope,
     dot_product_attention,
     param_specs,
     shard_params,
@@ -74,7 +75,7 @@ __all__ = [
     "AXIS_DATA", "AXIS_SEQ", "AXIS_MODEL", "AXIS_PIPE", "AXIS_EXPERT",
     "column_parallel_matmul", "row_parallel_matmul",
     "ColumnParallelDense", "RowParallelDense", "ParallelMLP",
-    "ParallelSelfAttention", "dot_product_attention",
+    "ParallelSelfAttention", "apply_rope", "dot_product_attention",
     "param_specs", "shard_params", "unbox",
     "ring_attention", "ring_attention_gspmd", "ulysses_attention",
     "ulysses_attention_gspmd", "blockwise_attention",

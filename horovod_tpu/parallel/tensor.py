@@ -165,6 +165,8 @@ class ParallelSelfAttention(nn.Module):
     attn_fn: Optional[Callable] = None
     decode: bool = False
     num_kv_heads: Optional[int] = None
+    pos_emb: str = "none"        # "none" | "rope"
+    rope_theta: float = 10000.0
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -198,14 +200,17 @@ class ParallelSelfAttention(nn.Module):
         q, k, v = heads(q, H), heads(k, Hkv), heads(v, Hkv)
         if self.decode:
             # Cache stores the UNREPEATED Hkv heads (the GQA memory
-            # win); _decode_attention broadcasts after the cache read.
+            # win); _decode_attention broadcasts after the cache read
+            # and applies RoPE at the absolute cache position.
             o = self._decode_attention(q, k, v)
-        elif self.attn_fn is not None:
-            o = self.attn_fn(q, self._repeat_kv(k), self._repeat_kv(v),
-                             mask)
         else:
-            o = dot_product_attention(q, self._repeat_kv(k),
-                                      self._repeat_kv(v), mask)
+            q, k = self._maybe_rope(q, k)
+            if self.attn_fn is not None:
+                o = self.attn_fn(q, self._repeat_kv(k),
+                                 self._repeat_kv(v), mask)
+            else:
+                o = dot_product_attention(q, self._repeat_kv(k),
+                                          self._repeat_kv(v), mask)
         o = o.reshape(*o.shape[:-2], features)
         if o.ndim == 2:
             o = constrain(o, AXIS_SEQ, AXIS_MODEL)
@@ -214,6 +219,15 @@ class ParallelSelfAttention(nn.Module):
                           AXIS_SEQ, AXIS_MODEL)
         return RowParallelDense(features, use_bias=False, dtype=self.dtype,
                                 name="out")(o)
+
+    def _maybe_rope(self, q, k, offset=0):
+        """Rotate q/k at absolute positions offset+arange(S) when
+        ``pos_emb == "rope"`` (single site for the rotation rule)."""
+        if self.pos_emb != "rope":
+            return q, k
+        positions = offset + jnp.arange(q.shape[-3])
+        return (apply_rope(q, positions, self.rope_theta),
+                apply_rope(k, positions, self.rope_theta))
 
     def _repeat_kv(self, t: jax.Array) -> jax.Array:
         """Broadcast Hkv KV heads to the full H query heads (no-op for
@@ -237,6 +251,7 @@ class ParallelSelfAttention(nn.Module):
                               lambda: jnp.zeros((), jnp.int32))
         if not is_init:
             S = q.shape[-3]
+            q, k = self._maybe_rope(q, k)
             causal = jnp.tril(jnp.ones((S, S), bool))[None, None]
             return dot_product_attention(
                 q, self._repeat_kv(k), self._repeat_kv(v), causal)
@@ -244,6 +259,9 @@ class ParallelSelfAttention(nn.Module):
         S = q.shape[-3]
         L = cached_k.value.shape[-3]
         i = index.value
+        # Rotate at the ABSOLUTE position; keys enter the cache
+        # already rotated, so the prefix needs no re-rotation.
+        q, k = self._maybe_rope(q, k, offset=i)
         z = jnp.zeros((), i.dtype)  # match index dtype under x64
         key = lax.dynamic_update_slice(cached_k.value, k, (z, i, z, z))
         val = lax.dynamic_update_slice(cached_v.value, v, (z, i, z, z))
@@ -258,6 +276,29 @@ class ParallelSelfAttention(nn.Module):
         mask = (pos <= qpos)[None, None]               # [1, 1, S, L]
         return dot_product_attention(q, self._repeat_kv(key),
                                      self._repeat_kv(val), mask)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float = 10000.0) -> jax.Array:
+    """Rotary position embedding (Su et al. 2021), half-split layout.
+
+    ``x`` [..., S, H, D] with D even; ``positions`` [S] absolute token
+    positions. Rotation is applied before the attention kernel at the
+    LOGICAL level, so it composes unchanged with GSPMD sequence
+    parallelism (ring/Ulysses shard the rotated tensors) and with the
+    KV cache (keys are cached post-rotation at their absolute
+    position).
+    """
+    D = x.shape[-1]
+    half = D // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, None].astype(jnp.float32) * freqs   # [S, half]
+    cos = jnp.cos(angles)[:, None, :]                          # [S, 1, h]
+    sin = jnp.sin(angles)[:, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
 
 
 def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -180,6 +180,80 @@ class TestGenerate:
         assert all(np.isfinite(l) for l in losses)
         assert losses[-1] < losses[0]
 
+    def test_rope_decode_matches_oracle(self, hvd):
+        """RoPE decode: keys cached post-rotation at absolute
+        positions — token-exact vs the full-forward oracle."""
+        model = _tiny_model(pos_emb="rope")
+        prompt = jnp.asarray(
+            np.random.RandomState(14).randint(0, 64, (2, 5)))
+        params = unbox(model.init(
+            jax.random.PRNGKey(15),
+            jnp.zeros((2, 16), jnp.int32))["params"])
+        assert "pos" not in params  # no learned table under rope
+        out = generate(model, params, prompt, steps=7)
+        ref = _oracle_greedy(model, params, prompt, steps=7)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    @pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
+    def test_rope_sequence_parallel_matches_unsharded(self, hvd,
+                                                      sp_impl):
+        """RoPE is applied at the logical level before the attention,
+        so sequence parallelism sees already-rotated q/k — the
+        ring/Ulysses forward over a seq mesh equals the unsharded
+        forward."""
+        from horovod_tpu.parallel.mesh import make_mesh, use
+        from horovod_tpu.parallel.tensor import shard_params
+        toks = _tokens(B=4, S=16, seed=16)
+        ref_model = _tiny_model("blockwise", pos_emb="rope")
+        variables = ref_model.init(jax.random.PRNGKey(17), toks)
+        ref = ref_model.apply(variables, toks)
+
+        mesh = make_mesh(data=2, seq=2, model=2)
+        sp_model = _tiny_model(sp_impl, pos_emb="rope")
+        with use(mesh):
+            params = shard_params(mesh, variables["params"])
+            toks_sh = jax.device_put(
+                toks, NamedSharding(mesh, P("data", "seq")))
+            out = jax.jit(lambda p, t: sp_model.apply(
+                {"params": p}, t))(params, toks_sh)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   atol=2e-4)
+
+    def test_rope_theta_and_validation(self, hvd):
+        """rope_theta reaches the attention (different theta ⇒
+        different logits) and bad pos_emb raises."""
+        toks = _tokens(B=2, S=8, seed=19)
+        m1 = _tiny_model(pos_emb="rope")
+        m2 = _tiny_model(pos_emb="rope", rope_theta=500000.0)
+        variables = m1.init(jax.random.PRNGKey(20), toks)
+        a = m1.apply(variables, toks)
+        b = m2.apply(variables, toks)
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+        bad = _tiny_model(pos_emb="Rope")
+        with pytest.raises(ValueError):
+            bad.init(jax.random.PRNGKey(0), toks)
+
+    def test_rope_trains(self, hvd):
+        import optax
+        from horovod_tpu.models.transformer import (
+            init_lm_state, make_lm_train_step)
+        from horovod_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(data=8)
+        model = _tiny_model(pos_emb="rope")
+        toks = _tokens(seed=18)
+        params, opt = init_lm_state(model, tx := optax.sgd(0.1),
+                                    jax.random.PRNGKey(0), mesh, toks)
+        step = make_lm_train_step(model, tx, mesh)
+        toks_sh = jax.device_put(
+            toks, NamedSharding(mesh, P("data", None)))
+        losses = []
+        for _ in range(3):
+            params, opt, loss = step(params, opt, toks_sh)
+            losses.append(float(loss))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
     def test_moe_decode_matches_when_dropfree(self, hvd):
         """Per-token top-k routing works one tick at a time. Expert
         capacity C = ceil(k·T/E·factor) depends on tokens-per-call, so
