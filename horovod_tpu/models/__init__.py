@@ -16,12 +16,12 @@ from horovod_tpu.models.word2vec import Word2Vec
 from horovod_tpu.models.train import make_cnn_train_step
 from horovod_tpu.models.transformer import (
     TransformerLM, generate, init_lm_state, lm_fsdp_specs,
-    make_lm_train_step,
+    make_lm_eval_step, make_lm_train_step,
 )
 
 __all__ = [
     "MnistConvNet", "ResNet", "ResNet50", "ResNet101", "ResNet152",
     "VGG16", "InceptionV3", "Word2Vec", "make_cnn_train_step",
     "TransformerLM", "generate", "init_lm_state", "lm_fsdp_specs",
-    "make_lm_train_step",
+    "make_lm_eval_step", "make_lm_train_step",
 ]

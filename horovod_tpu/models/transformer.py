@@ -348,15 +348,8 @@ def make_lm_train_step(model: TransformerLM,
     has_moe = model.moe_every > 0
 
     def data_loss(params, tokens, mutable):
-        if loss_chunk:
-            out = model.apply({"params": params}, tokens,
-                              return_hidden=True, mutable=mutable)
-            (hidden, embed), col = out if mutable else (out, {})
-            return chunked_lm_loss(hidden, embed, tokens,
-                                   chunk=loss_chunk), col
-        out = model.apply({"params": params}, tokens, mutable=mutable)
-        logits, col = out if mutable else (out, {})
-        return lm_loss(logits, tokens), col
+        return _lm_data_loss(model, params, tokens, loss_chunk,
+                             mutable)
 
     def loss_fn(params, tokens):
         if has_moe:
@@ -388,6 +381,42 @@ def make_lm_train_step(model: TransformerLM,
 
     from horovod_tpu.utils.timeline import step_bracket
     return step_bracket(wrapped)
+
+
+def _lm_data_loss(model, params, tokens, loss_chunk, mutable):
+    """Chunked-vs-plain loss dispatch shared by the train and eval
+    steps (one site, so the eval==train-loss invariant can't drift)."""
+    if loss_chunk:
+        out = model.apply({"params": params}, tokens,
+                          return_hidden=True, mutable=mutable)
+        (hidden, embed), col = out if mutable else (out, {})
+        return chunked_lm_loss(hidden, embed, tokens,
+                               chunk=loss_chunk), col
+    out = model.apply({"params": params}, tokens, mutable=mutable)
+    logits, col = out if mutable else (out, {})
+    return lm_loss(logits, tokens), col
+
+
+def make_lm_eval_step(model: TransformerLM, mesh, *,
+                      loss_chunk: Optional[int] = None) -> Callable:
+    """eval(params, tokens) -> mean next-token cross entropy (nats).
+
+    The forward-only twin of `make_lm_train_step` — same loss, same
+    sharding, no gradient/optimizer; perplexity = exp(loss). Use
+    `loss_chunk` to keep the [B, S, V] logits from materializing on
+    long sequences (same trade as the train step's option).
+    """
+    def ev(params, tokens):
+        return _lm_data_loss(model, params, tokens, loss_chunk,
+                             False)[0]
+
+    jitted = jax.jit(ev)
+
+    def wrapped(params, tokens):
+        with use(mesh):
+            return jitted(params, tokens)
+
+    return wrapped
 
 
 def init_lm_state(model: TransformerLM, tx: optax.GradientTransformation,
@@ -464,7 +493,9 @@ def lm_fsdp_specs(model: TransformerLM, rng, sample_tokens, mesh, *,
 
 
 def generate(model: TransformerLM, params, prompt, steps: int, *,
-             mesh=None, temperature: float = 0.0, rng=None) -> jax.Array:
+             mesh=None, temperature: float = 0.0, rng=None,
+             top_k: Optional[int] = None,
+             top_p: Optional[float] = None) -> jax.Array:
     """Autoregressive generation with a KV cache.
 
     The reference's inference story is a docs recipe for stripping
@@ -476,7 +507,10 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
     (pass ``mesh``; the cache keeps heads on ``model``).
 
     `prompt` [B, P] int tokens; returns [B, P + steps]. Greedy at
-    ``temperature=0``; otherwise softmax sampling with ``rng``.
+    ``temperature=0``; otherwise softmax sampling with ``rng``,
+    optionally truncated to the ``top_k`` highest-probability tokens
+    and/or the ``top_p`` nucleus (smallest set with cumulative
+    probability >= top_p).
     The prompt is prefilled in ONE forward pass (the decode-mode
     attention masks S>1 blocks causally against the cached prefix), so
     only the generated tokens pay the per-tick latency.
@@ -487,6 +521,14 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
         return prompt
     if temperature > 0 and rng is None:
         raise ValueError("sampling (temperature > 0) requires rng")
+    if (top_k is not None or top_p is not None) and temperature <= 0:
+        raise ValueError("top_k/top_p require temperature > 0")
+    if top_p is not None and not 0 < top_p <= 1:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k is not None and not 1 <= top_k <= model.vocab_size:
+        raise ValueError(
+            f"top_k must be in [1, vocab_size={model.vocab_size}], "
+            f"got {top_k}")
     if P + steps - 1 > model.max_len:
         # dynamic_update_slice would clamp writes past the cache end —
         # plausible-looking garbage, so refuse loudly instead.
@@ -506,7 +548,8 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
                          shapes["cache"])
 
     args = (dec_model, params, cache, prompt, rng, steps,
-            float(temperature))
+            float(temperature), top_k,
+            None if top_p is None else float(top_p))
     if mesh is not None:
         with use(mesh):
             gen = _generate_scan(*args)
@@ -516,9 +559,10 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("dec_model", "steps", "temperature"))
+                   static_argnames=("dec_model", "steps", "temperature",
+                                    "top_k"))
 def _generate_scan(dec_model, params, cache, prompt, rng, steps,
-                   temperature):
+                   temperature, top_k=None, top_p=None):
     """The compiled prefill+decode loop — module-level so the jit cache
     persists across `generate` calls (flax Modules hash by their
     dataclass fields, so same model config ⇒ cache hit)."""
@@ -536,10 +580,26 @@ def _generate_scan(dec_model, params, cache, prompt, rng, steps,
         return logits.astype(jnp.float32), mut["cache"]
 
     def pick(logits, r):
-        if temperature > 0:
-            nxt = jax.random.categorical(r, logits / temperature)
-        else:
-            nxt = jnp.argmax(logits, axis=-1)
+        if temperature <= 0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        logits = logits / temperature
+        neg = jnp.finfo(logits.dtype).min
+        if top_k is not None:
+            kth = lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, neg, logits)
+        if top_p is not None:
+            # Nucleus: keep the smallest prefix of the sorted
+            # distribution with cumulative probability >= top_p.
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            csum = jnp.cumsum(probs, axis=-1)
+            keep = csum - probs < top_p      # first token always kept
+            # Threshold = smallest kept logit; mask everything below.
+            thresh = jnp.min(
+                jnp.where(keep, sorted_logits, jnp.inf),
+                axis=-1, keepdims=True)
+            logits = jnp.where(logits < thresh, neg, logits)
+        nxt = jax.random.categorical(r, logits)
         return nxt.astype(prompt.dtype)
 
     # Prefill: the whole prompt in one forward (fills every block's

@@ -254,6 +254,54 @@ class TestGenerate:
         assert all(np.isfinite(l) for l in losses)
         assert losses[-1] < losses[0]
 
+    def test_top_k_one_equals_greedy(self, hvd):
+        model = _tiny_model()
+        prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+        params = unbox(model.init(
+            jax.random.PRNGKey(21),
+            jnp.zeros((1, 16), jnp.int32))["params"])
+        greedy = generate(model, params, prompt, steps=6)
+        k1 = generate(model, params, prompt, steps=6, temperature=1.0,
+                      top_k=1, rng=jax.random.PRNGKey(5))
+        np.testing.assert_array_equal(np.asarray(greedy),
+                                      np.asarray(k1))
+        # A tiny nucleus keeps only the argmax token too.
+        p_small = generate(model, params, prompt, steps=6,
+                           temperature=1.0, top_p=1e-9,
+                           rng=jax.random.PRNGKey(6))
+        np.testing.assert_array_equal(np.asarray(greedy),
+                                      np.asarray(p_small))
+        with pytest.raises(ValueError):
+            generate(model, params, prompt, steps=2, top_k=5)  # temp=0
+        with pytest.raises(ValueError):
+            generate(model, params, prompt, steps=2, temperature=1.0,
+                     top_p=1.5, rng=jax.random.PRNGKey(0))
+
+    def test_eval_step_matches_train_loss(self, hvd):
+        """make_lm_eval_step == the train step's reported loss at the
+        same params (loss is computed pre-update)."""
+        import optax
+        from horovod_tpu.models.transformer import (
+            init_lm_state, make_lm_eval_step, make_lm_train_step)
+        from horovod_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(data=4, model=2)
+        model = _tiny_model()
+        toks = _tokens(seed=22)
+        params, opt = init_lm_state(model, tx := optax.sgd(0.1),
+                                    jax.random.PRNGKey(0), mesh, toks)
+        ev = make_lm_eval_step(model, mesh)
+        step = make_lm_train_step(model, tx, mesh, donate=False)
+        toks_sh = jax.device_put(
+            toks, NamedSharding(mesh, P("data", None)))
+        eval_loss = float(ev(params, toks_sh))
+        _, _, train_loss = step(params, opt, toks_sh)
+        np.testing.assert_allclose(eval_loss, float(train_loss),
+                                   rtol=1e-5)
+        # chunked variant agrees too
+        ev_c = make_lm_eval_step(model, mesh, loss_chunk=8)
+        np.testing.assert_allclose(float(ev_c(params, toks_sh)),
+                                   eval_loss, rtol=1e-4)
+
     def test_moe_decode_matches_when_dropfree(self, hvd):
         """Per-token top-k routing works one tick at a time. Expert
         capacity C = ceil(k·T/E·factor) depends on tokens-per-call, so
