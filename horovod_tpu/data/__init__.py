@@ -280,3 +280,57 @@ class ShardedDataset:
 
     def __exit__(self, *exc):
         self.close()
+
+
+# ---------------------------------------------------------------------------
+# LM token packing (text pipeline on top of the binary shard loader).
+# ---------------------------------------------------------------------------
+
+def pack_tokens(documents: Sequence[Sequence[int]], seq_len: int, *,
+                eos_id: Optional[int] = None,
+                dtype: str = "int32") -> np.ndarray:
+    """Pack token documents into fixed [N, seq_len] training rows.
+
+    The standard LM packing recipe: documents are concatenated into one
+    stream (each terminated by ``eos_id`` when given, so the model can
+    learn document boundaries) and sliced into full-length rows; the
+    tail remainder that doesn't fill a row is dropped. No padding, no
+    attention-mask bookkeeping — every position is a real token, which
+    keeps the MXU busy on actual work.
+    """
+    if seq_len <= 0:
+        raise ValueError(f"seq_len must be positive, got {seq_len}")
+    parts = []
+    for doc in documents:
+        parts.append(np.asarray(doc, dtype=np.dtype(dtype)))
+        if eos_id is not None:
+            parts.append(np.asarray([eos_id], dtype=np.dtype(dtype)))
+    stream = (np.concatenate(parts)
+              if parts else np.zeros((0,), np.dtype(dtype)))
+    n = len(stream) // seq_len
+    return stream[:n * seq_len].reshape(n, seq_len)
+
+
+def lm_spec(seq_len: int, dtype: str = "int32") -> Spec:
+    """Record spec for packed LM rows (`ShardedDataset` field name is
+    ``tokens``; batches feed `make_lm_train_step` directly)."""
+    return [("tokens", dtype, (seq_len,))]
+
+
+def write_token_shards(directory: str, prefix: str,
+                       documents: Sequence[Sequence[int]],
+                       seq_len: int, num_shards: int, *,
+                       eos_id: Optional[int] = None,
+                       dtype: str = "int32") -> List[str]:
+    """`pack_tokens` + `write_shards` in one call; returns shard paths.
+
+    Load with ``ShardedDataset(paths, lm_spec(seq_len), batch)`` —
+    per-rank shard ownership and native prefetching included.
+    """
+    rows = pack_tokens(documents, seq_len, eos_id=eos_id, dtype=dtype)
+    if len(rows) == 0:
+        raise ValueError(
+            f"no full rows packed: corpus has fewer than "
+            f"seq_len={seq_len} tokens")
+    return write_shards(directory, prefix, lm_spec(seq_len, dtype),
+                        {"tokens": rows}, num_shards)

@@ -401,10 +401,17 @@ def make_lm_eval_step(model: TransformerLM, mesh, *,
                       loss_chunk: Optional[int] = None) -> Callable:
     """eval(params, tokens) -> mean next-token cross entropy (nats).
 
-    The forward-only twin of `make_lm_train_step` — same loss, same
-    sharding, no gradient/optimizer; perplexity = exp(loss). Use
-    `loss_chunk` to keep the [B, S, V] logits from materializing on
-    long sequences (same trade as the train step's option).
+    The forward-only twin of `make_lm_train_step` — same sharding, no
+    gradient/optimizer; perplexity = exp(loss). Use `loss_chunk` to
+    keep the [B, S, V] logits from materializing on long sequences
+    (same trade as the train step's option).
+
+    For MoE models this is PURE cross entropy: the train step's
+    load-balancing aux term (`moe_aux_weight · aux`) is a training
+    regularizer, not part of the modeled likelihood, so it is excluded
+    here — the right number for perplexity, but expect the train
+    step's reported loss to sit `moe_aux_weight · aux` above eval on
+    the same batch.
     """
     def ev(params, tokens):
         return _lm_data_loss(model, params, tokens, loss_chunk,

@@ -186,3 +186,84 @@ class TestLoaderRobustness:
                                world=1) as ds:
             with pytest.raises(RuntimeError, match="cannot open"):
                 list(ds.epoch(0))
+
+
+class TestTokenPacking:
+    def test_pack_tokens_concat_and_tail_drop(self):
+        rows = hd.pack_tokens([[1, 2, 3], [4, 5], [6, 7, 8, 9]], 4)
+        # Stream 1..9 (len 9) -> two full rows, tail [9] dropped.
+        np.testing.assert_array_equal(
+            rows, [[1, 2, 3, 4], [5, 6, 7, 8]])
+        assert rows.dtype == np.int32
+
+    def test_pack_tokens_eos_separation(self):
+        rows = hd.pack_tokens([[1, 2], [3]], 3, eos_id=0)
+        # Stream 1 2 0 3 0 -> one row, tail dropped.
+        np.testing.assert_array_equal(rows, [[1, 2, 0]])
+
+    def test_pack_tokens_edge_cases(self):
+        assert hd.pack_tokens([], 8).shape == (0, 8)
+        assert hd.pack_tokens([[1, 2]], 8).shape == (0, 8)  # short tail
+        with pytest.raises(ValueError):
+            hd.pack_tokens([[1]], 0)
+
+    def test_write_token_shards_roundtrip_two_ranks(self, tmp_path):
+        docs = [list(range(i, i + 7)) for i in range(0, 700, 7)]
+        S = 10
+        paths = hd.write_token_shards(str(tmp_path), "lm", docs, S, 4,
+                                      eos_id=99)
+        expected = hd.pack_tokens(docs, S, eos_id=99)
+        got = []
+        for rank in range(2):  # 2 ranks × 2 shards, disjoint coverage
+            with hd.ShardedDataset(paths, hd.lm_spec(S), batch_size=8,
+                                   shuffle=False, rank=rank,
+                                   world=2) as ds:
+                for batch in ds.epoch():
+                    assert batch["tokens"].shape[1] == S
+                    got.append(batch["tokens"])
+        got = np.concatenate(got)
+        assert got.shape == expected.shape
+        # Same multiset of ROWS across both ranks, no dup, no loss
+        # (lexicographic row sort keeps row integrity; a column-wise
+        # sort would pass even if values scrambled across rows).
+        def row_sorted(a):
+            return a[np.lexsort(a.T[::-1])]
+        np.testing.assert_array_equal(row_sorted(got),
+                                      row_sorted(expected))
+
+    def test_token_pipeline_trains_lm(self, hvd, tmp_path):
+        """End-to-end: packed shards -> ShardedDataset -> LM train
+        step on the mesh; loss decreases."""
+        import jax
+        import optax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from horovod_tpu.models.transformer import (
+            TransformerLM, init_lm_state, make_lm_train_step)
+        from horovod_tpu.parallel.mesh import make_mesh
+
+        rng = np.random.RandomState(0)
+        docs = [np.cumsum(rng.randint(0, 3, 40)) % 64
+                for _ in range(40)]
+        S = 16
+        paths = hd.write_token_shards(str(tmp_path), "lm", docs, S, 2)
+        mesh = make_mesh(data=8)
+        model = TransformerLM(vocab_size=64, num_layers=2, num_heads=4,
+                              head_dim=8, max_len=32,
+                              dtype=jax.numpy.float32, pos_emb="rope")
+        sample = np.zeros((8, S), np.int32)
+        params, opt = init_lm_state(model, tx := optax.adam(1e-2),
+                                    jax.random.PRNGKey(0), mesh, sample)
+        step = make_lm_train_step(model, tx, mesh)
+        losses = []
+        with hd.ShardedDataset(paths, hd.lm_spec(S), batch_size=8,
+                               drop_remainder=True, seed=1) as ds:
+            for epoch in range(3):
+                for batch in ds.epoch(epoch):
+                    toks = jax.device_put(
+                        batch["tokens"],
+                        NamedSharding(mesh, P("data", None)))
+                    params, opt, loss = step(params, opt, toks)
+                    losses.append(float(loss))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
