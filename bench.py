@@ -169,7 +169,8 @@ def run_decode(args, devices, n_chips, log):
     model = TransformerLM(
         vocab_size=32768, num_layers=args.layers,
         num_heads=args.heads, num_kv_heads=args.kv_heads,
-        pos_emb=args.pos_emb, head_dim=args.head_dim,
+        pos_emb=args.pos_emb, window=args.window,
+        head_dim=args.head_dim,
         max_len=args.seq, dtype=jnp.bfloat16,
         attn_impl=args.attn_impl)
     B, P, steps = args.batch, 32, args.decode_steps
@@ -214,7 +215,8 @@ def run_transformer(args, devices, n_chips, log):
     model = TransformerLM(
         vocab_size=32768, num_layers=args.layers,
         num_heads=args.heads, num_kv_heads=args.kv_heads,
-        pos_emb=args.pos_emb, head_dim=args.head_dim,
+        pos_emb=args.pos_emb, window=args.window,
+        head_dim=args.head_dim,
         max_len=args.seq, dtype=jnp.bfloat16,
         attn_impl=args.attn_impl)
     toks = np.random.RandomState(0).randint(
@@ -281,6 +283,8 @@ def main():
                     help="GQA: fewer K/V heads (shrinks the KV cache)")
     ap.add_argument("--pos-emb", default="learned",
                     choices=["learned", "rope"])
+    ap.add_argument("--window", type=int, default=None,
+                    help="sliding-window attention span")
     # head_dim 128 fills the MXU lanes — measured 1.56x over 64.
     ap.add_argument("--head-dim", type=int, default=128)
     ap.add_argument("--attn-impl", default="flash",

@@ -33,6 +33,8 @@ def main():
                     help="GQA: fewer K/V heads than query heads")
     ap.add_argument("--pos-emb", default="learned",
                     choices=["learned", "rope"])
+    ap.add_argument("--window", type=int, default=None,
+                    help="sliding-window attention span")
     ap.add_argument("--head-dim", type=int, default=64)
     ap.add_argument("--attn", default="ring",
                     choices=["dot", "blockwise", "flash", "ring",
@@ -70,7 +72,8 @@ def main():
     model = TransformerLM(
         vocab_size=args.vocab, num_layers=args.layers,
         num_heads=args.heads, num_kv_heads=args.kv_heads,
-        pos_emb=args.pos_emb, head_dim=args.head_dim,
+        pos_emb=args.pos_emb, window=args.window,
+        head_dim=args.head_dim,
         max_len=args.seq_len, attn_impl=args.attn,
         moe_every=args.moe_every, remat=args.remat)
 

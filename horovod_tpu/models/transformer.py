@@ -44,7 +44,8 @@ from horovod_tpu.parallel.mesh import (
     AXIS_DATA, AXIS_MODEL, AXIS_SEQ, constrain, use,
 )
 from horovod_tpu.parallel.sequence import (
-    blockwise_attention, ring_attention_gspmd, ulysses_attention_gspmd,
+    banded_causal_mask, blockwise_attention, ring_attention_gspmd,
+    ulysses_attention_gspmd,
 )
 from horovod_tpu.parallel.tensor import (
     ParallelMLP, ParallelSelfAttention, dot_product_attention,
@@ -57,11 +58,22 @@ ATTN_IMPLS = ("dot", "blockwise", "flash", "ring", "ulysses")
 
 
 def make_attn_fn(impl: str, *, causal: bool = True,
-                 block_size: int = 512) -> Optional[Callable]:
+                 block_size: int = 512,
+                 window: Optional[int] = None) -> Optional[Callable]:
     """attn_fn for `ParallelSelfAttention` (None = dot baseline, which
-    consumes the explicit mask argument instead)."""
+    consumes the explicit mask argument instead). ``window`` = sliding
+    -window attention (last `window` positions only; requires causal).
+    """
+    if window is not None and window < 1:
+        raise ValueError(
+            f"window must be >= 1 (None disables), got {window}")
     if impl == "dot":
         return None
+    if window is not None and impl == "flash":
+        raise NotImplementedError(
+            "attn_impl='flash' does not support window yet; use "
+            "'blockwise', 'ring', or 'ulysses' for sliding-window "
+            "attention")
 
     def _no_mask(m):
         if m is not None:
@@ -73,6 +85,7 @@ def make_attn_fn(impl: str, *, causal: bool = True,
         def attn(q, k, v, m):
             _no_mask(m)
             return blockwise_attention(q, k, v, causal=causal,
+                                       window=window,
                                        block_size=block_size)
         return attn
     if impl == "flash":
@@ -94,8 +107,9 @@ def make_attn_fn(impl: str, *, causal: bool = True,
             mesh = jax.sharding.get_abstract_mesh()
             if mesh is None or mesh.empty:
                 return blockwise_attention(q, k, v, causal=causal,
+                                           window=window,
                                            block_size=block_size)
-            return sp_fn(None, q, k, v, causal=causal)
+            return sp_fn(None, q, k, v, causal=causal, window=window)
 
         return attn
     raise ValueError(f"attn_impl must be one of {ATTN_IMPLS}, got {impl!r}")
@@ -109,6 +123,7 @@ class TransformerBlock(nn.Module):
     num_kv_heads: Optional[int] = None
     pos_emb: str = "none"        # "none" | "rope"
     rope_theta: float = 10000.0
+    window: Optional[int] = None  # sliding-window attention
     mlp_ratio: int = 4
     dtype: Optional[Dtype] = jnp.bfloat16
     attn_impl: str = "blockwise"
@@ -123,17 +138,19 @@ class TransformerBlock(nn.Module):
         d = x.shape[-1]
         # Decode ticks attend against the KV cache inside the attention
         # module; the training attn_fn (flash/ring/...) is bypassed.
-        attn_fn = None if self.decode else make_attn_fn(self.attn_impl)
+        attn_fn = (None if self.decode else
+                   make_attn_fn(self.attn_impl, window=self.window))
         mask = None
         if attn_fn is None and not self.decode:
-            # dot baseline materializes the causal mask
+            # dot baseline materializes the banded causal mask
             S = x.shape[-2]
-            mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+            pos = jnp.arange(S)
+            mask = banded_causal_mask(pos, pos, self.window)[None, None]
         h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
         h = ParallelSelfAttention(
             num_heads=self.num_heads, head_dim=self.head_dim,
             num_kv_heads=self.num_kv_heads, pos_emb=self.pos_emb,
-            rope_theta=self.rope_theta,
+            rope_theta=self.rope_theta, window=self.window,
             dtype=self.dtype, attn_fn=attn_fn, decode=self.decode,
             name="attn")(h, mask)
         x = x + h
@@ -164,6 +181,7 @@ class TransformerLM(nn.Module):
     num_kv_heads: Optional[int] = None   # GQA: fewer K/V heads
     pos_emb: str = "learned"             # "learned" | "rope"
     rope_theta: float = 10000.0
+    window: Optional[int] = None         # sliding-window attention
     mlp_ratio: int = 4
     max_len: int = 2048
     dtype: Optional[Dtype] = jnp.bfloat16
@@ -220,7 +238,7 @@ class TransformerLM(nn.Module):
                 num_heads=self.num_heads, head_dim=self.head_dim,
                 num_kv_heads=self.num_kv_heads,
                 pos_emb=("rope" if self.pos_emb == "rope" else "none"),
-                rope_theta=self.rope_theta,
+                rope_theta=self.rope_theta, window=self.window,
                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                 attn_impl=self.attn_impl, moe=moe,
                 num_experts=self.num_experts, moe_k=self.moe_k,

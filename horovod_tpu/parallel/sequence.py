@@ -68,23 +68,44 @@ def _finalize(o, m, l, dtype):
     return (o / denom).astype(dtype)
 
 
-def _causal_bias(q_pos: jax.Array, k_pos: jax.Array) -> jax.Array:
-    """[1,1,Sq,Sk] additive bias: 0 where k ≤ q, -inf otherwise."""
+def banded_causal_mask(q_pos: jax.Array, k_pos: jax.Array,
+                       window: "int | None" = None) -> jax.Array:
+    """[Sq, Sk] bool: k ≤ q and (with ``window``) q − k < window.
+
+    THE band rule — every consumer (dot baseline, decode cache,
+    blockwise/ring/ulysses bias) derives from this one site so the
+    sliding-window semantics cannot drift between kernels. Positions
+    are GLOBAL, so the same logic is exact inside ring attention's
+    rotated blocks and the decode cache."""
     keep = q_pos[:, None] >= k_pos[None, :]
+    if window is not None:
+        keep &= q_pos[:, None] - k_pos[None, :] < window
+    return keep
+
+
+def _causal_bias(q_pos: jax.Array, k_pos: jax.Array,
+                 window: "int | None" = None) -> jax.Array:
+    """[1,1,Sq,Sk] additive bias form of `banded_causal_mask`."""
+    keep = banded_causal_mask(q_pos, k_pos, window)
     return jnp.where(keep, 0.0, -jnp.inf)[None, None]
 
 
 def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         *, block_size: int = 512,
                         causal: bool = False,
+                        window: "int | None" = None,
                         q_offset: int = 0,
                         k_offset: int = 0) -> jax.Array:
     """Memory-efficient attention: scan over K/V chunks, online softmax.
 
     [B, Sq, H, D] x [B, Sk, H, D] → [B, Sq, H, D] without the [Sq, Sk]
     matrix. `q_offset`/`k_offset` are the global positions of element 0
-    (used by ring attention to causal-mask rotated blocks).
+    (used by ring attention to causal-mask rotated blocks). ``window``
+    (requires causal) limits attention to the last `window` positions —
+    Mistral-style sliding-window attention.
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     nblk = max(1, -(-Sk // block_size))
@@ -104,7 +125,7 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         k_pos = k_offset + i * blk + jnp.arange(blk)
         bias = None
         if causal:
-            bias = _causal_bias(q_pos, k_pos)
+            bias = _causal_bias(q_pos, k_pos, window)
         if pad:
             # mask the zero-padding tail (local key index >= Sk)
             tail = jnp.where((k_pos - k_offset < Sk)[None, None, None, :],
@@ -125,7 +146,8 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    *, axis_name: str = AXIS_SEQ,
-                   causal: bool = False) -> jax.Array:
+                   causal: bool = False,
+                   window: "int | None" = None) -> jax.Array:
     """Ring attention over the ``seq`` mesh axis (SPMD; inside shard_map).
 
     Each rank holds a contiguous sequence block [B, S/sp, H, D]. K/V
@@ -136,6 +158,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     their compute is skipped by masking (XLA still schedules the permute,
     keeping the ring in lockstep — required for collective correctness).
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, S, H, D = q.shape
@@ -146,7 +170,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         # Block kc originated on rank (idx - step) mod sp.
         src = (idx - step) % sp
         k_pos = src * S + jnp.arange(S)
-        bias = _causal_bias(q_pos, k_pos) if causal else None
+        bias = _causal_bias(q_pos, k_pos, window) if causal else None
         return _online_block(carry, q32, kc.astype(jnp.float32), vc, bias)
 
     def body(carry, step):
@@ -172,6 +196,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       *, axis_name: str = AXIS_SEQ,
                       causal: bool = False,
+                      window: "int | None" = None,
                       attn_impl=None) -> jax.Array:
     """DeepSpeed-Ulysses sequence parallelism (SPMD; inside shard_map).
 
@@ -196,9 +221,11 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     if attn_impl is None:
-        attn_impl = functools.partial(blockwise_attention, causal=causal)
+        attn_impl = functools.partial(blockwise_attention, causal=causal,
+                                      window=window)
     else:
-        attn_impl = functools.partial(attn_impl, causal=causal)
+        attn_impl = functools.partial(attn_impl, causal=causal,
+                                      window=window)
     oh = attn_impl(qh, kh, vh)
     return heads_to_seq(oh)
 
@@ -214,6 +241,7 @@ def _ambient_mesh(mesh):
 
 
 def ring_attention_gspmd(mesh, q, k, v, *, causal: bool = False,
+                         window: "int | None" = None,
                          seq_axis: str = AXIS_SEQ) -> jax.Array:
     """Ring attention as a shard_map region inside a pjit'ed model.
 
@@ -226,12 +254,13 @@ def ring_attention_gspmd(mesh, q, k, v, *, causal: bool = False,
     mesh = _ambient_mesh(mesh)
     spec = P(AXIS_DATA, seq_axis, AXIS_MODEL, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis,
-                           causal=causal)
+                           causal=causal, window=window)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)(q, k, v)
 
 
 def ulysses_attention_gspmd(mesh, q, k, v, *, causal: bool = False,
+                            window: "int | None" = None,
                             seq_axis: str = AXIS_SEQ,
                             attn_impl=None) -> jax.Array:
     """Ulysses sequence parallelism as a shard_map region inside pjit.
@@ -244,6 +273,7 @@ def ulysses_attention_gspmd(mesh, q, k, v, *, causal: bool = False,
     mesh = _ambient_mesh(mesh)
     spec = P(AXIS_DATA, seq_axis, AXIS_MODEL, None)
     fn = functools.partial(ulysses_attention, axis_name=seq_axis,
-                           causal=causal, attn_impl=attn_impl)
+                           causal=causal, window=window,
+                           attn_impl=attn_impl)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)(q, k, v)

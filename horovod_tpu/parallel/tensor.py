@@ -31,6 +31,7 @@ import flax.linen as nn
 from horovod_tpu.parallel.mesh import (
     AXIS_DATA, AXIS_MODEL, AXIS_SEQ, UNCONSTRAINED, constrain,
 )
+from horovod_tpu.parallel.sequence import banded_causal_mask
 
 Dtype = Any
 
@@ -167,6 +168,7 @@ class ParallelSelfAttention(nn.Module):
     num_kv_heads: Optional[int] = None
     pos_emb: str = "none"        # "none" | "rope"
     rope_theta: float = 10000.0
+    window: Optional[int] = None  # sliding-window (decode mask)
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -176,6 +178,10 @@ class ParallelSelfAttention(nn.Module):
         if H % Hkv:
             raise ValueError(
                 f"num_heads={H} not divisible by num_kv_heads={Hkv}")
+        if self.window is not None and self.window < 1:
+            raise ValueError(
+                f"window must be >= 1 (None disables), "
+                f"got {self.window}")
         features = H * self.head_dim
         kv_features = Hkv * self.head_dim
         qkv = ColumnParallelDense(features + 2 * kv_features,
@@ -252,7 +258,9 @@ class ParallelSelfAttention(nn.Module):
         if not is_init:
             S = q.shape[-3]
             q, k = self._maybe_rope(q, k)
-            causal = jnp.tril(jnp.ones((S, S), bool))[None, None]
+            pos_ = jnp.arange(S)
+            causal = banded_causal_mask(pos_, pos_,
+                                        self.window)[None, None]
             return dot_product_attention(
                 q, self._repeat_kv(k), self._repeat_kv(v), causal)
 
@@ -270,10 +278,9 @@ class ParallelSelfAttention(nn.Module):
         index.value = i + S
         # Valid positions: the prefix plus the causal part of the new
         # block — position p attends to cached positions <= i + its
-        # own offset.
-        pos = jnp.arange(L)[None, :]                   # [1, L]
-        qpos = i + jnp.arange(S)[:, None]              # [S, 1]
-        mask = (pos <= qpos)[None, None]               # [1, 1, S, L]
+        # own offset; with a window, only the last `window` of them.
+        mask = banded_causal_mask(i + jnp.arange(S), jnp.arange(L),
+                                  self.window)[None, None]
         return dot_product_attention(q, self._repeat_kv(key),
                                      self._repeat_kv(val), mask)
 
