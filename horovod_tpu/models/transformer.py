@@ -64,16 +64,10 @@ def make_attn_fn(impl: str, *, causal: bool = True,
     consumes the explicit mask argument instead). ``window`` = sliding
     -window attention (last `window` positions only; requires causal).
     """
-    if window is not None and window < 1:
-        raise ValueError(
-            f"window must be >= 1 (None disables), got {window}")
+    from horovod_tpu.parallel.sequence import check_window
+    check_window(window)
     if impl == "dot":
         return None
-    if window is not None and impl == "flash":
-        raise NotImplementedError(
-            "attn_impl='flash' does not support window yet; use "
-            "'blockwise', 'ring', or 'ulysses' for sliding-window "
-            "attention")
 
     def _no_mask(m):
         if m is not None:
@@ -93,7 +87,8 @@ def make_attn_fn(impl: str, *, causal: bool = True,
 
         def attn(q, k, v, m):
             _no_mask(m)
-            return flash_attention(q, k, v, causal=causal)
+            return flash_attention(q, k, v, causal=causal,
+                                   window=window)
         return attn
     if impl in ("ring", "ulysses"):
         sp_fn = (ring_attention_gspmd if impl == "ring"

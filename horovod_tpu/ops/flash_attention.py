@@ -49,10 +49,25 @@ except Exception:  # pragma: no cover
 NEG_INF = float("-inf")
 
 
+def _band_j0(qi, *, window, q_offset, k_offset, block_q, block_k):
+    """First k-block index that can intersect q-block ``qi``'s band —
+    the banded grid's offset (shared by index_map and kernel so the
+    DMA'd block and the in-kernel positions cannot disagree)."""
+    lo = (q_offset + qi * block_q - (window - 1) - k_offset) // block_k
+    return jnp.maximum(0, lo)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
-                  scale: float, causal: bool, q_offset: int, k_offset: int,
+                  scale: float, causal: bool, window: "int | None",
+                  banded: bool, nk_total: int,
+                  q_offset: int, k_offset: int,
                   kv_len: int, block_q: int, block_k: int):
     """One (batch, head, q-block, k-block) grid cell.
+
+    ``banded``: the innermost grid axis runs over only the k-blocks
+    that can intersect the sliding-window band of this q-block
+    (index_map adds `_band_j0`); out-of-range logical blocks (clamped
+    duplicates at the sequence end) are skipped by the validity guard.
 
     Scratch (persistent across the innermost k-block sweep):
       acc_ref [block_q, D] f32 — unnormalized output accumulator
@@ -71,7 +86,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     # Global positions of this block's rows/cols (for causal + pad masks).
     q_start = q_offset + qi * block_q
-    k_start = k_offset + ki * block_k
+    if banded:
+        jl = _band_j0(qi, window=window, q_offset=q_offset,
+                      k_offset=k_offset, block_q=block_q,
+                      block_k=block_k) + ki
+        jc = jnp.minimum(jl, nk_total - 1)   # what the index_map DMA'd
+        in_range = jl <= nk_total - 1
+    else:
+        jl = jc = ki
+        in_range = True
+    k_start = k_offset + jc * block_k
 
     def _block():
         q = q_ref[0, 0].astype(jnp.float32) * scale          # [bq, D]
@@ -87,10 +111,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             cols = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             mask = rows >= cols
+            if window is not None:
+                # Sliding window: same band rule as
+                # sequence.banded_causal_mask, global positions.
+                mask = jnp.logical_and(mask, rows - cols < window)
         if kv_len % block_k:
             # Zero-padding tail of the key axis (local index >= kv_len);
             # trivially all-true except in the last k block.
-            local = ki * block_k + jax.lax.broadcasted_iota(
+            local = jc * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             pad_ok = local < kv_len
             mask = pad_ok if mask is None else jnp.logical_and(mask, pad_ok)
@@ -117,8 +145,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
 
     if causal:
         # Skip blocks entirely in the future: the earliest key in the
-        # block is later than the latest query row.
-        pl.when(k_start <= q_start + block_q - 1)(_block)
+        # block is later than the latest query row. With a window,
+        # also skip blocks entirely in the past (the newest key older
+        # than the oldest query's window start) and clamped duplicates
+        # past the banded grid's end.
+        relevant = k_start <= q_start + block_q - 1
+        if window is not None:
+            relevant = jnp.logical_and(
+                relevant,
+                k_start + block_k - 1 >= q_start - window + 1)
+        if banded:
+            relevant = jnp.logical_and(relevant, in_range)
+        pl.when(relevant)(_block)
     else:
         _block()
 
@@ -129,8 +167,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         o_ref[0, 0, :, :] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
-def _flash_forward(q, k, v, *, causal, q_offset, k_offset, block_q,
-                   block_k, interpret):
+def _flash_forward(q, k, v, *, causal, window, q_offset, k_offset,
+                   block_q, block_k, interpret):
     """[B, S, H, D] flash attention forward via pallas_call."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -149,19 +187,39 @@ def _flash_forward(q, k, v, *, causal, q_offset, k_offset, block_q,
         kt = jnp.pad(kt, ((0, 0), (0, 0), (0, nk * bk - Sk), (0, 0)))
         vt = jnp.pad(vt, ((0, 0), (0, 0), (0, nk * bk - Sk), (0, 0)))
 
+    # Sliding window: shrink the innermost grid to the k-blocks that
+    # can intersect each q-block's band — out-of-band K/V blocks are
+    # never DMA'd at all, so a long-context SWA step moves
+    # O(S·(window+block)) bytes instead of O(S²).
+    banded = causal and window is not None
+    if banded:
+        span = bq + window - 1                 # key span of one q-block
+        nkb = min(nk, -(-span // bk) + 1)
+
+        def k_map(b, h, i, j):
+            j0 = _band_j0(i, window=window, q_offset=q_offset,
+                          k_offset=k_offset, block_q=bq, block_k=bk)
+            return (b, h, jnp.minimum(j0 + j, nk - 1), 0)
+    else:
+        nkb = nk
+
+        def k_map(b, h, i, j):
+            return (b, h, j, 0)
+
     kernel = functools.partial(
-        _flash_kernel, scale=D ** -0.5, causal=causal,
+        _flash_kernel, scale=D ** -0.5, causal=causal, window=window,
+        banded=banded, nk_total=nk,
         q_offset=q_offset, k_offset=k_offset, kv_len=Sk,
         block_q=bq, block_k=bk)
 
-    grid = (B, H, nq, nk)
+    grid = (B, H, nq, nkb)
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, D), k_map),
+            pl.BlockSpec((1, 1, bk, D), k_map),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, D),
                                lambda b, h, i, j: (b, h, i, 0)),
@@ -189,7 +247,8 @@ def _auto_interpret() -> bool:
 
 
 @functools.lru_cache(maxsize=None)
-def _make_flash(causal, q_offset, k_offset, block_q, block_k, interpret):
+def _make_flash(causal, window, q_offset, k_offset, block_q, block_k,
+                interpret):
     """Config-specialized flash fn with a recompute VJP.
 
     Backward = flash-style recompute: differentiate the blockwise
@@ -201,13 +260,14 @@ def _make_flash(causal, q_offset, k_offset, block_q, block_k, interpret):
 
     def ref(q, k, v):
         return blockwise_attention(
-            q, k, v, block_size=block_k, causal=causal,
+            q, k, v, block_size=block_k, causal=causal, window=window,
             q_offset=q_offset, k_offset=k_offset)
 
     @jax.custom_vjp
     def flash(q, k, v):
         return _flash_forward(
-            q, k, v, causal=causal, q_offset=q_offset, k_offset=k_offset,
+            q, k, v, causal=causal, window=window,
+            q_offset=q_offset, k_offset=k_offset,
             block_q=block_q, block_k=block_k, interpret=interpret)
 
     def fwd(q, k, v):
@@ -224,6 +284,7 @@ def _make_flash(causal, q_offset, k_offset, block_q, block_k, interpret):
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     mask=None, *, causal: bool = False,
+                    window: Optional[int] = None,
                     q_offset: int = 0, k_offset: int = 0,
                     block_q: int = 128, block_k: int = 128,
                     interpret: Optional[bool] = None) -> jax.Array:
@@ -239,6 +300,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
       causal: apply a causal mask using global positions
         `q_offset + i >= k_offset + j` (offsets support ring-attention
         style rotated blocks).
+      window: sliding-window attention (last `window` positions only;
+        requires causal; >= 1). The FORWARD's innermost grid axis
+        covers only the k-blocks intersecting each q-block's band, so
+        out-of-band K/V is never read from HBM — forward SWA moves
+        O(S·(window+block_k)) bytes and FLOPs, not O(S²). The
+        recompute backward currently scans all blocks (out-of-band
+        ones masked), so training steps remain O(S²) there; a banded
+        backward is the natural follow-up.
       block_q, block_k: VMEM tile sizes (128 matches the MXU; raise
         block_k to 256/512 when head_dim is small).
       interpret: run the kernel in interpreter mode (None = auto: True
@@ -248,8 +317,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise NotImplementedError(
             "flash_attention supports causal masking only; use "
             "dot_product_attention for arbitrary masks")
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
+    from horovod_tpu.parallel.sequence import check_window
+    check_window(window)
     if interpret is None:
         interpret = _auto_interpret()
-    fn = _make_flash(bool(causal), int(q_offset), int(k_offset),
+    fn = _make_flash(bool(causal),
+                     None if window is None else int(window),
+                     int(q_offset), int(k_offset),
                      int(block_q), int(block_k), bool(interpret))
     return fn(q, k, v)

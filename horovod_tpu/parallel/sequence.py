@@ -68,6 +68,14 @@ def _finalize(o, m, l, dtype):
     return (o / denom).astype(dtype)
 
 
+def check_window(window: "int | None") -> None:
+    """THE window argument contract (single site for all entry points:
+    blockwise/ring/ulysses/flash and the model layers)."""
+    if window is not None and window < 1:
+        raise ValueError(
+            f"window must be >= 1 (None disables), got {window}")
+
+
 def banded_causal_mask(q_pos: jax.Array, k_pos: jax.Array,
                        window: "int | None" = None) -> jax.Array:
     """[Sq, Sk] bool: k ≤ q and (with ``window``) q − k < window.
@@ -106,6 +114,7 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
+    check_window(window)
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     nblk = max(1, -(-Sk // block_size))
@@ -160,6 +169,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
+    check_window(window)
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, S, H, D = q.shape
@@ -219,13 +229,16 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
+    check_window(window)
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    # Only forward window= when set, so pre-existing custom attn_impl
+    # callables without the kwarg keep working in window-less models.
+    kw = {} if window is None else {"window": window}
     if attn_impl is None:
         attn_impl = functools.partial(blockwise_attention, causal=causal,
-                                      window=window)
+                                      **kw)
     else:
-        attn_impl = functools.partial(attn_impl, causal=causal,
-                                      window=window)
+        attn_impl = functools.partial(attn_impl, causal=causal, **kw)
     oh = attn_impl(qh, kh, vh)
     return heads_to_seq(oh)
 

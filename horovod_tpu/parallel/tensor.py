@@ -178,10 +178,8 @@ class ParallelSelfAttention(nn.Module):
         if H % Hkv:
             raise ValueError(
                 f"num_heads={H} not divisible by num_kv_heads={Hkv}")
-        if self.window is not None and self.window < 1:
-            raise ValueError(
-                f"window must be >= 1 (None disables), "
-                f"got {self.window}")
+        from horovod_tpu.parallel.sequence import check_window
+        check_window(self.window)
         features = H * self.head_dim
         kv_features = Hkv * self.head_dim
         qkv = ColumnParallelDense(features + 2 * kv_features,
