@@ -549,12 +549,16 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
         raise ValueError(
             f"top_k must be in [1, vocab_size={model.vocab_size}], "
             f"got {top_k}")
-    if P + steps - 1 > model.max_len:
+    unbounded = model.pos_emb == "rope" and model.window is not None
+    if not unbounded and P + steps - 1 > model.max_len:
         # dynamic_update_slice would clamp writes past the cache end —
-        # plausible-looking garbage, so refuse loudly instead.
+        # plausible-looking garbage, so refuse loudly instead. With
+        # RoPE + a sliding window the cache is a rolling buffer and
+        # positions are unbounded, so any length generates.
         raise ValueError(
             f"prompt ({P}) + steps ({steps}) - 1 exceeds "
-            f"max_len={model.max_len}")
+            f"max_len={model.max_len} (use pos_emb='rope' with "
+            f"window= for unbounded generation)")
     if rng is None:
         rng = jax.random.PRNGKey(0)
     dec_model = model.clone(decode=True)

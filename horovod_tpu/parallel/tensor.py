@@ -245,12 +245,25 @@ class ParallelSelfAttention(nn.Module):
         """One decode tick: append k/v at `cache_index`, attend q
         against the filled prefix. At cache-init time (`model.init` on
         a [B, max_len] dummy) the cache is shaped from the full-length
-        k/v and a plain causal forward runs instead."""
+        k/v and a plain causal forward runs instead.
+
+        With a ``window``, the cache is a ROLLING buffer of only
+        `window` entries (slot = position mod window): cache memory
+        and per-tick attention cost are O(window), not O(max_len), and
+        with RoPE the absolute position counter keeps growing, so
+        generation length is unbounded by the cache."""
         is_init = self.has_variable("cache", "cached_key")
+        # Cache length: full at plain decode, exactly `window` slots
+        # when sliding-window — NOT min(init_len, window): a cache
+        # shorter than the window would silently evict in-band keys
+        # once the position counter passes the init length. (Shape
+        # args are only read at creation, i.e. during model.init.)
+        L0 = k.shape[-3] if self.window is None else self.window
+        cache_shape = (*k.shape[:-3], L0, *k.shape[-2:])
         cached_k = self.variable("cache", "cached_key",
-                                 jnp.zeros, k.shape, k.dtype)
+                                 jnp.zeros, cache_shape, k.dtype)
         cached_v = self.variable("cache", "cached_value",
-                                 jnp.zeros, v.shape, v.dtype)
+                                 jnp.zeros, cache_shape, v.dtype)
         index = self.variable("cache", "cache_index",
                               lambda: jnp.zeros((), jnp.int32))
         if not is_init:
@@ -263,24 +276,55 @@ class ParallelSelfAttention(nn.Module):
                 q, self._repeat_kv(k), self._repeat_kv(v), causal)
 
         S = q.shape[-3]
-        L = cached_k.value.shape[-3]
+        W = cached_k.value.shape[-3]
         i = index.value
         # Rotate at the ABSOLUTE position; keys enter the cache
         # already rotated, so the prefix needs no re-rotation.
         q, k = self._maybe_rope(q, k, offset=i)
-        z = jnp.zeros((), i.dtype)  # match index dtype under x64
-        key = lax.dynamic_update_slice(cached_k.value, k, (z, i, z, z))
-        val = lax.dynamic_update_slice(cached_v.value, v, (z, i, z, z))
-        cached_k.value = key
-        cached_v.value = val
+
+        if self.window is None:
+            z = jnp.zeros((), i.dtype)  # match index dtype under x64
+            key = lax.dynamic_update_slice(
+                cached_k.value, k, (z, i, z, z))
+            val = lax.dynamic_update_slice(
+                cached_v.value, v, (z, i, z, z))
+            cached_k.value = key
+            cached_v.value = val
+            index.value = i + S
+            # Valid positions: the prefix plus the causal part of the
+            # new block — position p attends to cached positions
+            # <= i + its own offset.
+            mask = banded_causal_mask(i + jnp.arange(S), jnp.arange(W),
+                                      None)[None, None]
+            return dot_product_attention(q, self._repeat_kv(key),
+                                         self._repeat_kv(val), mask)
+
+        # Rolling window. Attend BEFORE writing: a same-call write
+        # could evict the oldest key still inside an earlier query
+        # row's band. Slot s currently holds the newest position
+        # <= i-1 congruent to s mod W (negative = never written).
+        s_idx = jnp.arange(W, dtype=i.dtype)
+        last = i - 1
+        slot_pos = last - ((last - s_idx) % W)
+        valid = (i > 0) & (slot_pos >= 0)
+        qpos = i + jnp.arange(S, dtype=i.dtype)
+        kv_pos = jnp.concatenate([slot_pos, qpos])       # cache ++ block
+        keep = banded_causal_mask(qpos, kv_pos, self.window)
+        keep &= jnp.concatenate(
+            [valid, jnp.ones((S,), bool)])[None, :]
+        key = jnp.concatenate([cached_k.value, k], axis=-3)
+        val = jnp.concatenate([cached_v.value, v], axis=-3)
+        out = dot_product_attention(q, self._repeat_kv(key),
+                                    self._repeat_kv(val),
+                                    keep[None, None])
+        # Write the last min(S, W) new keys into their slots (earlier
+        # ones would be overwritten within this block anyway).
+        t = min(S, W)
+        slots = (qpos[S - t:]) % W
+        cached_k.value = cached_k.value.at[:, slots].set(k[:, S - t:])
+        cached_v.value = cached_v.value.at[:, slots].set(v[:, S - t:])
         index.value = i + S
-        # Valid positions: the prefix plus the causal part of the new
-        # block — position p attends to cached positions <= i + its
-        # own offset; with a window, only the last `window` of them.
-        mask = banded_causal_mask(i + jnp.arange(S), jnp.arange(L),
-                                  self.window)[None, None]
-        return dot_product_attention(q, self._repeat_kv(key),
-                                     self._repeat_kv(val), mask)
+        return out
 
 
 def apply_rope(x: jax.Array, positions: jax.Array,

@@ -344,7 +344,8 @@ class TestGenerate:
 
     def test_window_decode_matches_oracle(self, hvd):
         """Decode with a sliding window == full-forward oracle of the
-        same windowed model (cache mask bands correctly)."""
+        same windowed model. The prompt (5) exceeds the window (4), so
+        the rolling cache's prefill eviction path is exercised."""
         model = _tiny_model(window=4, pos_emb="rope")
         prompt = jnp.asarray(
             np.random.RandomState(27).randint(0, 64, (2, 5)))
@@ -353,6 +354,53 @@ class TestGenerate:
             jnp.zeros((2, 16), jnp.int32))["params"])
         out = generate(model, params, prompt, steps=8)
         ref = _oracle_greedy(model, params, prompt, steps=8)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_window_rolling_cache_size_and_unbounded(self, hvd):
+        """With a window the KV cache is a rolling buffer of `window`
+        slots (not max_len), and RoPE + window generates PAST max_len
+        — token-exact vs the full-forward oracle throughout."""
+        model = _tiny_model(window=6, pos_emb="rope")
+        cache = model.clone(decode=True).init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((2, 32), jnp.int32))["cache"]
+        ck = cache["block_0"]["attn"]["cached_key"]
+        assert ck.shape == (2, 6, 4, 8), ck.shape  # window, not max_len
+
+        prompt = jnp.asarray(
+            np.random.RandomState(31).randint(0, 64, (2, 4)))
+        params = unbox(model.init(
+            jax.random.PRNGKey(32),
+            jnp.zeros((2, 32), jnp.int32))["params"])
+        # 4 + 40 tokens >> max_len=32: unbounded streaming generation.
+        out = generate(model, params, prompt, steps=40)
+        ref = _oracle_greedy(model, params, prompt, steps=40)
+        assert out.shape == (2, 44)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        # learned-pos models must still refuse past max_len.
+        lm = _tiny_model(window=6)
+        p2 = unbox(lm.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 16), jnp.int32))["params"])
+        with pytest.raises(ValueError):
+            generate(lm, p2, prompt, steps=40)
+
+    def test_window_larger_than_max_len_cache_not_truncated(self, hvd):
+        """window > max_len: the rolling cache must still hold `window`
+        slots (regression: min(init_len, window) silently evicted
+        in-band keys once positions passed the init length)."""
+        model = _tiny_model(window=40, pos_emb="rope")  # max_len=32
+        cache = model.clone(decode=True).init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((2, 32), jnp.int32))["cache"]
+        ck = cache["block_0"]["attn"]["cached_key"]
+        assert ck.shape == (2, 40, 4, 8), ck.shape
+        prompt = jnp.asarray(
+            np.random.RandomState(33).randint(0, 64, (2, 4)))
+        params = unbox(model.init(
+            jax.random.PRNGKey(34),
+            jnp.zeros((2, 32), jnp.int32))["params"])
+        out = generate(model, params, prompt, steps=44)  # past window
+        ref = _oracle_greedy(model, params, prompt, steps=44)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
     @pytest.mark.parametrize("window,S", [(1, 64), (12, 64), (12, 57),
