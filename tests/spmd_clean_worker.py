@@ -24,11 +24,17 @@ import __graft_entry__ as graft  # noqa: E402
 
 def main():
     devices = jax.devices()[:8]
-    for axes, attn, moe, spec in [
-        (dict(data=2, seq=2, model=2), "ring", 0, ("data", "seq")),
-        (dict(data=2, expert=2, model=2), "blockwise", 2, ("data", None)),
+    for axes, attn, moe, spec, kw in [
+        # Same configurations as dryrun_multichip (rope on the ring
+        # path, GQA+FSDP on the MoE path) so the SPMD-clean assertion
+        # covers exactly what the driver compiles.
+        (dict(data=2, seq=2, model=2), "ring", 0, ("data", "seq"),
+         dict(pos_emb="rope")),
+        (dict(data=2, expert=2, model=2), "blockwise", 2,
+         ("data", None),
+         dict(num_kv_heads=2, sharded_init=True, fsdp=True)),
     ]:
-        loss = graft._dryrun_lm(devices, axes, attn, moe, spec)
+        loss = graft._dryrun_lm(devices, axes, attn, moe, spec, **kw)
         assert np.isfinite(loss)
         print(f"SPMD_CLEAN_OK {attn} moe={moe} loss={loss:.4f}")
 
