@@ -24,16 +24,10 @@ import __graft_entry__ as graft  # noqa: E402
 
 def main():
     devices = jax.devices()[:8]
-    for axes, attn, moe, spec, kw in [
-        # Same configurations as dryrun_multichip (rope on the ring
-        # path, GQA+FSDP on the MoE path) so the SPMD-clean assertion
-        # covers exactly what the driver compiles.
-        (dict(data=2, seq=2, model=2), "ring", 0, ("data", "seq"),
-         dict(pos_emb="rope")),
-        (dict(data=2, expert=2, model=2), "blockwise", 2,
-         ("data", None),
-         dict(num_kv_heads=2, sharded_init=True, fsdp=True)),
-    ]:
+    # Iterate the SAME config list the driver's dryrun uses — coverage
+    # parity by construction, not by hand-synced copies.
+    for names, attn, moe, spec, kw in graft.DRYRUN_LM_CONFIGS:
+        axes = dict(zip(names, graft._split(len(devices), len(names))))
         loss = graft._dryrun_lm(devices, axes, attn, moe, spec, **kw)
         assert np.isfinite(loss)
         print(f"SPMD_CLEAN_OK {attn} moe={moe} loss={loss:.4f}")

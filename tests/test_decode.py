@@ -479,3 +479,22 @@ class TestGenerate:
         out = generate(model, params, prompt, steps=5)
         ref = _oracle_greedy(model, params, prompt, steps=5)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_generate_example_runs():
+    """examples/transformer_generate.py: train-then-generate demo
+    (single device — generation is single-replica anyway)."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["HOROVOD_PLATFORM"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    res = subprocess.run(
+        [sys.executable, "examples/transformer_generate.py",
+         "--steps", "20", "--gen-len", "8"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=420)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "generated:" in res.stdout
