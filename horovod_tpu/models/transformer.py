@@ -264,6 +264,7 @@ class TransformerBlockStack(nn.Module):
     num_kv_heads: Optional[int] = None
     pos_emb: str = "none"        # "none" | "rope"
     rope_theta: float = 10000.0
+    window: Optional[int] = None         # sliding-window attention
     layers_per_stage: int = 1
     mlp_ratio: int = 4
     dtype: Optional[Dtype] = jnp.bfloat16
@@ -276,6 +277,7 @@ class TransformerBlockStack(nn.Module):
                 num_heads=self.num_heads, head_dim=self.head_dim,
                 num_kv_heads=self.num_kv_heads,
                 pos_emb=self.pos_emb, rope_theta=self.rope_theta,
+                window=self.window,
                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                 attn_impl=self.attn_impl, name=f"block_{i}")(x)
         return x
@@ -576,20 +578,26 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
             None if top_p is None else float(top_p))
     if mesh is not None:
         with use(mesh):
-            gen = _generate_scan(*args)
+            gen = _generate_scan(*args, greedy=temperature <= 0)
     else:
-        gen = _generate_scan(*args)
+        gen = _generate_scan(*args, greedy=temperature <= 0)
     return jnp.concatenate([prompt, gen], axis=1)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("dec_model", "steps", "temperature",
+                   static_argnames=("dec_model", "steps", "greedy",
                                     "top_k"))
 def _generate_scan(dec_model, params, cache, prompt, rng, steps,
-                   temperature, top_k=None, top_p=None):
+                   temperature, top_k=None, top_p=None, *, greedy=False):
     """The compiled prefill+decode loop — module-level so the jit cache
     persists across `generate` calls (flax Modules hash by their
-    dataclass fields, so same model config ⇒ cache hit)."""
+    dataclass fields, so same model config ⇒ cache hit).
+
+    ``temperature`` and ``top_p`` are traced operands, so changing
+    their values reuses the compiled program; what recompiles is the
+    static ``greedy`` flag (temperature <= 0 — selects the argmax
+    branch), ``top_k`` (a shape operand of `lax.top_k`), and toggling
+    ``top_p`` between None and a float (the arg pytree changes)."""
 
     def last_logits(cache, toks):
         """Apply one decode call and project ONLY the last position
@@ -604,7 +612,7 @@ def _generate_scan(dec_model, params, cache, prompt, rng, steps,
         return logits.astype(jnp.float32), mut["cache"]
 
     def pick(logits, r):
-        if temperature <= 0:
+        if greedy:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
         logits = logits / temperature
         neg = jnp.finfo(logits.dtype).min

@@ -254,7 +254,11 @@ def _make_flash(causal, window, q_offset, k_offset, block_q, block_k,
     Backward = flash-style recompute: differentiate the blockwise
     online-softmax scan (`sequence.blockwise_attention`, the same math)
     instead of saving the score matrix — O(S) residual memory, the
-    standard TPU rematerialization trade.
+    standard TPU rematerialization trade. With a sliding window the
+    backward is BANDED like the forward (`_banded_bwd`): Q is scanned
+    in `block_q` chunks and each chunk's VJP sees only the
+    `block_q + window - 1` keys its band can touch, so SWA training
+    moves O(S·(window+block)) bytes/FLOPs end to end, not O(S²).
     """
     from horovod_tpu.parallel.sequence import blockwise_attention
 
@@ -262,6 +266,56 @@ def _make_flash(causal, window, q_offset, k_offset, block_q, block_k,
         return blockwise_attention(
             q, k, v, block_size=block_k, causal=causal, window=window,
             q_offset=q_offset, k_offset=k_offset)
+
+    def _banded_bwd(q, k, v, g):
+        B, Sq, H, D = q.shape
+        Sk = k.shape[1]
+        C = min(block_q, Sq)
+        span = C + window - 1          # keys one q-chunk's band touches
+        nc = -(-Sq // C)
+        pad_q = nc * C - Sq
+        if pad_q:
+            # Padded q rows sit past the real sequence; their cotangent
+            # rows are zero, so every gradient contribution they make
+            # vanishes (dq row-local; dk/dv weighted by g rows).
+            q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+            g = jnp.pad(g, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+
+        def body(carry, ci):
+            dq_a, dk_a, dv_a = carry
+            qc = jax.lax.dynamic_slice_in_dim(q, ci * C, C, axis=1)
+            gc = jax.lax.dynamic_slice_in_dim(g, ci * C, C, axis=1)
+            # First key the chunk's band can touch, clamped so the
+            # static-size slice stays in range; the k_offset handed to
+            # the ref keeps masking exact under the clamp (keys pulled
+            # into the slice but outside the band are masked out).
+            lo = q_offset + ci * C - (window - 1) - k_offset
+            start = jnp.clip(lo, 0, Sk - span)
+            kc = jax.lax.dynamic_slice_in_dim(k, start, span, axis=1)
+            vc = jax.lax.dynamic_slice_in_dim(v, start, span, axis=1)
+            fn = functools.partial(
+                blockwise_attention, block_size=block_k, causal=True,
+                window=window, q_offset=q_offset + ci * C,
+                k_offset=k_offset + start)
+            _, vjp = jax.vjp(fn, qc, kc, vc)
+            dqc, dkc, dvc = vjp(gc)
+            dq_a = jax.lax.dynamic_update_slice_in_dim(
+                dq_a, dqc.astype(jnp.float32), ci * C, axis=1)
+            # Adjacent bands overlap by window-1 keys: read-add-write.
+            dk_a = jax.lax.dynamic_update_slice_in_dim(
+                dk_a, jax.lax.dynamic_slice_in_dim(dk_a, start, span, 1)
+                + dkc.astype(jnp.float32), start, axis=1)
+            dv_a = jax.lax.dynamic_update_slice_in_dim(
+                dv_a, jax.lax.dynamic_slice_in_dim(dv_a, start, span, 1)
+                + dvc.astype(jnp.float32), start, axis=1)
+            return (dq_a, dk_a, dv_a), None
+
+        z = (jnp.zeros(q.shape, jnp.float32),
+             jnp.zeros(k.shape, jnp.float32),
+             jnp.zeros(v.shape, jnp.float32))
+        (dq, dk, dv), _ = jax.lax.scan(body, z, jnp.arange(nc))
+        return (dq[:, :Sq].astype(q.dtype), dk.astype(k.dtype),
+                dv.astype(v.dtype))
 
     @jax.custom_vjp
     def flash(q, k, v):
@@ -275,6 +329,10 @@ def _make_flash(causal, window, q_offset, k_offset, block_q, block_k,
 
     def bwd(res, g):
         q, k, v = res
+        # Band the backward only when it actually shrinks the key span.
+        if (causal and window is not None
+                and min(block_q, q.shape[1]) + window - 1 < k.shape[1]):
+            return _banded_bwd(q, k, v, g)
         _, vjp = jax.vjp(ref, q, k, v)
         return vjp(g)
 
@@ -301,13 +359,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         `q_offset + i >= k_offset + j` (offsets support ring-attention
         style rotated blocks).
       window: sliding-window attention (last `window` positions only;
-        requires causal; >= 1). The FORWARD's innermost grid axis
-        covers only the k-blocks intersecting each q-block's band, so
-        out-of-band K/V is never read from HBM — forward SWA moves
-        O(S·(window+block_k)) bytes and FLOPs, not O(S²). The
-        recompute backward currently scans all blocks (out-of-band
-        ones masked), so training steps remain O(S²) there; a banded
-        backward is the natural follow-up.
+        requires causal; >= 1). Banded end to end: the FORWARD's
+        innermost grid axis covers only the k-blocks intersecting each
+        q-block's band (out-of-band K/V never read from HBM), and the
+        recompute BACKWARD scans q in `block_q` chunks whose VJPs see
+        only each band's `block_q + window - 1` keys — so an SWA
+        training step moves O(S·(window+block)) bytes and FLOPs, not
+        O(S²).
       block_q, block_k: VMEM tile sizes (128 matches the MXU; raise
         block_k to 256/512 when head_dim is small).
       interpret: run the kernel in interpreter mode (None = auto: True

@@ -29,6 +29,7 @@ blockwise attention never communicates across heads.
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Optional, Tuple
 
 import jax
@@ -66,6 +67,21 @@ def _online_block(carry, q, k, v, logit_bias):
 def _finalize(o, m, l, dtype):
     denom = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1)[..., None]
     return (o / denom).astype(dtype)
+
+
+def _accepts_kwarg(fn, name: str) -> bool:
+    """True if `fn` can be called with keyword `name` (directly, via
+    **kwargs, or through functools.partial layers). Unintrospectable
+    callables pass — the call itself will surface any real mismatch."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return True
+    params = sig.parameters
+    if name in params:
+        return True
+    return any(p.kind is inspect.Parameter.VAR_KEYWORD
+               for p in params.values())
 
 
 def check_window(window: "int | None") -> None:
@@ -230,15 +246,25 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                               tiled=True)
 
     check_window(window)
-    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     # Only forward window= when set, so pre-existing custom attn_impl
-    # callables without the kwarg keep working in window-less models.
+    # callables without the kwarg keep working in window-less models —
+    # but refuse up front (before tracing) when window IS set and the
+    # callable can't take it, instead of an opaque TypeError from
+    # inside the shard_map trace.
     kw = {} if window is None else {"window": window}
     if attn_impl is None:
         attn_impl = functools.partial(blockwise_attention, causal=causal,
                                       **kw)
     else:
+        if window is not None and not _accepts_kwarg(attn_impl, "window"):
+            raise ValueError(
+                f"window={window} was requested but the custom "
+                f"attn_impl {getattr(attn_impl, '__name__', attn_impl)!r} "
+                f"does not accept a 'window' keyword; add "
+                f"window: int | None = None to its signature (contract: "
+                f"attn_impl(q, k, v, *, causal, window) -> out)")
         attn_impl = functools.partial(attn_impl, causal=causal, **kw)
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     oh = attn_impl(qh, kh, vh)
     return heads_to_seq(oh)
 

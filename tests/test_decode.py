@@ -128,6 +128,31 @@ class TestGenerate:
         with pytest.raises(ValueError):
             generate(model, params, prompt, steps=2, temperature=1.0)
 
+    def test_temperature_change_does_not_recompile(self, hvd):
+        """temperature is a traced operand of the compiled decode loop:
+        sampling at a new temperature (and top_p) reuses the program —
+        only greedy<->sampling and top_k recompile (advisor r2 #2)."""
+        from horovod_tpu.models.transformer import _generate_scan
+        model = _tiny_model()
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        params = unbox(model.init(
+            jax.random.PRNGKey(6),
+            jnp.zeros((1, 16), jnp.int32))["params"])
+        generate(model, params, prompt, steps=4, temperature=0.7,
+                 rng=jax.random.PRNGKey(0))
+        n0 = _generate_scan._cache_size()
+        generate(model, params, prompt, steps=4, temperature=1.3,
+                 rng=jax.random.PRNGKey(0))
+        generate(model, params, prompt, steps=4, temperature=2.0,
+                 top_p=0.9, rng=jax.random.PRNGKey(0))
+        n1 = _generate_scan._cache_size()
+        # one extra entry for the top_p branch (None -> float changes
+        # the arg pytree), none for the temperature changes
+        assert n1 == n0 + 1, (n0, n1)
+        generate(model, params, prompt, steps=4, temperature=3.0,
+                 top_p=0.5, rng=jax.random.PRNGKey(0))
+        assert _generate_scan._cache_size() == n1
+
     def test_gqa_decode_matches_oracle_and_shrinks_cache(self, hvd):
         """GQA (num_kv_heads < num_heads): decode is token-exact vs the
         full-forward oracle, and the KV cache physically carries only

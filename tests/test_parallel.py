@@ -177,6 +177,34 @@ class TestSequenceParallel:
                                    _ref_attention(q, k, v, causal),
                                    rtol=2e-5, atol=2e-5)
 
+    def test_ulysses_rejects_windowless_custom_attn_impl(self):
+        """window= with a custom attn_impl that can't take it must be a
+        clear ValueError naming the contract, not a TypeError from
+        inside the shard_map trace (advisor r2 #4)."""
+        mesh = par.make_mesh(seq=4, data=2)
+        rng = np.random.RandomState(5)
+        q = jnp.asarray(rng.randn(2, 32, 4, 8), jnp.float32)
+        spec = P("data", "seq", None, None)
+
+        def no_window_impl(q, k, v, *, causal=False):
+            return par.dot_product_attention(q, k, v)
+
+        fn = jax.shard_map(
+            functools.partial(par.ulysses_attention, causal=True,
+                              window=4, attn_impl=no_window_impl),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        with pytest.raises(ValueError, match="window"):
+            fn(q, q, q)
+        # …and an impl that does take window= still composes.
+        ok = jax.shard_map(
+            functools.partial(
+                par.ulysses_attention, causal=True, window=4,
+                attn_impl=functools.partial(par.blockwise_attention,
+                                            block_size=8)),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        got = ok(q, q, q)
+        assert np.isfinite(np.asarray(got)).all()
+
     def test_ring_attention_grad(self):
         """Gradients flow through the ppermute ring."""
         mesh = par.make_mesh(seq=4, data=2)
