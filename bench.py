@@ -12,10 +12,14 @@ ResNet-101 throughput (~138 img/s, tf_cnn_benchmarks as used in
 arXiv:1802.05799's setup) — i.e. per-chip speed relative to the
 hardware the reference published on.
 
-Startup is hardened: backend acquisition runs under a watchdog so a
-hung TPU plugin (tunnel down) is reported as `backend_unavailable` in
-a diagnostic JSON instead of eating the driver's budget, and benchmark
-failures after init carry a distinct `error` field.
+Startup is hardened: backend acquisition is a LONG-HORIZON wait —
+fresh-subprocess probes of `jax.devices()` (default 10 x 90s watchdog
+with 40s backoff, ~20min patience) so a transient tunnel outage can't
+zero the round's only perf signal; only if every probe fails is
+`backend_unavailable` reported in a diagnostic JSON. Mid-run transient
+errors (remote_compile drops) retry with backoff. The Pallas flash
+fwd+bwd proof is emitted EARLY as its own JSON line so it survives a
+later model-bench timeout; the driver parses the final (model) line.
 
 Extras:
   --sweep-fusion 0,1048576,8388608,67108864   per-threshold img/s in
@@ -97,6 +101,59 @@ def acquire_devices(timeout_s):
     if "error" in box:
         return None, box["error"]
     return box["devices"], None
+
+
+def _force_platform(platform):
+    """`jax.config.update("jax_platforms", ...)` — the only forcing
+    that sticks: the axon sitecustomize re-asserts the JAX_PLATFORMS
+    env var, so the env var alone cannot select cpu."""
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+
+
+def wait_for_backend(attempts, probe_timeout_s, backoff_s,
+                     platform=None):
+    """Long-horizon backend wait: probe `jax.devices()` in FRESH
+    subprocesses until one succeeds (VERDICT r2 next-#1).
+
+    Why subprocesses: once an in-process `jax.devices()` hangs inside
+    the axon plugin's native init, every later call in that process
+    blocks on the same wedged process-global backend lock — in-process
+    retries can never recover. A fresh interpreter per probe re-runs
+    plugin init from scratch, so a tunnel that comes back mid-window
+    is actually seen. Only after a probe succeeds do we pay the
+    in-process acquisition (which then finds the tunnel up).
+
+    Returns (ok, last_error_string, probes_used, elapsed_s).
+    """
+    import subprocess
+    last = "no probe attempted"
+    t_start = time.time()
+    for i in range(max(1, attempts)):
+        if i:
+            log(f"backend probe {i}/{attempts} failed ({last}); "
+                f"retrying in {backoff_s:.0f}s")
+            time.sleep(backoff_s)
+        t0 = time.time()
+        force = (f"jax.config.update('jax_platforms', {platform!r}); "
+                 if platform else "")
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 f"import jax; {force}print(len(jax.devices()))"],
+                capture_output=True, text=True,
+                timeout=probe_timeout_s)
+        except subprocess.TimeoutExpired:
+            last = (f"probe hung > {probe_timeout_s:.0f}s "
+                    f"(TPU tunnel?)")
+            continue
+        if r.returncode == 0:
+            log(f"backend probe ok in {time.time() - t0:.1f}s "
+                f"({r.stdout.strip()} device(s), probe {i + 1})")
+            return True, None, i + 1, time.time() - t_start
+        last = (r.stderr.strip().splitlines() or ["no stderr"])[-1][:300]
+    return False, last, max(1, attempts), time.time() - t_start
 
 
 def time_steps(step, state, batch, rng, steps, warmup):
@@ -269,10 +326,24 @@ def main():
     ap.add_argument("--no-flash", action="store_true",
                     help="skip the Pallas flash-attention hardware "
                          "proof")
-    ap.add_argument("--init-timeout", type=float, default=90.0)
-    ap.add_argument("--retries", type=int, default=2,
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu for smoke "
+                         "tests; the axon sitecustomize re-asserts "
+                         "JAX_PLATFORMS, so the env var alone cannot)")
+    ap.add_argument("--init-timeout", type=float, default=90.0,
+                    help="watchdog for each backend probe / the final "
+                         "in-process acquisition")
+    ap.add_argument("--init-attempts", type=int, default=10,
+                    help="subprocess backend probes before giving up "
+                         "(long-horizon wait: one bad minute of tunnel "
+                         "must not zero the round's perf signal)")
+    ap.add_argument("--init-backoff", type=float, default=40.0,
+                    help="seconds between backend probes")
+    ap.add_argument("--retries", type=int, default=4,
                     help="re-attempts after a transient tunnel/backend "
                          "error (remote_compile drops mid-run)")
+    ap.add_argument("--retry-backoff", type=float, default=20.0,
+                    help="seconds between transient-error retries")
     ap.add_argument("--remat", action="store_true",
                     help="jax.checkpoint the forward (fit larger batch)")
     ap.add_argument("--seq", type=int, default=2048,
@@ -313,9 +384,18 @@ def main():
         # once a backend exists) — no watchdog probe.
         devices = None
     else:
+        _force_platform(args.platform)
+        ok, err, probes, waited = wait_for_backend(
+            args.init_attempts, args.init_timeout, args.init_backoff,
+            platform=args.platform)
+        if not ok:
+            fail(metric, unit, "backend_unavailable",
+                 f"{err} (after {probes} probes over "
+                 f"{waited / 60:.1f}min)")
         devices, err = acquire_devices(args.init_timeout)
         if err is not None:
-            fail(metric, unit, "backend_unavailable", err)
+            fail(metric, unit, "backend_unavailable",
+                 f"{err} (probe succeeded but in-process init failed)")
 
     try:
         import jax
@@ -346,7 +426,9 @@ def main():
                 if (attempt < args.retries
                         and any(t in repr(e) for t in transient)):
                     log(f"transient backend error (attempt "
-                        f"{attempt + 1}): {e!r}; retrying")
+                        f"{attempt + 1}): {e!r}; retrying in "
+                        f"{args.retry_backoff:.0f}s")
+                    time.sleep(args.retry_backoff)
                     continue
                 raise
     except SystemExit:
@@ -355,6 +437,9 @@ def main():
         import traceback
         traceback.print_exc(file=sys.stderr)
         fail(metric, unit, "benchmark_failed", repr(e))
+
+
+_FLASH_DONE = {}  # the proof runs once even across transient retries
 
 
 def _bench_body(args, devices, n_chips, metric, unit,
@@ -367,6 +452,27 @@ def _bench_body(args, devices, n_chips, metric, unit,
     from horovod_tpu import models
     from horovod_tpu.models import make_cnn_train_step
     from horovod_tpu.models.train import init_cnn_state
+
+    # Flash-attention hardware proof FIRST, as its own emitted JSON
+    # line (VERDICT r2 next-#3): the cheapest driver-visible artifact,
+    # so the hot kernel's on-chip timing survives in the output tail
+    # even if the heavy model bench below times out. The final model
+    # line is still the LAST line (what the driver parses). Runs once
+    # even if a transient error re-enters this body via the retry
+    # loop (no duplicate compile cost / emitted lines).
+    flash_ms = flash_err = None
+    if not args.no_flash and not _FLASH_DONE.get("done"):
+        _FLASH_DONE["done"] = True
+        try:
+            flash_ms = flash_attention_proof(platform)
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            flash_err = repr(e)
+            log(f"flash proof failed: {flash_err}")
+        if flash_ms is not None:
+            emit({"metric": "flash_attn_fwd_bwd_ms", "value": flash_ms,
+                  "unit": "ms", "vs_baseline": None,
+                  "platform": platform, "device_kind": device_kind,
+                  "shape": "B4 S2048 H8 D128 bf16 causal"})
 
     is_lm = args.model == "transformer"
     if is_lm and args.decode:
@@ -481,13 +587,6 @@ def _bench_body(args, devices, n_chips, metric, unit,
             (shape[1] / base) ** 2
         gflops = TRAIN_GFLOPS_PER_IMG[args.model] * scale
         mfu = round(img_s_chip * gflops * 1e9 / peak, 4)
-
-    flash_ms = flash_err = None
-    if not args.no_flash:
-        try:
-            flash_ms = flash_attention_proof(platform)
-        except Exception as e:  # noqa: BLE001 — report, don't die
-            flash_err = repr(e)
 
     result = {
         "metric": metric,
