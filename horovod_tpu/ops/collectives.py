@@ -85,8 +85,19 @@ def broadcast(x: jax.Array, root_rank: int, *,
 
     Parity: `hvd.broadcast` (`horovod/tensorflow/mpi_ops.py:173-187`,
     kernel `mpi_ops.cc:1110-1137`). Implemented as a masked psum — only the
-    root contributes — which XLA lowers to an efficient one-to-all over the
-    torus; exact for every numeric dtype since exactly one rank is nonzero.
+    root contributes — exact for every numeric dtype since exactly one
+    rank is nonzero.
+
+    Lowering (verified: `tests/test_collectives.py`
+    TestBroadcastLowering pins it): ONE `all-reduce` HLO with the mask
+    fused in — no all-gather, no loop. XLA has no rewrite of this
+    pattern to `collective-broadcast`, so the wire cost is an
+    all-reduce's ~2·|x|·(N−1)/N per link, ≈2x a perfect pipelined
+    one-to-all. Accepted: in the Horovod model broadcast is the
+    init-time weight sync (`BroadcastGlobalVariablesHook`, reference
+    `horovod/tensorflow/__init__.py:143-166`), not a training-loop op,
+    so one-shot cost beats the complexity of a chunked ppermute ring
+    pipeline (the only way to reach 1x with today's JAX collectives).
     """
     idx = lax.axis_index(axis_name)
     if jnp.issubdtype(x.dtype, jnp.bool_):

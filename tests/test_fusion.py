@@ -79,3 +79,65 @@ def test_fusion_env_var(hvd, monkeypatch):
     finally:
         monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD")
         config.refresh()
+
+
+class TestOverlapStructure:
+    """Pin the PRECONDITION for backward/allreduce overlap (VERDICT r2
+    next-#4): the IR handed to XLA must contain one INDEPENDENT
+    all_reduce per gradient bucket — none chained through another
+    collective — so the latency-hiding scheduler is free to issue each
+    bucket's collective as soon as its grads exist, instead of one
+    monolithic all-reduce that can only trail the whole backward.
+
+    What this test deliberately does NOT claim: the CPU test backend's
+    AllReduceCombiner pass re-merges these into one tuple all-reduce
+    in the compiled module (observed: the merged op schedules after
+    the last backward convolution), so a CPU schedule cannot evidence
+    overlap; exposed-comm fraction is measurable only on >=2 real
+    chips (docs/scaling.md carries the full analysis)."""
+
+    def _stablehlo(self, threshold):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from horovod_tpu import models
+        from horovod_tpu.models import make_cnn_train_step
+        from horovod_tpu.models.train import init_cnn_state
+
+        model = models.MnistConvNet(dtype=jnp.float32)
+        tx = optax.sgd(0.1)
+        state = init_cnn_state(model, tx, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 28, 28, 1), jnp.float32))
+        step = make_cnn_train_step(model, tx,
+                                   fusion_threshold=threshold)
+        x = jnp.zeros((8, 28, 28, 1))
+        y = jnp.zeros((8,), jnp.int64).astype(jnp.int32)
+        return step.__wrapped__.lower(
+            state, (x, y), jax.random.PRNGKey(1)).as_text()
+
+    def test_one_independent_all_reduce_per_bucket(self, hvd):
+        import re
+
+        n_grad_leaves = 8  # MnistConvNet: 4 layers x (kernel, bias)
+
+        # threshold=1 byte: every grad leaf is its own bucket.
+        txt = self._stablehlo(1)
+        ops = re.findall(
+            r'(%\d+(?::\d+)?) = "stablehlo.all_reduce"\(([^)]*)\)', txt)
+        # 8 grad buckets + the scalar loss pmean.
+        assert len(ops) == n_grad_leaves + 1, txt[:500]
+
+        # Independence: no all_reduce consumes another's result — the
+        # buckets form an antichain the scheduler may freely reorder.
+        results = {name.split(":")[0] for name, _ in ops}
+        for _, operands in ops:
+            for op in re.findall(r"%\d+", operands):
+                assert op not in results, (
+                    f"all_reduce chained through {op}")
+
+        # 64 MB threshold: all same-dtype grads fuse into ONE bucket
+        # (+ the loss pmean) — HOROVOD_FUSION_THRESHOLD controls the
+        # collective granularity of the IR end to end.
+        txt = self._stablehlo(1 << 26)
+        assert len(re.findall(r"stablehlo\.all_reduce", txt)) == 2
