@@ -254,10 +254,16 @@ def run_decode(args, devices, n_chips, log):
     out = generate(model, params, prompt, steps=steps)
     np.asarray(out)  # full device->host fence (see time_steps)
     log(f"decode compiled+first run in {time.time() - t0:.1f}s")
-    t0 = time.time()
-    out = generate(model, params, prompt, steps=steps)
-    np.asarray(out)
-    dt = time.time() - t0
+    import contextlib
+    ctx = (jax.profiler.trace(args.profile) if args.profile
+           else contextlib.nullcontext())
+    with ctx:
+        t0 = time.time()
+        out = generate(model, params, prompt, steps=steps)
+        np.asarray(out)
+        dt = time.time() - t0
+    if args.profile:
+        log(f"profiler trace written to {args.profile}")
     tok_s = B * steps / dt
     log(f"decode: {tok_s:.1f} tokens/s "
         f"({dt / steps * 1e3:.2f} ms/tick at B={B})")

@@ -215,6 +215,56 @@ def _mc_local_devices(st):
     return [d for d in st.devices if d.process_index == pidx]
 
 
+def _mc_mesh2(st):
+    """(proc, local) two-axis view of the multi-controller device set.
+
+    Lets collectives reduce across PROCESSES on intra-process SHARDS:
+    device (p, l) carries only chunk l of process p's block, the
+    cross-process psum runs over ``proc`` in k parallel chunk groups,
+    and the full result reassembles with an intra-process all_gather
+    over ``local`` — so the wire payload per process is its block
+    ONCE, not k times (VERDICT r2 next-#7). Cached on the state.
+    """
+    cached = getattr(st, "mc_mesh2", None)
+    if cached is not None:
+        return cached
+    from jax.sharding import Mesh
+    procs = sorted({d.process_index for d in st.devices})
+    rows = [[d for d in st.devices if d.process_index == p]
+            for p in procs]
+    k = len(rows[0])
+    if any(len(r) != k for r in rows):
+        raise RuntimeError(
+            f"multi-process collectives require uniform device "
+            f"ownership; got {[len(r) for r in rows]}")
+    mesh = Mesh(np.array(rows), ("proc", "local"))
+    st.mc_mesh2 = mesh
+    return mesh
+
+
+def _mc_chunked_global(st, mesh2, x: np.ndarray):
+    """Shard `x` (this process's block) over the ``local`` axis:
+    [nproc, k, chunk] global array where device (p, l) holds the l-th
+    flat chunk of process p's block — each local device receives 1/k
+    of the block instead of a full copy."""
+    import jax
+    k = mesh2.shape["local"]
+    n = x.size
+    chunk = -(-n // k)
+    flat = np.ravel(x)
+    if chunk * k != n:
+        flat = np.pad(flat, (0, chunk * k - n))
+    blocks = flat.reshape(k, chunk)
+    pidx = jax.process_index()
+    procs = sorted({d.process_index for d in st.devices})
+    row = mesh2.devices[procs.index(pidx)]
+    sharding = NamedSharding(mesh2, P("proc", "local"))
+    shards = [jax.device_put(jnp.asarray(blocks[l])[None, None], row[l])
+              for l in range(k)]
+    return jax.make_array_from_single_device_arrays(
+        (mesh2.shape["proc"], k, chunk), sharding, shards), chunk
+
+
 def _mc_global_array(st, local_block: np.ndarray) -> jax.Array:
     """Assemble the [world, ...] global array where every device owned by
     this process holds `local_block` as its shard."""
@@ -269,8 +319,10 @@ def _shard_over_mesh(st, stacked: np.ndarray) -> jax.Array:
     return jax.device_put(jnp.asarray(stacked), sharding)
 
 
-def _run_collective(st, key, fn, data):
-    """Dispatch a cached shard_map'd collective over the framework mesh.
+def _run_collective(st, key, fn, data, *, mesh=None, in_specs=None):
+    """Dispatch a cached shard_map'd collective over the framework mesh
+    (or an explicit `mesh`/`in_specs`, e.g. the chunked mc (proc,
+    local) mesh).
 
     `data` is either a host [world, ...] stack (single-controller) or an
     already-placed global jax.Array (multi-controller).
@@ -281,8 +333,8 @@ def _run_collective(st, key, fn, data):
         # construction but JAX's static replication checker cannot prove
         # it, so the check is disabled for these dispatch wrappers.
         shaped = jax.shard_map(
-            fn, mesh=st.mesh,
-            in_specs=P(st.axis_name),
+            fn, mesh=st.mesh if mesh is None else mesh,
+            in_specs=P(st.axis_name) if in_specs is None else in_specs,
             out_specs=P(),
             check_vma=False,
         )
@@ -327,29 +379,48 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
             key = ("allreduce", average, stacked.shape, str(stacked.dtype))
             return _run_collective(st, key, _kernel, stacked)
         if _is_multicontroller(st):
-            # True MPMD path: this process's local tensor, reduced across
-            # processes after KV negotiation. Each process replicates its
-            # block onto all k of its local devices, so the device psum
-            # overcounts by exactly k — divide it back out; ranks are
-            # processes here, matching Horovod's process-rank model.
+            # True MPMD path: this process's local tensor, reduced
+            # across processes after KV negotiation; ranks are
+            # processes, matching Horovod's process-rank model. With
+            # k > 1 local devices the block is SHARDED over them
+            # (``local`` axis of `_mc_mesh2`), the cross-process psum
+            # runs over ``proc`` in k parallel chunk groups, and an
+            # intra-process all_gather reassembles — wire payload per
+            # process is its block once (no k-fold duplication).
             x = np.asarray(tensor)
             _mc_negotiate(st, opname, "allreduce", x, None, False,
                           extra=_meta_extra)
             _timeline(st, opname, "TOP_LEVEL", "ALLREDUCE")
-            k = st.size // st.num_processes
             nproc = st.num_processes
+            k = st.size // nproc
+            if k == 1 or x.size == 0:
+                # One device per process: the plain mesh psum is
+                # already payload-optimal.
+                def _kernel(g):
+                    from jax import lax
+                    s = lax.psum(g[0], st.axis_name)
+                    if jnp.issubdtype(s.dtype, jnp.integer):
+                        return s // nproc if average else s
+                    return s / nproc if average else s
+                key = ("mc_allreduce", average, x.shape, str(x.dtype))
+                return _run_collective(
+                    st, key, _kernel, _mc_global_array(st, x))
+            mesh2 = _mc_mesh2(st)
+            garr, chunk = _mc_chunked_global(st, mesh2, x)
 
             def _kernel(g):
                 from jax import lax
-                s = lax.psum(g[0], st.axis_name)
-                if jnp.issubdtype(s.dtype, jnp.integer):
-                    s = s // k  # exact: every term is duplicated k times
-                    return s // nproc if average else s
-                s = s / k
-                return s / nproc if average else s
-            key = ("mc_allreduce", average, x.shape, str(x.dtype))
-            return _run_collective(
-                st, key, _kernel, _mc_global_array(st, x))
+                s = lax.psum(g, "proc")            # [1, 1, chunk]
+                full = lax.all_gather(s, "local", axis=1,
+                                      tiled=True)  # [1, k, chunk]
+                flat = full.reshape(-1)
+                if jnp.issubdtype(flat.dtype, jnp.integer):
+                    return flat // nproc if average else flat
+                return flat / nproc if average else flat
+            key = ("mc_allreduce2", average, x.shape, str(x.dtype))
+            out = _run_collective(st, key, _kernel, garr, mesh=mesh2,
+                                  in_specs=P("proc", "local"))
+            return out[:x.size].reshape(x.shape)
         # Replicated value: every rank contributes the same tensor.
         x = jnp.asarray(tensor)
         _timeline(st, opname, "TOP_LEVEL", "ALLREDUCE")

@@ -58,7 +58,8 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
                    stage_params: Any,
                    microbatches: jax.Array,
                    *, axis_name: str = AXIS_PIPE,
-                   num_chunks: int = 1) -> jax.Array:
+                   num_chunks: int = 1,
+                   remat: bool = False) -> jax.Array:
     """Run `microbatches` through the pipeline (SPMD; call in shard_map).
 
     Args:
@@ -73,6 +74,18 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         across the ``pipe`` axis (only stage 0 reads it).
       num_chunks: chunks per device (v). 1 = GPipe; >1 = interleaved
         schedule with a v× smaller pipeline bubble (module docstring).
+      remat: `jax.checkpoint` the stage body per tick. Without it,
+        differentiating through the scan stores EVERY interior
+        intermediate of `stage_fn` for all `v·M + P − 1` ticks —
+        activation memory `O(ticks · stage_interior)`, the classic
+        reason 1F1B exists. With it, the backward keeps only each
+        tick's stage INPUT (already a scan residual) and recomputes
+        the interior, bounding the footprint at
+        `O(ticks · microbatch_activation) + one stage interior` —
+        the standard TPU remat trade (one extra stage forward per
+        tick). Tested: `tests/test_parallel.py::TestPipelineParallel::
+        test_remat_matches_and_bounds_residuals` asserts the
+        residual-byte drop and grad equality.
 
     Returns:
       [M, mb, ...] final-stage outputs, replicated across ``pipe``.
@@ -90,6 +103,8 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     ticks = v * M + nstages - 1
     fwd = [(i, (i + 1) % nstages) for i in range(nstages)]
     group = v * nstages  # work-items per P-microbatch group
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
     def tick(carry, t):
         state, outputs = carry
@@ -151,7 +166,8 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
 
 def pipeline_apply_gspmd(mesh, stage_fn, stacked_params, microbatches,
                          *, data_sharded: bool = True,
-                         num_chunks: int = 1) -> jax.Array:
+                         num_chunks: int = 1,
+                         remat: bool = False) -> jax.Array:
     """`pipeline_apply` as a shard_map region inside a pjit'ed step.
 
     `stacked_params`: pytree whose leaves have leading dim P (one slice
@@ -169,7 +185,7 @@ def pipeline_apply_gspmd(mesh, stage_fn, stacked_params, microbatches,
     def body(params, x):
         local = jax.tree.map(lambda a: jnp.squeeze(a, 0), params)
         return pipeline_apply(stage_fn, local, x,
-                              num_chunks=num_chunks)
+                              num_chunks=num_chunks, remat=remat)
 
     return jax.shard_map(
         body, mesh=mesh,

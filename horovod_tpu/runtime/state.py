@@ -61,6 +61,9 @@ class GlobalState:
         self.stall_monitor: Optional[Any] = None
         # Eager-path compile cache: name -> jitted collective.
         self.op_cache: dict = {}
+        # (proc, local) mesh for payload-deduplicated mc collectives
+        # (built lazily by ops.eager._mc_mesh2).
+        self.mc_mesh2: Optional[Any] = None
 
     def reset(self) -> None:
         self.initialized = False
@@ -70,6 +73,7 @@ class GlobalState:
         self.mesh = None
         self.devices = []
         self.op_cache = {}
+        self.mc_mesh2 = None
         self.timeline = None
         self.stall_monitor = None
 

@@ -23,6 +23,37 @@ def main():
     out = np.asarray(hvd.allreduce(x, average=True))
     np.testing.assert_allclose(out, 1.5)
 
+    # Ragged (size % k != 0) and integer paths through the chunked
+    # kernel: 5 elements over k=2 local devices pad to chunks of 3.
+    xi = np.arange(5, dtype=np.int32) + r
+    np.testing.assert_array_equal(
+        np.asarray(hvd.allreduce(xi, average=False)),
+        2 * np.arange(5) + 1)
+
+    # Counted-bytes check (VERDICT r2 next-#7): the cross-process
+    # all-reduce must move chunk = n/k elements in k parallel groups
+    # of nproc ranks — the k-fold payload duplication is gone.
+    import re
+
+    from horovod_tpu.ops import eager
+    from horovod_tpu.runtime import state as _state
+    st = _state.check_initialized()
+    key = ("mc_allreduce2", False, (4,), "float32")
+    assert key in st.op_cache, sorted(st.op_cache)
+    mesh2 = eager._mc_mesh2(st)
+    garr, chunk = eager._mc_chunked_global(
+        st, mesh2, np.ones((4,), np.float32))
+    assert chunk == 2, chunk
+    hlo = st.op_cache[key].lower(garr).compile().as_text()
+    ars = [l for l in hlo.splitlines() if "all-reduce(" in l]
+    assert len(ars) == 1, ars
+    line = ars[0]
+    assert "f32[1,1,2]" in line, line          # chunk, not the block
+    m = re.search(r"replica_groups=\{(.*?)\}\}", line)
+    groups = re.findall(r"\{([\d,]+)\}", m.group(0))
+    assert len(groups) == 2, line              # k chunk groups...
+    assert all(len(g.split(",")) == 2 for g in groups), line  # of nproc
+
     got = np.asarray(hvd.broadcast(
         np.full((2,), float(r * 5), np.float32), 1))
     np.testing.assert_allclose(got, 5.0)

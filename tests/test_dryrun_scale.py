@@ -1,0 +1,34 @@
+"""Virtual-mesh scale: the full multi-axis dryrun beyond 8 devices.
+
+VERDICT r2 next-#6: the 8-device meshes the suite (and the driver)
+exercise can hide factorization/divisibility bugs in `_split`, the
+interleaved pipeline placement, and eager negotiation that only appear
+at larger N. These tests run the SAME `dryrun_multichip` the driver
+uses — every parallelism composition (dp CNN, dp/sp/tp ring LM,
+dp/ep/tp MoE+FSDP+GQA LM, GPipe + interleaved pp), one real train step
+each — at 16 and 32 virtual CPU devices in a subprocess (the dryrun
+commandeers the process's backend, so it cannot share this one).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("n", [16, 32])
+def test_dryrun_multichip_at_scale(n):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the dryrun sets its own device count
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "-c",
+         f"import __graft_entry__ as g; g.dryrun_multichip({n})"],
+        capture_output=True, text=True, cwd=REPO, env=env,
+        timeout=540)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert f"dryrun_multichip({n}): OK" in res.stderr + res.stdout, (
+        res.stdout + res.stderr)

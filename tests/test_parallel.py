@@ -355,6 +355,107 @@ class TestPipelineParallel:
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4),
             g1, g2)
 
+    def test_remat_matches_and_bounds_residuals(self):
+        """remat=True: gradients are bit-compatible with the plain
+        path, and the backward's per-tick residuals shrink from every
+        stage INTERIOR intermediate to just the stage input — the
+        memory-bounding promise of `pipeline_apply(remat=)` (VERDICT
+        r2 next-#5). Measured structurally: the forward scan's
+        stacked [ticks, ...] residual outputs in the grad jaxpr."""
+        mesh = par.make_mesh(pipe=4, data=2)
+        d, hidden, M, mb = 8, 64, 8, 4
+        P_, v = 4, 1
+        ticks = v * M + P_ - 1
+
+        def fat_stage(p, x):   # interior is hidden/d = 8x wider than x
+            h = jnp.tanh(x @ p["w1"])
+            h = jnp.tanh(h @ p["w2"])
+            return jnp.tanh(h @ p["w3"])
+
+        rng = np.random.RandomState(11)
+        per_stage = [
+            {"w1": jnp.asarray(rng.randn(d, hidden) * .3, jnp.float32),
+             "w2": jnp.asarray(rng.randn(hidden, hidden) * .1,
+                               jnp.float32),
+             "w3": jnp.asarray(rng.randn(hidden, d) * .3, jnp.float32)}
+            for _ in range(P_)]
+        stacked = par.PipelineStage.stack(per_stage)
+        x = jnp.asarray(rng.randn(M, mb, d), jnp.float32)
+
+        def residual_bytes(remat):
+            def loss(sp, mbatch):
+                y = par.pipeline_apply_gspmd(mesh, fat_stage, sp,
+                                             mbatch, remat=remat)
+                return (y ** 2).mean()
+            jaxpr = jax.make_jaxpr(jax.grad(loss))(stacked, x)
+            total = 0
+
+            def walk(jx):
+                nonlocal total
+                for eqn in jx.eqns:
+                    if eqn.primitive.name == "scan":
+                        for ov in eqn.outvars:
+                            shp = ov.aval.shape
+                            if len(shp) > 1 and shp[0] == ticks:
+                                total += (int(np.prod(shp))
+                                          * ov.aval.dtype.itemsize)
+                    for sub in eqn.params.values():
+                        inner = getattr(sub, "jaxpr", sub)
+                        if hasattr(inner, "eqns"):
+                            walk(inner)
+
+            walk(jaxpr.jaxpr)
+            return total
+
+        plain, bounded = residual_bytes(False), residual_bytes(True)
+        # Plain stores interior (~3 x hidden wide) per tick; remat only
+        # the d-wide stage input: expect ~(3*hidden+d)/d ~ 25x here.
+        assert bounded > 0
+        assert plain / bounded > 5, (plain, bounded)
+        # Per-tick bound: with remat, residuals are O(ticks * input).
+        per_shard_mb = mb // 2  # data axis = 2
+        input_bytes = ticks * per_shard_mb * d * 4
+        assert bounded <= 4 * input_bytes, (bounded, input_bytes)
+
+        def loss(remat):
+            def f(sp, mbatch):
+                y = par.pipeline_apply_gspmd(mesh, fat_stage, sp,
+                                             mbatch, remat=remat)
+                return (y ** 2).mean()
+            return f
+
+        g1 = jax.jit(jax.grad(loss(False)))(stacked, x)
+        g2 = jax.jit(jax.grad(loss(True)))(stacked, x)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5),
+            g1, g2)
+
+    def test_interleaved_remat_matches(self):
+        """remat composes with the interleaved (v>1) schedule."""
+        P_, v, M, mb, d = 2, 2, 4, 4, 4
+        mesh = par.make_mesh(pipe=P_, data=4)
+        per_stage, _ = self._make(v * P_, d)
+        inter = par.PipelineStage.stack_interleaved(
+            [jax.tree.map(jnp.asarray, p) for p in per_stage], P_)
+        x = jnp.asarray(
+            np.random.RandomState(12).randn(M, mb, d).astype(np.float32))
+
+        def loss(remat):
+            def f(sp, mbatch):
+                y = par.pipeline_apply_gspmd(
+                    mesh, self._stage_fn, sp, mbatch,
+                    num_chunks=v, remat=remat)
+                return (y ** 2).mean()
+            return f
+
+        g1 = jax.jit(jax.grad(loss(False)))(inter, x)
+        g2 = jax.jit(jax.grad(loss(True)))(inter, x)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4),
+            g1, g2)
+
     def test_interleaved_rejects_ragged_microbatches(self):
         mesh = par.make_mesh(pipe=4, data=2)
         per_stage, _ = self._make(8, 4)
