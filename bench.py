@@ -156,11 +156,20 @@ def wait_for_backend(attempts, probe_timeout_s, backoff_s,
     return False, last, max(1, attempts), time.time() - t_start
 
 
-def time_steps(step, state, batch, rng, steps, warmup,
-               profile_dir=None):
+def _profile_ctx(profile_dir):
+    """jax.profiler trace context (nullcontext when disabled); the
+    caller must time strictly inside it so profiler start/serialize
+    stay untimed."""
     import contextlib
 
     import jax
+    if not profile_dir:
+        return contextlib.nullcontext()
+    return jax.profiler.trace(profile_dir)
+
+
+def time_steps(step, state, batch, rng, steps, warmup,
+               profile_dir=None):
     t0 = time.time()
     for _ in range(max(1, warmup)):  # >=1 so compile stays untimed
         state, loss = step(state, batch, rng)
@@ -171,11 +180,7 @@ def time_steps(step, state, batch, rng, steps, warmup,
     warm_loss = float(loss)
     compile_s = time.time() - t0
     log(f"warmup done in {compile_s:.1f}s (loss={warm_loss:.3f})")
-    ctx = (jax.profiler.trace(profile_dir) if profile_dir
-           else contextlib.nullcontext())
-    with ctx:
-        # Timed window sits strictly inside the profiler context, so
-        # profiler start and trace serialization stay untimed.
+    with _profile_ctx(profile_dir):
         t0 = time.time()
         for _ in range(steps):
             state, loss = step(state, batch, rng)
@@ -254,10 +259,7 @@ def run_decode(args, devices, n_chips, log):
     out = generate(model, params, prompt, steps=steps)
     np.asarray(out)  # full device->host fence (see time_steps)
     log(f"decode compiled+first run in {time.time() - t0:.1f}s")
-    import contextlib
-    ctx = (jax.profiler.trace(args.profile) if args.profile
-           else contextlib.nullcontext())
-    with ctx:
+    with _profile_ctx(args.profile):
         t0 = time.time()
         out = generate(model, params, prompt, steps=steps)
         np.asarray(out)

@@ -256,7 +256,9 @@ def _mc_chunked_global(st, mesh2, x: np.ndarray):
         flat = np.pad(flat, (0, chunk * k - n))
     blocks = flat.reshape(k, chunk)
     pidx = jax.process_index()
-    procs = sorted({d.process_index for d in st.devices})
+    # This process's row of the (proc, local) mesh — rows are ordered
+    # by process index by construction in `_mc_mesh2`.
+    procs = [r[0].process_index for r in mesh2.devices]
     row = mesh2.devices[procs.index(pidx)]
     sharding = NamedSharding(mesh2, P("proc", "local"))
     shards = [jax.device_put(jnp.asarray(blocks[l])[None, None], row[l])
@@ -407,20 +409,20 @@ def allreduce(tensor, average: bool = True, name: Optional[str] = None,
                     st, key, _kernel, _mc_global_array(st, x))
             mesh2 = _mc_mesh2(st)
             garr, chunk = _mc_chunked_global(st, mesh2, x)
+            n, shape = x.size, x.shape  # static in the cached kernel
 
             def _kernel(g):
                 from jax import lax
                 s = lax.psum(g, "proc")            # [1, 1, chunk]
                 full = lax.all_gather(s, "local", axis=1,
                                       tiled=True)  # [1, k, chunk]
-                flat = full.reshape(-1)
+                flat = full.reshape(-1)[:n].reshape(shape)
                 if jnp.issubdtype(flat.dtype, jnp.integer):
                     return flat // nproc if average else flat
                 return flat / nproc if average else flat
             key = ("mc_allreduce2", average, x.shape, str(x.dtype))
-            out = _run_collective(st, key, _kernel, garr, mesh=mesh2,
-                                  in_specs=P("proc", "local"))
-            return out[:x.size].reshape(x.shape)
+            return _run_collective(st, key, _kernel, garr, mesh=mesh2,
+                                   in_specs=P("proc", "local"))
         # Replicated value: every rank contributes the same tensor.
         x = jnp.asarray(tensor)
         _timeline(st, opname, "TOP_LEVEL", "ALLREDUCE")

@@ -83,9 +83,13 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
         the interior, bounding the footprint at
         `O(ticks · microbatch_activation) + one stage interior` —
         the standard TPU remat trade (one extra stage forward per
-        tick). Tested: `tests/test_parallel.py::TestPipelineParallel::
-        test_remat_matches_and_bounds_residuals` asserts the
-        residual-byte drop and grad equality.
+        tick). Holds for the interleaved schedule too: the per-tick
+        chunk-param indexing sits inside the checkpoint boundary, so
+        chunk params are re-sliced in the backward, not stacked as
+        `[ticks, ...]` residuals. Tested:
+        `tests/test_parallel.py::TestPipelineParallel::
+        test_remat_matches_and_bounds_residuals` (v=1 and v=2)
+        asserts the residual-byte drop and grad equality.
 
     Returns:
       [M, mb, ...] final-stage outputs, replicated across ``pipe``.
@@ -103,8 +107,21 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     ticks = v * M + nstages - 1
     fwd = [(i, (i + 1) % nstages) for i in range(nstages)]
     group = v * nstages  # work-items per P-microbatch group
+
+    def _apply(params, c, x):
+        # Chunk indexing lives INSIDE the checkpoint boundary: with
+        # remat, the per-tick [chunk-params] slice is recomputed in the
+        # backward instead of becoming a stacked [ticks, ...] scan
+        # residual (which would reintroduce O(ticks·params) memory for
+        # the interleaved schedule).
+        if v > 1:
+            params = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(
+                    a, c, axis=0, keepdims=False), params)
+        return stage_fn(params, x)
+
     if remat:
-        stage_fn = jax.checkpoint(stage_fn)
+        _apply = jax.checkpoint(_apply)
 
     def tick(carry, t):
         state, outputs = carry
@@ -125,13 +142,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
             idx == 0, jnp.logical_and(c == 0, jnp.logical_and(
                 m_feed >= 0, m_feed < M)))
         x = jnp.where(take_feed, feed, state)
-        if v == 1:
-            params_c = stage_params
-        else:
-            params_c = jax.tree.map(
-                lambda a: lax.dynamic_index_in_dim(
-                    a, c, axis=0, keepdims=False), stage_params)
-        y = stage_fn(params_c, x)
+        y = _apply(stage_params, c, x)
         # The finished microbatch m_out leaves the pipeline at the last
         # device's last chunk. A microbatch invalid at chunk (c, d)
         # stays invalid at the next hop, so garbage can never reach the

@@ -50,6 +50,7 @@ def main():
     line = ars[0]
     assert "f32[1,1,2]" in line, line          # chunk, not the block
     m = re.search(r"replica_groups=\{(.*?)\}\}", line)
+    assert m, line  # HLO text format changed — update the check
     groups = re.findall(r"\{([\d,]+)\}", m.group(0))
     assert len(groups) == 2, line              # k chunk groups...
     assert all(len(g.split(",")) == 2 for g in groups), line  # of nproc

@@ -355,16 +355,20 @@ class TestPipelineParallel:
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4),
             g1, g2)
 
-    def test_remat_matches_and_bounds_residuals(self):
+    @pytest.mark.parametrize("v", [1, 2])
+    def test_remat_matches_and_bounds_residuals(self, v):
         """remat=True: gradients are bit-compatible with the plain
         path, and the backward's per-tick residuals shrink from every
         stage INTERIOR intermediate to just the stage input — the
         memory-bounding promise of `pipeline_apply(remat=)` (VERDICT
         r2 next-#5). Measured structurally: the forward scan's
-        stacked [ticks, ...] residual outputs in the grad jaxpr."""
+        stacked [ticks, ...] residual outputs in the grad jaxpr.
+        v=2 additionally pins that the interleaved chunk-param
+        indexing happens INSIDE the checkpoint (no [ticks, params]
+        residual stack)."""
         mesh = par.make_mesh(pipe=4, data=2)
         d, hidden, M, mb = 8, 64, 8, 4
-        P_, v = 4, 1
+        P_ = 4
         ticks = v * M + P_ - 1
 
         def fat_stage(p, x):   # interior is hidden/d = 8x wider than x
@@ -378,14 +382,18 @@ class TestPipelineParallel:
              "w2": jnp.asarray(rng.randn(hidden, hidden) * .1,
                                jnp.float32),
              "w3": jnp.asarray(rng.randn(hidden, d) * .3, jnp.float32)}
-            for _ in range(P_)]
-        stacked = par.PipelineStage.stack(per_stage)
+            for _ in range(v * P_)]
+        if v == 1:
+            stacked = par.PipelineStage.stack(per_stage)
+        else:
+            stacked = par.PipelineStage.stack_interleaved(per_stage, P_)
         x = jnp.asarray(rng.randn(M, mb, d), jnp.float32)
 
         def residual_bytes(remat):
             def loss(sp, mbatch):
                 y = par.pipeline_apply_gspmd(mesh, fat_stage, sp,
-                                             mbatch, remat=remat)
+                                             mbatch, num_chunks=v,
+                                             remat=remat)
                 return (y ** 2).mean()
             jaxpr = jax.make_jaxpr(jax.grad(loss))(stacked, x)
             total = 0
@@ -412,7 +420,10 @@ class TestPipelineParallel:
         # the d-wide stage input: expect ~(3*hidden+d)/d ~ 25x here.
         assert bounded > 0
         assert plain / bounded > 5, (plain, bounded)
-        # Per-tick bound: with remat, residuals are O(ticks * input).
+        # Per-tick bound: with remat, residuals are O(ticks * input) —
+        # in particular NO [ticks, chunk-params] stack at v=2 (a w2
+        # slice alone would be ticks*hidden*hidden*4 ~ 3.1 MB >> this
+        # bound).
         per_shard_mb = mb // 2  # data axis = 2
         input_bytes = ticks * per_shard_mb * d * 4
         assert bounded <= 4 * input_bytes, (bounded, input_bytes)
@@ -420,7 +431,8 @@ class TestPipelineParallel:
         def loss(remat):
             def f(sp, mbatch):
                 y = par.pipeline_apply_gspmd(mesh, fat_stage, sp,
-                                             mbatch, remat=remat)
+                                             mbatch, num_chunks=v,
+                                             remat=remat)
                 return (y ** 2).mean()
             return f
 
