@@ -343,6 +343,10 @@ def main():
     ap.add_argument("--sweep-fusion", default=None, metavar="B0,B1,...",
                     help="comma list of fusion thresholds (bytes); "
                          "times each and reports all in one JSON")
+    ap.add_argument("--sweep-batch", default=None, metavar="B0,B1,...",
+                    help="comma list of per-chip batch sizes; times "
+                         "each (OOM tolerated), reports all + picks "
+                         "the best (the first knob of the MFU hunt)")
     ap.add_argument("--no-flash", action="store_true",
                     help="skip the Pallas flash-attention hardware "
                          "proof")
@@ -568,40 +572,77 @@ def _bench_body(args, devices, n_chips, metric, unit,
     state = init_cnn_state(model, tx, rng,
                            jnp.zeros(shape, jnp.bfloat16))
 
-    global_batch = args.batch * n_chips
-    x = np.random.RandomState(0).randn(
-        global_batch, *shape[1:]).astype(np.float32)
-    y = np.random.RandomState(1).randint(
-        0, num_classes, size=(global_batch,))
-    x = jnp.asarray(x, jnp.bfloat16)
-    y = jnp.asarray(y)
+    _batches = {}  # per-chip size -> device arrays (fusion sweeps
+    # reuse the same batch; only the batch sweep builds new shapes)
 
-    def run(threshold):
+    def make_batch(per_chip):
+        if per_chip not in _batches:
+            gb = per_chip * n_chips
+            x = np.random.RandomState(0).randn(
+                gb, *shape[1:]).astype(np.float32)
+            y = np.random.RandomState(1).randint(
+                0, num_classes, size=(gb,))
+            _batches[per_chip] = (jnp.asarray(x, jnp.bfloat16),
+                                  jnp.asarray(y))
+        return _batches[per_chip]
+
+    def run(threshold, batch=None):
         step = make_cnn_train_step(model, tx,
                                    fusion_threshold=threshold,
                                    remat=args.remat)
+        xb, yb = make_batch(args.batch if batch is None else batch)
+        gb = xb.shape[0]
         # Fresh state per run: the step donates its input buffers,
         # so a sweep's second run would otherwise read deleted
         # arrays.
         st0 = jax.tree.map(jnp.array, state)
         st, loss, dt, compile_s = time_steps(
-            step, st0, (x, y), rng, args.steps, args.warmup,
+            step, st0, (xb, yb), rng, args.steps, args.warmup,
             profile_dir=args.profile)
-        img_s = args.steps * global_batch / dt
-        log(f"{args.model} thr={threshold}: {img_s:.1f} img/s "
-            f"({img_s / n_chips:.1f}/chip, "
+        img_s = args.steps * gb / dt
+        log(f"{args.model} thr={threshold} b={gb // n_chips}: "
+            f"{img_s:.1f} img/s ({img_s / n_chips:.1f}/chip, "
             f"step {dt / args.steps * 1e3:.1f} ms, "
             f"warmup {compile_s:.1f}s, loss={loss:.3f})")
         return img_s
 
-    sweep = None
+    sweep = batch_sweep = None
+    if args.sweep_batch:
+        # Per-chip batch sweep — the first knob of the MFU hunt: a too-
+        # small batch underfills the MXU, a too-large one spills HBM
+        # into remat-less recompute or OOM. One invocation, one JSON.
+        batch_sweep = {}
+        best = (None, -1.0)
+        for tok in args.sweep_batch.split(","):
+            b = int(tok)
+            try:
+                r = run(args.fusion_threshold, batch=b) / n_chips
+            except Exception as e:  # noqa: BLE001 — see filter below
+                # Only a genuine capacity failure marks the size as
+                # infeasible; transient backend errors must propagate
+                # to main()'s retry loop, not skew the sweep.
+                msg = repr(e)
+                if not any(t in msg for t in (
+                        "RESOURCE_EXHAUSTED", "Out of memory",
+                        "out of memory", "OOM")):
+                    raise
+                log(f"batch {b} OOM: {msg[:200]}")
+                batch_sweep[str(b)] = None
+                continue
+            batch_sweep[str(b)] = round(r, 2)
+            if r > best[1]:
+                best = (b, r)
+        if best[0] is None:
+            raise RuntimeError(f"every batch failed: {batch_sweep}")
+        args.batch = best[0]
+        img_s_chip = best[1]
     if args.sweep_fusion:
         sweep = {}
         for tok in args.sweep_fusion.split(","):
             thr = int(tok)
             sweep[str(thr)] = round(run(thr) / n_chips, 2)
         img_s_chip = max(sweep.values())
-    else:
+    elif batch_sweep is None:
         img_s_chip = run(args.fusion_threshold) / n_chips
 
     # MFU estimate: analytic training FLOPs over the chip's bf16
@@ -631,6 +672,8 @@ def _bench_body(args, devices, n_chips, metric, unit,
     }
     if sweep is not None:
         result["sweep_fusion_img_s_per_chip"] = sweep
+    if batch_sweep is not None:
+        result["sweep_batch_img_s_per_chip"] = batch_sweep
     if flash_ms is not None:
         result["flash_attn_ms"] = flash_ms
     if flash_err is not None:
