@@ -8,11 +8,15 @@ rescale/exp traffic stays on the VPU.
 
 No reference equivalent: Horovod v0.10 contains no attention at all
 (SURVEY §5.7); this is part of the TPU-native long-context extension.
-The same math in plain-XLA form lives in
-`horovod_tpu.parallel.sequence.blockwise_attention`, which is both the
-correctness oracle for this kernel and its backward pass: the VJP
-recomputes attention blockwise (flash-style recompute — O(S) memory,
-no saved score matrix) and lets XLA differentiate the scan.
+The backward is fused Pallas too (FlashAttention-2 style, the
+default): the forward saves only the row logsumexp, and two kernels
+rebuild each probability tile on the fly for dK/dV and dQ — O(S)
+residual memory, no scan-residual HBM traffic; under a sliding window
+both backward sweeps are banded like the forward grid. The same math
+in plain-XLA form lives in
+`horovod_tpu.parallel.sequence.blockwise_attention`, the correctness
+oracle for both directions and the recompute-VJP fallback
+(HOROVOD_FLASH_BWD=recompute; banded for sliding-window training).
 
 Layout is the framework-wide [batch, seq, heads, head_dim]; the kernel
 internally works head-major. `ulysses_attention(attn_impl=
@@ -57,7 +61,40 @@ def _band_j0(qi, *, window, q_offset, k_offset, block_q, block_k):
     return jnp.maximum(0, lo)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+def _band_i0(j, *, q_offset, k_offset, block_q, block_k):
+    """First q-block index whose rows can see k-block ``j`` under the
+    causal band (q >= k) — the dK/dV banded grid's offset."""
+    lo = (k_offset + j * block_k - q_offset) // block_q
+    return jnp.maximum(0, lo)
+
+
+def _mask_block(q_start, k_start, *, causal, window, kv_len, k_local0,
+                block_q, block_k):
+    """The fwd/bwd-shared mask for one [block_q, block_k] tile, or None.
+
+    `q_start`/`k_start` are GLOBAL positions (offset-aware, the
+    `banded_causal_mask` band rule); `k_local0` is the block's LOCAL
+    key index origin for the zero-pad tail test.
+    """
+    mask = None
+    if causal:
+        rows = q_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = rows >= cols
+        if window is not None:
+            mask = jnp.logical_and(mask, rows - cols < window)
+    if kv_len % block_k:
+        local = k_local0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        pad_ok = local < kv_len
+        mask = pad_ok if mask is None else jnp.logical_and(mask, pad_ok)
+    return mask
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                  acc_ref, m_ref, l_ref, *,
                   scale: float, causal: bool, window: "int | None",
                   banded: bool, nk_total: int,
                   q_offset: int, k_offset: int,
@@ -104,24 +141,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # [bq, bk]
 
-        mask = None
-        if causal:
-            rows = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            mask = rows >= cols
-            if window is not None:
-                # Sliding window: same band rule as
-                # sequence.banded_causal_mask, global positions.
-                mask = jnp.logical_and(mask, rows - cols < window)
-        if kv_len % block_k:
-            # Zero-padding tail of the key axis (local index >= kv_len);
-            # trivially all-true except in the last k block.
-            local = jc * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            pad_ok = local < kv_len
-            mask = pad_ok if mask is None else jnp.logical_and(mask, pad_ok)
+        mask = _mask_block(q_start, k_start, causal=causal,
+                           window=window, kv_len=kv_len,
+                           k_local0=jc * block_k,
+                           block_q=block_q, block_k=block_k)
         if mask is not None:
             logits = jnp.where(mask, logits, NEG_INF)
 
@@ -143,33 +166,35 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
         acc_ref[...] = acc_ref[...] * corr[:, :1] + pv
         m_ref[...] = m_new
 
-    if causal:
-        # Skip blocks entirely in the future: the earliest key in the
-        # block is later than the latest query row. With a window,
-        # also skip blocks entirely in the past (the newest key older
-        # than the oldest query's window start) and clamped duplicates
-        # past the banded grid's end.
-        relevant = k_start <= q_start + block_q - 1
-        if window is not None:
-            relevant = jnp.logical_and(
-                relevant,
-                k_start + block_k - 1 >= q_start - window + 1)
-        if banded:
-            relevant = jnp.logical_and(relevant, in_range)
-        pl.when(relevant)(_block)
-    else:
-        _block()
+    # Skip blocks entirely outside the causal band (future keys, or —
+    # with a window — keys entirely in the past) and clamped
+    # duplicates past the banded grid's end.
+    rel = _relevant_block(q_start, k_start, causal=causal,
+                          window=window, block_q=block_q,
+                          block_k=block_k)
+    if banded:
+        rel = in_range if rel is None else jnp.logical_and(rel, in_range)
+    pl.when(rel)(_block) if rel is not None else _block()
 
     @pl.when(ki == nk - 1)
     def _finalize():
         l = l_ref[...][:, :1]
         denom = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0, :, :] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        # Row logsumexp for the fused backward: L = m + log(l), -inf on
+        # fully-masked rows (the bwd kernels turn those into p = 0).
+        m = m_ref[...][:, :1]
+        lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(denom))
+        lse_ref[0, 0, :] = lse.reshape(-1)
 
 
 def _flash_forward(q, k, v, *, causal, window, q_offset, k_offset,
                    block_q, block_k, interpret):
-    """[B, S, H, D] flash attention forward via pallas_call."""
+    """[B, S, H, D] flash attention forward via pallas_call.
+
+    Returns `(out [B, Sq, H, D], lse [B, H, nq*bq] f32)` — the row
+    logsumexp rides along for the fused Pallas backward (head-major,
+    padded to the block grid; -inf on fully-masked rows)."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     bq = min(block_q, max(Sq, 1))
@@ -213,7 +238,7 @@ def _flash_forward(q, k, v, *, causal, window, q_offset, k_offset,
         block_q=bq, block_k=bk)
 
     grid = (B, H, nq, nkb)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -221,9 +246,14 @@ def _flash_forward(q, k, v, *, causal, window, q_offset, k_offset,
             pl.BlockSpec((1, 1, bk, D), k_map),
             pl.BlockSpec((1, 1, bk, D), k_map),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, D),
-                               lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, nq * bq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, nq * bq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, nq * bq), jnp.float32),
+        ],
         scratch_shapes=[
             _scratch((bq, D), jnp.float32),
             _scratch((bq, 128), jnp.float32),
@@ -233,7 +263,7 @@ def _flash_forward(q, k, v, *, causal, window, q_offset, k_offset,
         interpret=interpret,
     )(qt, kt, vt)
     out = out[:, :, :Sq, :]
-    return jnp.transpose(out, (0, 2, 1, 3))
+    return jnp.transpose(out, (0, 2, 1, 3)), lse
 
 
 def _scratch(shape, dtype):
@@ -242,23 +272,296 @@ def _scratch(shape, dtype):
     return _VMEM(shape, dtype)
 
 
+def _recompute_p(q_ref, k_ref, lse_ref, *, scale, causal, window,
+                 kv_len, q_start, k_start, k_local0, block_q, block_k):
+    """Shared bwd-kernel tile: rebuild the probability block
+    `p = exp(scale·q·kᵀ − lse)` exactly as the forward computed it
+    (same f32 dot, same mask, -inf lse rows → 0)."""
+    qs = q_ref[0, 0].astype(jnp.float32) * scale           # [bq, D]
+    kb = k_ref[0, 0].astype(jnp.float32)                   # [bk, D]
+    s = jax.lax.dot_general(qs, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    mask = _mask_block(q_start, k_start, causal=causal, window=window,
+                       kv_len=kv_len, k_local0=k_local0,
+                       block_q=block_q, block_k=block_k)
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    lse = lse_ref[0, 0, :]                                 # [bq]
+    p = jnp.where(jnp.isfinite(lse)[:, None],
+                  jnp.exp(s - lse[:, None]), 0.0)          # [bq, bk]
+    return qs, kb, p
+
+
+def _relevant_block(q_start, k_start, *, causal, window, block_q,
+                    block_k):
+    """Causal/window block-skip predicate shared by the forward and
+    both backward kernels (~2x for long causal sequences); None when
+    nothing can be skipped."""
+    if not causal:
+        return None
+    rel = k_start <= q_start + block_q - 1
+    if window is not None:
+        rel = jnp.logical_and(
+            rel, k_start + block_k - 1 >= q_start - window + 1)
+    return rel
+
+
+def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, dvec_ref, k_ref,
+                          v_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          scale, causal, window, banded, nq_total,
+                          q_offset, k_offset,
+                          kv_len, block_q, block_k):
+    """dK/dV: grid (B, H, k-block, q-block) with the q sweep innermost
+    (sequential); accumulators live in VMEM scratch across the sweep
+    and each dK/dV block is written to HBM exactly once.
+
+    ``banded``: the q sweep covers only the blocks whose rows can see
+    this k-block under the sliding-window band (index_map adds
+    `_band_i0`; clamped duplicates skipped by the validity guard)."""
+    j = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    if banded:
+        il = _band_i0(j, q_offset=q_offset, k_offset=k_offset,
+                      block_q=block_q, block_k=block_k) + qi
+        ic = jnp.minimum(il, nq_total - 1)   # what the index_map DMA'd
+        in_range = il <= nq_total - 1
+    else:
+        ic = qi
+        in_range = True
+    q_start = q_offset + ic * block_q
+    k_start = k_offset + j * block_k
+
+    def _block():
+        qs, kb, p = _recompute_p(
+            q_ref, k_ref, lse_ref, scale=scale, causal=causal,
+            window=window, kv_len=kv_len, q_start=q_start,
+            k_start=k_start, k_local0=j * block_k,
+            block_q=block_q, block_k=block_k)
+        dob = do_ref[0, 0].astype(jnp.float32)             # [bq, D]
+        dv_acc[...] += jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, D]
+        vb = v_ref[0, 0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - dvec_ref[0, 0, :][:, None])
+        # s = (scale·q)·kᵀ, so dk = dsᵀ·(scale·q) — qs carries scale.
+        dk_acc[...] += jax.lax.dot_general(
+            ds, qs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, D]
+
+    rel = _relevant_block(q_start, k_start, causal=causal, window=window,
+                        block_q=block_q, block_k=block_k)
+    if banded:
+        rel = in_range if rel is None else jnp.logical_and(rel, in_range)
+    pl.when(rel)(_block) if rel is not None else _block()
+
+    @pl.when(qi == nq - 1)
+    def _fin():
+        dk_ref[0, 0, :, :] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dvec_ref, k_ref,
+                         v_ref, dq_ref, dq_acc, *,
+                         scale, causal, window, banded, nk_total,
+                         q_offset, k_offset,
+                         kv_len, block_q, block_k):
+    """dQ: grid (B, H, q-block, k-block) with the k sweep innermost.
+
+    ``banded``: same banded k sweep as the forward (`_band_j0`)."""
+    qi = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    if banded:
+        jl = _band_j0(qi, window=window, q_offset=q_offset,
+                      k_offset=k_offset, block_q=block_q,
+                      block_k=block_k) + j
+        jc = jnp.minimum(jl, nk_total - 1)
+        in_range = jl <= nk_total - 1
+    else:
+        jc = j
+        in_range = True
+    q_start = q_offset + qi * block_q
+    k_start = k_offset + jc * block_k
+
+    def _block():
+        qs, kb, p = _recompute_p(
+            q_ref, k_ref, lse_ref, scale=scale, causal=causal,
+            window=window, kv_len=kv_len, q_start=q_start,
+            k_start=k_start, k_local0=jc * block_k,
+            block_q=block_q, block_k=block_k)
+        dob = do_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - dvec_ref[0, 0, :][:, None])
+        dq_acc[...] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, D]
+
+    rel = _relevant_block(q_start, k_start, causal=causal, window=window,
+                        block_q=block_q, block_k=block_k)
+    if banded:
+        rel = in_range if rel is None else jnp.logical_and(rel, in_range)
+    pl.when(rel)(_block) if rel is not None else _block()
+
+    @pl.when(j == nk - 1)
+    def _fin():
+        # dq = scale · Σ_j ds·k (ds was taken w.r.t. scale·q·kᵀ).
+        dq_ref[0, 0, :, :] = (dq_acc[...] * scale).astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
+                    k_offset, block_q, block_k, interpret):
+    """Fused Pallas backward (FlashAttention-2 style): recompute each
+    probability tile from Q/K and the saved row logsumexp, never
+    materializing [Sq, Sk] — two kernels (dK/dV with q innermost, dQ
+    with k innermost), each output written once.
+
+    vs the XLA recompute VJP it replaces on this path: no per-block
+    scan residuals in HBM and no [B,Sq,H,D]-carry rewrite per k-block
+    — the HBM traffic drops to the tensors themselves, which is what
+    makes the fwd+bwd step time land near the ~2.5x-of-forward ideal.
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    bq = min(block_q, max(Sq, 1))
+    bk = min(block_k, max(Sk, 1))
+    nq = -(-Sq // bq)
+    nk = -(-Sk // bk)
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    ot = jnp.transpose(o, (0, 2, 1, 3))
+    gt = jnp.transpose(g, (0, 2, 1, 3))
+    if nq * bq != Sq:
+        pad = ((0, 0), (0, 0), (0, nq * bq - Sq), (0, 0))
+        qt, ot, gt = jnp.pad(qt, pad), jnp.pad(ot, pad), jnp.pad(gt, pad)
+    if nk * bk != Sk:
+        pad = ((0, 0), (0, 0), (0, nk * bk - Sk), (0, 0))
+        kt, vt = jnp.pad(kt, pad), jnp.pad(vt, pad)
+    # D_i = Σ_d dO_id · O_id (rowwise) — the softmax-jacobian term;
+    # cheap elementwise+reduce, XLA fuses it into the transposes.
+    dvec = (gt.astype(jnp.float32) * ot.astype(jnp.float32)).sum(-1)
+
+    # Sliding window: both sweeps shrink to the band, mirroring the
+    # forward grid — out-of-band blocks are never DMA'd.
+    banded = causal and window is not None
+    if banded:
+        nkb = min(nk, -(-(bq + window - 1) // bk) + 1)
+        nqb = min(nq, -(-(bk + window - 1) // bq) + 1)
+
+        def dq_k_map(b, h, i, j):
+            j0 = _band_j0(i, window=window, q_offset=q_offset,
+                          k_offset=k_offset, block_q=bq, block_k=bk)
+            return (b, h, jnp.minimum(j0 + j, nk - 1), 0)
+
+        def dkv_q_map(b, h, j, i):
+            i0 = _band_i0(j, q_offset=q_offset, k_offset=k_offset,
+                          block_q=bq, block_k=bk)
+            return (b, h, jnp.minimum(i0 + i, nq - 1), 0)
+
+        def dkv_r_map(b, h, j, i):
+            i0 = _band_i0(j, q_offset=q_offset, k_offset=k_offset,
+                          block_q=bq, block_k=bk)
+            return (b, h, jnp.minimum(i0 + i, nq - 1))
+    else:
+        nkb, nqb = nk, nq
+
+        def dq_k_map(b, h, i, j):
+            return (b, h, j, 0)
+
+        def dkv_q_map(b, h, j, i):
+            return (b, h, i, 0)
+
+        def dkv_r_map(b, h, j, i):
+            return (b, h, i)
+
+    common = dict(scale=D ** -0.5, causal=causal, window=window,
+                  banded=banded, q_offset=q_offset, k_offset=k_offset,
+                  kv_len=Sk, block_q=bq, block_k=bk)
+    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
+    r_spec = pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, nk_total=nk, **common),
+        grid=(B, H, nq, nkb),
+        in_specs=[
+            q_spec, q_spec, r_spec, r_spec,
+            pl.BlockSpec((1, 1, bk, D), dq_k_map),
+            pl.BlockSpec((1, 1, bk, D), dq_k_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, nq * bq, D), q.dtype),
+        scratch_shapes=[_scratch((bq, D), jnp.float32)],
+        compiler_params=None if interpret else _compiler_params(),
+        interpret=interpret,
+    )(qt, gt, lse, dvec, kt, vt)
+
+    kq_spec = pl.BlockSpec((1, 1, bq, D), dkv_q_map)
+    kr_spec = pl.BlockSpec((1, 1, bq), dkv_r_map)
+    kk_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, nq_total=nq, **common),
+        grid=(B, H, nk, nqb),
+        in_specs=[kq_spec, kq_spec, kr_spec, kr_spec, kk_spec, kk_spec],
+        out_specs=[kk_spec, kk_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, nk * bk, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, nk * bk, D), v.dtype),
+        ],
+        scratch_shapes=[_scratch((bk, D), jnp.float32),
+                        _scratch((bk, D), jnp.float32)],
+        compiler_params=None if interpret else _compiler_params(),
+        interpret=interpret,
+    )(qt, gt, lse, dvec, kt, vt)
+
+    dq = jnp.transpose(dq[:, :, :Sq], (0, 2, 1, 3))
+    dk = jnp.transpose(dk[:, :, :Sk], (0, 2, 1, 3))
+    dv = jnp.transpose(dv[:, :, :Sk], (0, 2, 1, 3))
+    return dq, dk, dv
+
+
 def _auto_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
 @functools.lru_cache(maxsize=None)
 def _make_flash(causal, window, q_offset, k_offset, block_q, block_k,
-                interpret):
-    """Config-specialized flash fn with a recompute VJP.
+                interpret, bwd_impl="pallas"):
+    """Config-specialized flash fn with a fused or recompute VJP.
 
-    Backward = flash-style recompute: differentiate the blockwise
-    online-softmax scan (`sequence.blockwise_attention`, the same math)
-    instead of saving the score matrix — O(S) residual memory, the
-    standard TPU rematerialization trade. With a sliding window the
-    backward is BANDED like the forward (`_banded_bwd`): Q is scanned
-    in `block_q` chunks and each chunk's VJP sees only the
-    `block_q + window - 1` keys its band can touch, so SWA training
-    moves O(S·(window+block)) bytes/FLOPs end to end, not O(S²).
+    ``bwd_impl="pallas"`` (the default): the FlashAttention-2 style
+    fused backward (`_flash_backward`) — probability tiles rebuilt
+    from the saved row logsumexp in two Pallas kernels, O(S) residual
+    memory (q, k, v, o, lse), no XLA scan-residual traffic; banded
+    sweeps under a sliding window.
+
+    ``bwd_impl="recompute"``: differentiate the blockwise
+    online-softmax scan (`sequence.blockwise_attention`, the same
+    math) — the conservative fallback (HOROVOD_FLASH_BWD=recompute).
+    With a sliding window the recompute backward is BANDED like the
+    forward (`_banded_bwd`): Q is scanned in `block_q` chunks and
+    each chunk's VJP sees only the `block_q + window - 1` keys its
+    band can touch, so SWA training moves O(S·(window+block))
+    bytes/FLOPs end to end, not O(S²).
     """
     from horovod_tpu.parallel.sequence import blockwise_attention
 
@@ -317,24 +620,40 @@ def _make_flash(causal, window, q_offset, k_offset, block_q, block_k,
         return (dq[:, :Sq].astype(q.dtype), dk.astype(k.dtype),
                 dv.astype(v.dtype))
 
-    @jax.custom_vjp
-    def flash(q, k, v):
+    def _fwd_full(q, k, v):
         return _flash_forward(
             q, k, v, causal=causal, window=window,
             q_offset=q_offset, k_offset=k_offset,
             block_q=block_q, block_k=block_k, interpret=interpret)
 
-    def fwd(q, k, v):
-        return flash(q, k, v), (q, k, v)
+    @jax.custom_vjp
+    def flash(q, k, v):
+        return _fwd_full(q, k, v)[0]
 
-    def bwd(res, g):
-        q, k, v = res
-        # Band the backward only when it actually shrinks the key span.
-        if (causal and window is not None
-                and min(block_q, q.shape[1]) + window - 1 < k.shape[1]):
-            return _banded_bwd(q, k, v, g)
-        _, vjp = jax.vjp(ref, q, k, v)
-        return vjp(g)
+    if bwd_impl == "pallas":
+        def fwd(q, k, v):
+            out, lse = _fwd_full(q, k, v)
+            return out, (q, k, v, out, lse)
+
+        def bwd(res, g):
+            q, k, v, o, lse = res
+            return _flash_backward(
+                q, k, v, o, lse, g, causal=causal, window=window,
+                q_offset=q_offset, k_offset=k_offset,
+                block_q=block_q, block_k=block_k, interpret=interpret)
+    else:
+        def fwd(q, k, v):
+            return flash(q, k, v), (q, k, v)
+
+        def bwd(res, g):
+            q, k, v = res
+            # Band the backward only when it shrinks the key span.
+            if (causal and window is not None
+                    and min(block_q, q.shape[1]) + window - 1
+                    < k.shape[1]):
+                return _banded_bwd(q, k, v, g)
+            _, vjp = jax.vjp(ref, q, k, v)
+            return vjp(g)
 
     flash.defvjp(fwd, bwd)
     return flash
@@ -345,7 +664,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     window: Optional[int] = None,
                     q_offset: int = 0, k_offset: int = 0,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    bwd_impl: str = "auto") -> jax.Array:
     """Fused flash attention, [B, S, H, D] → [B, S, H, D].
 
     Args:
@@ -370,6 +690,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         block_k to 256/512 when head_dim is small).
       interpret: run the kernel in interpreter mode (None = auto: True
         off-TPU, so the same tests run on the CPU mesh).
+      bwd_impl: "auto" (default — the fused Pallas backward
+        `_flash_backward`, banded under a sliding window), "pallas",
+        or "recompute" (the blockwise-VJP fallback). The env var
+        HOROVOD_FLASH_BWD overrides "auto" (escape hatch if the fused
+        backward misbehaves on some toolchain).
     """
     if mask is not None:
         raise NotImplementedError(
@@ -381,8 +706,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     check_window(window)
     if interpret is None:
         interpret = _auto_interpret()
+    if bwd_impl not in ("auto", "pallas", "recompute"):
+        raise ValueError(
+            f"bwd_impl must be auto|pallas|recompute, got {bwd_impl!r}")
+    if bwd_impl == "auto":
+        import os
+        bwd_impl = os.environ.get("HOROVOD_FLASH_BWD", "")
+        if bwd_impl not in ("pallas", "recompute"):
+            # Fused Pallas backward everywhere — banded under a
+            # sliding window, mirroring the forward grid.
+            bwd_impl = "pallas"
     fn = _make_flash(bool(causal),
                      None if window is None else int(window),
                      int(q_offset), int(k_offset),
-                     int(block_q), int(block_k), bool(interpret))
+                     int(block_q), int(block_k), bool(interpret),
+                     bwd_impl)
     return fn(q, k, v)

@@ -52,14 +52,19 @@ class TestFlashAttention:
         ref = dot_product_attention(q[:, :50], k, v)
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
-    def test_gradients_match_reference(self):
+    @pytest.mark.parametrize("bwd_impl", ["pallas", "recompute"])
+    def test_gradients_match_reference(self, bwd_impl):
+        """Both backward implementations — the fused Pallas kernels
+        (default) and the blockwise recompute fallback — match the
+        materialized-softmax oracle."""
         q, k, v = _qkv(S=32, seed=3)
         S = q.shape[1]
         mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
 
         def loss_flash(q, k, v):
             return (flash_attention(q, k, v, causal=True, block_q=16,
-                                    block_k=16) ** 2).sum()
+                                    block_k=16,
+                                    bwd_impl=bwd_impl) ** 2).sum()
 
         def loss_ref(q, k, v):
             return (dot_product_attention(q, k, v, mask) ** 2).sum()
@@ -68,6 +73,90 @@ class TestFlashAttention:
         gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(a, b, atol=1e-4)
+
+    @pytest.mark.parametrize("case", ["full", "uneven", "cross",
+                                      "offset"])
+    def test_pallas_bwd_shapes_and_offsets(self, case):
+        """The fused backward across the fwd kernel's shape edge
+        cases: non-causal full, pad tails on both axes, Sq != Sk, and
+        ring-style global offsets."""
+        causal, S, Sk, qo, seed = {
+            "full": (False, 48, 48, 0, 101),
+            "uneven": (True, 50, 50, 0, 102),
+            "cross": (False, 32, 80, 0, 103),
+            "offset": (True, 32, 32, 32, 104),
+        }[case]
+        rng = np.random.RandomState(seed)
+        q = jnp.asarray(rng.randn(2, S, 2, 16), jnp.float32)
+        k = jnp.asarray(rng.randn(2, Sk, 2, 16), jnp.float32)
+        v = jnp.asarray(rng.randn(2, Sk, 2, 16), jnp.float32)
+        mask = None
+        if causal:
+            pos_q = qo + jnp.arange(S)
+            mask = (pos_q[:, None] >= jnp.arange(Sk)[None, :]
+                    )[None, None]
+
+        def lf(q, k, v):
+            return (flash_attention(
+                q, k, v, causal=causal, q_offset=qo, block_q=16,
+                block_k=16, bwd_impl="pallas") ** 2).sum()
+
+        def lr(q, k, v):
+            return (dot_product_attention(q, k, v, mask) ** 2).sum()
+
+        gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
+
+    def test_pallas_bwd_composes_with_window(self):
+        """Forced bwd_impl='pallas' with a sliding window still
+        matches the banded oracle (auto keeps the banded recompute
+        for SWA, but the fused path must not be wrong)."""
+        from horovod_tpu.parallel.sequence import banded_causal_mask
+        q, k, v = _qkv(S=64, seed=9)
+        pos = jnp.arange(64)
+        mask = banded_causal_mask(pos, pos, 8)[None, None]
+
+        def lf(q, k, v):
+            return (flash_attention(
+                q, k, v, causal=True, window=8, block_q=16,
+                block_k=16, bwd_impl="pallas") ** 2).sum()
+
+        def lr(q, k, v):
+            return (dot_product_attention(q, k, v, mask) ** 2).sum()
+
+        gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_bwd_impl_validation_and_env_override(self, monkeypatch):
+        q, k, v = _qkv(S=16)
+        with pytest.raises(ValueError, match="bwd_impl"):
+            flash_attention(q, k, v, bwd_impl="nope")
+        # env escape hatch: auto must RESOLVE to recompute (spy on the
+        # config factory — finiteness alone would pass either way).
+        from horovod_tpu.ops import flash_attention as fa
+        resolved = []
+        orig = fa._make_flash
+
+        def spy(*a):
+            resolved.append(a[-1])
+            return orig(*a)
+
+        monkeypatch.setattr(fa, "_make_flash", spy)
+        monkeypatch.setenv("HOROVOD_FLASH_BWD", "recompute")
+        out = fa.flash_attention(q, k, v, causal=True, block_q=16,
+                                 block_k=16)
+        assert resolved == ["recompute"], resolved
+        assert np.isfinite(np.asarray(out)).all()
+        monkeypatch.delenv("HOROVOD_FLASH_BWD")
+        fa.flash_attention(q, k, v, causal=True, block_q=16,
+                           block_k=16)
+        assert resolved[-1] == "pallas", resolved
 
     def test_offsets_for_rotated_blocks(self):
         # Ring-attention style: keys are a rotated block with a global
