@@ -251,8 +251,8 @@ def _flash_forward(q, k, v, *, causal, window, q_offset, k_offset,
             pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, nq * bq, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, nq * bq), jnp.float32),
+            _sds((B, H, nq * bq, D), q.dtype, qt, kt, vt),
+            _sds((B, H, nq * bq), jnp.float32, qt, kt, vt),
         ],
         scratch_shapes=[
             _scratch((bq, D), jnp.float32),
@@ -270,6 +270,22 @@ def _scratch(shape, dtype):
     if _VMEM is None:  # pragma: no cover
         raise RuntimeError("pallas TPU backend unavailable")
     return _VMEM(shape, dtype)
+
+
+def _sds(shape, dtype, *like):
+    """ShapeDtypeStruct whose varying-manual-axes are the union of the
+    `like` operands' — lets the pallas_calls sit inside `shard_map`
+    with its default `check_vma=True` (ring/Ulysses SP pass this
+    kernel as `attn_impl`)."""
+    vma = frozenset()
+    for x in like:
+        try:
+            vma |= jax.typeof(x).vma
+        except Exception:  # older jax / non-shard_map tracer
+            pass
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _recompute_p(q_ref, k_ref, lse_ref, *, scale, causal, window,
@@ -509,7 +525,7 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
         ],
         out_specs=pl.BlockSpec((1, 1, bq, D),
                                lambda b, h, i, j: (b, h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, nq * bq, D), q.dtype),
+        out_shape=_sds((B, H, nq * bq, D), q.dtype, qt, gt, kt, vt),
         scratch_shapes=[_scratch((bq, D), jnp.float32)],
         compiler_params=None if interpret else _compiler_params(),
         interpret=interpret,
@@ -524,8 +540,8 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
         in_specs=[kq_spec, kq_spec, kr_spec, kr_spec, kk_spec, kk_spec],
         out_specs=[kk_spec, kk_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, nk * bk, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, nk * bk, D), v.dtype),
+            _sds((B, H, nk * bk, D), k.dtype, qt, gt, kt, vt),
+            _sds((B, H, nk * bk, D), v.dtype, qt, gt, kt, vt),
         ],
         scratch_shapes=[_scratch((bk, D), jnp.float32),
                         _scratch((bk, D), jnp.float32)],
@@ -711,11 +727,16 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             f"bwd_impl must be auto|pallas|recompute, got {bwd_impl!r}")
     if bwd_impl == "auto":
         import os
-        bwd_impl = os.environ.get("HOROVOD_FLASH_BWD", "")
-        if bwd_impl not in ("pallas", "recompute"):
-            # Fused Pallas backward everywhere — banded under a
-            # sliding window, mirroring the forward grid.
-            bwd_impl = "pallas"
+        env = os.environ.get("HOROVOD_FLASH_BWD")
+        if env is not None and env not in ("pallas", "recompute"):
+            # The escape hatch must never silently select the kernel
+            # being escaped (e.g. a typo'd "recompue").
+            raise ValueError(
+                f"HOROVOD_FLASH_BWD must be pallas|recompute, "
+                f"got {env!r}")
+        # Default: fused Pallas backward everywhere — banded under a
+        # sliding window, mirroring the forward grid.
+        bwd_impl = env or "pallas"
     fn = _make_flash(bool(causal),
                      None if window is None else int(window),
                      int(q_offset), int(k_offset),

@@ -111,9 +111,9 @@ class TestFlashAttention:
                                        atol=2e-4, rtol=2e-4)
 
     def test_pallas_bwd_composes_with_window(self):
-        """Forced bwd_impl='pallas' with a sliding window still
-        matches the banded oracle (auto keeps the banded recompute
-        for SWA, but the fused path must not be wrong)."""
+        """The fused backward under a sliding window (the default —
+        auto resolves to 'pallas' with banded backward sweeps) matches
+        the banded oracle."""
         from horovod_tpu.parallel.sequence import banded_causal_mask
         q, k, v = _qkv(S=64, seed=9)
         pos = jnp.arange(64)
