@@ -89,6 +89,9 @@ def make_attn_fn(impl: str, *, causal: bool = True,
             _no_mask(m)
             return flash_attention(q, k, v, causal=causal,
                                    window=window)
+        # The kernel consumes grouped K/V natively (index-mapped kv
+        # heads); let ParallelSelfAttention skip the repeat.
+        attn.native_gqa = True
         return attn
     if impl in ("ring", "ulysses"):
         sp_fn = (ring_attention_gspmd if impl == "ring"

@@ -197,6 +197,7 @@ def _flash_forward(q, k, v, *, causal, window, q_offset, k_offset,
     padded to the block grid; -inf on fully-masked rows)."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
+    group = _gqa_group(q, k, v)
     bq = min(block_q, max(Sq, 1))
     bk = min(block_k, max(Sk, 1))
     nq = -(-Sq // bq)
@@ -224,12 +225,12 @@ def _flash_forward(q, k, v, *, causal, window, q_offset, k_offset,
         def k_map(b, h, i, j):
             j0 = _band_j0(i, window=window, q_offset=q_offset,
                           k_offset=k_offset, block_q=bq, block_k=bk)
-            return (b, h, jnp.minimum(j0 + j, nk - 1), 0)
+            return (b, h // group, jnp.minimum(j0 + j, nk - 1), 0)
     else:
         nkb = nk
 
         def k_map(b, h, i, j):
-            return (b, h, j, 0)
+            return (b, h // group, j, 0)
 
     kernel = functools.partial(
         _flash_kernel, scale=D ** -0.5, causal=causal, window=window,
@@ -270,6 +271,21 @@ def _scratch(shape, dtype):
     if _VMEM is None:  # pragma: no cover
         raise RuntimeError("pallas TPU backend unavailable")
     return _VMEM(shape, dtype)
+
+
+def _gqa_group(q, k, v):
+    """q heads per kv head (GQA, Ainslie et al. 2023) — the kernels
+    index-map K/V head `h // group`, so grouped K/V is consumed
+    NATIVELY, never materialized at full head count in HBM."""
+    H, Hkv = q.shape[2], k.shape[2]
+    if v.shape[2] != Hkv:
+        raise ValueError(
+            f"k and v head counts differ: {Hkv} vs {v.shape[2]}")
+    if H % Hkv:
+        raise ValueError(
+            f"query heads ({H}) must be a multiple of kv heads "
+            f"({Hkv}) for grouped-query attention")
+    return H // Hkv
 
 
 def _sds(shape, dtype, *like):
@@ -325,20 +341,24 @@ def _relevant_block(q_start, k_start, *, causal, window, block_q,
 def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, dvec_ref, k_ref,
                           v_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
                           scale, causal, window, banded, nq_total,
-                          q_offset, k_offset,
+                          nq_band, q_offset, k_offset,
                           kv_len, block_q, block_k):
-    """dK/dV: grid (B, H, k-block, q-block) with the q sweep innermost
-    (sequential); accumulators live in VMEM scratch across the sweep
-    and each dK/dV block is written to HBM exactly once.
+    """dK/dV: grid (B, Hkv, k-block, group·q-block) — the innermost
+    sequential sweep runs every (gqa-group, q-block) pair, so the
+    accumulators fold the whole query-head group in VMEM scratch and
+    each dK/dV block is written to HBM exactly once AT KV WIDTH (with
+    GQA there is no full-H gradient materialization + reduce pass).
 
     ``banded``: the q sweep covers only the blocks whose rows can see
     this k-block under the sliding-window band (index_map adds
     `_band_i0`; clamped duplicates skipped by the validity guard)."""
     j = pl.program_id(2)
-    qi = pl.program_id(3)
-    nq = pl.num_programs(3)
+    inner = pl.program_id(3)
+    nin = pl.num_programs(3)
+    qi = inner % nq_band       # q-block within this query head
+    # (inner // nq_band = the group member; only index maps need it)
 
-    @pl.when(qi == 0)
+    @pl.when(inner == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -380,7 +400,7 @@ def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, dvec_ref, k_ref,
         rel = in_range if rel is None else jnp.logical_and(rel, in_range)
     pl.when(rel)(_block) if rel is not None else _block()
 
-    @pl.when(qi == nq - 1)
+    @pl.when(inner == nin - 1)
     def _fin():
         dk_ref[0, 0, :, :] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_acc[...].astype(dv_ref.dtype)
@@ -479,6 +499,7 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
     # Sliding window: both sweeps shrink to the band, mirroring the
     # forward grid — out-of-band blocks are never DMA'd.
     banded = causal and window is not None
+    group = _gqa_group(q, k, v)
     if banded:
         nkb = min(nk, -(-(bq + window - 1) // bk) + 1)
         nqb = min(nq, -(-(bk + window - 1) // bq) + 1)
@@ -486,28 +507,30 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
         def dq_k_map(b, h, i, j):
             j0 = _band_j0(i, window=window, q_offset=q_offset,
                           k_offset=k_offset, block_q=bq, block_k=bk)
-            return (b, h, jnp.minimum(j0 + j, nk - 1), 0)
+            return (b, h // group, jnp.minimum(j0 + j, nk - 1), 0)
 
-        def dkv_q_map(b, h, j, i):
+        def dkv_q_map(b, hkv, j, inner):
             i0 = _band_i0(j, q_offset=q_offset, k_offset=k_offset,
                           block_q=bq, block_k=bk)
-            return (b, h, jnp.minimum(i0 + i, nq - 1), 0)
+            i = jnp.minimum(i0 + inner % nqb, nq - 1)
+            return (b, hkv * group + inner // nqb, i, 0)
 
-        def dkv_r_map(b, h, j, i):
+        def dkv_r_map(b, hkv, j, inner):
             i0 = _band_i0(j, q_offset=q_offset, k_offset=k_offset,
                           block_q=bq, block_k=bk)
-            return (b, h, jnp.minimum(i0 + i, nq - 1))
+            i = jnp.minimum(i0 + inner % nqb, nq - 1)
+            return (b, hkv * group + inner // nqb, i)
     else:
         nkb, nqb = nk, nq
 
         def dq_k_map(b, h, i, j):
-            return (b, h, j, 0)
+            return (b, h // group, j, 0)
 
-        def dkv_q_map(b, h, j, i):
-            return (b, h, i, 0)
+        def dkv_q_map(b, hkv, j, inner):
+            return (b, hkv * group + inner // nqb, inner % nqb, 0)
 
-        def dkv_r_map(b, h, j, i):
-            return (b, h, i)
+        def dkv_r_map(b, hkv, j, inner):
+            return (b, hkv * group + inner // nqb, inner % nqb)
 
     common = dict(scale=D ** -0.5, causal=causal, window=window,
                   banded=banded, q_offset=q_offset, k_offset=k_offset,
@@ -533,15 +556,22 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
 
     kq_spec = pl.BlockSpec((1, 1, bq, D), dkv_q_map)
     kr_spec = pl.BlockSpec((1, 1, bq), dkv_r_map)
-    kk_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, j, i: (b, h, j, 0))
+    kk_spec = pl.BlockSpec((1, 1, bk, D),
+                           lambda b, hkv, j, inner: (b, hkv, j, 0))
+    Hkv = H // group
+    # Grid over KV heads; the inner sequential sweep folds the whole
+    # query-head group into the VMEM accumulators, so dK/dV are
+    # written once, at kv width — no full-H gradient + reduce pass.
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, nq_total=nq, **common),
-        grid=(B, H, nk, nqb),
-        in_specs=[kq_spec, kq_spec, kr_spec, kr_spec, kk_spec, kk_spec],
+        functools.partial(_flash_bwd_dkv_kernel, nq_total=nq,
+                          nq_band=nqb, **common),
+        grid=(B, Hkv, nk, group * nqb),
+        in_specs=[kq_spec, kq_spec, kr_spec, kr_spec,
+                  kk_spec, kk_spec],
         out_specs=[kk_spec, kk_spec],
         out_shape=[
-            _sds((B, H, nk * bk, D), k.dtype, qt, gt, kt, vt),
-            _sds((B, H, nk * bk, D), v.dtype, qt, gt, kt, vt),
+            _sds((B, Hkv, nk * bk, D), k.dtype, qt, gt, kt, vt),
+            _sds((B, Hkv, nk * bk, D), v.dtype, qt, gt, kt, vt),
         ],
         scratch_shapes=[_scratch((bk, D), jnp.float32),
                         _scratch((bk, D), jnp.float32)],
@@ -582,6 +612,12 @@ def _make_flash(causal, window, q_offset, k_offset, block_q, block_k,
     from horovod_tpu.parallel.sequence import blockwise_attention
 
     def ref(q, k, v):
+        # GQA: repeat kv INSIDE the vjp'd fn — jnp.repeat's transpose
+        # is the per-group sum, so dk/dv come back at kv-head width.
+        g_ = q.shape[2] // k.shape[2]
+        if g_ > 1:
+            k = jnp.repeat(k, g_, axis=2)
+            v = jnp.repeat(v, g_, axis=2)
         return blockwise_attention(
             q, k, v, block_size=block_k, causal=causal, window=window,
             q_offset=q_offset, k_offset=k_offset)
@@ -612,10 +648,16 @@ def _make_flash(causal, window, q_offset, k_offset, block_q, block_k,
             start = jnp.clip(lo, 0, Sk - span)
             kc = jax.lax.dynamic_slice_in_dim(k, start, span, axis=1)
             vc = jax.lax.dynamic_slice_in_dim(v, start, span, axis=1)
-            fn = functools.partial(
-                blockwise_attention, block_size=block_k, causal=True,
-                window=window, q_offset=q_offset + ci * C,
-                k_offset=k_offset + start)
+            g_ = qc.shape[2] // kc.shape[2]
+
+            def fn(qc, kc, vc, _start=start, _g=g_):
+                if _g > 1:  # GQA (see `ref`)
+                    kc = jnp.repeat(kc, _g, axis=2)
+                    vc = jnp.repeat(vc, _g, axis=2)
+                return blockwise_attention(
+                    qc, kc, vc, block_size=block_k, causal=True,
+                    window=window, q_offset=q_offset + ci * C,
+                    k_offset=k_offset + _start)
             _, vjp = jax.vjp(fn, qc, kc, vc)
             dqc, dkc, dvc = vjp(gc)
             dq_a = jax.lax.dynamic_update_slice_in_dim(
@@ -743,3 +785,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                      int(block_q), int(block_k), bool(interpret),
                      bwd_impl)
     return fn(q, k, v)
+
+
+# K/V may carry fewer heads than Q (must divide): the kernels index-map
+# kv head h//group instead of reading a materialized repeat
+# (`parallel.tensor.ParallelSelfAttention` checks this marker).
+flash_attention.native_gqa = True

@@ -40,6 +40,15 @@ Dtype = Any
 # Functional forms (for use inside shard_map with `axis_name` bound).
 # ---------------------------------------------------------------------------
 
+def _native_gqa(fn) -> bool:
+    """True when `fn` (possibly functools.partial-wrapped) declares it
+    consumes grouped K/V natively (fewer kv heads than q heads) — the
+    `native_gqa` marker set by `ops.flash_attention.flash_attention`."""
+    while hasattr(fn, "func"):
+        fn = fn.func
+    return bool(getattr(fn, "native_gqa", False))
+
+
 def column_parallel_matmul(x: jax.Array, w_shard: jax.Array) -> jax.Array:
     """`x @ W[:, shard]` — input replicated, output column-sharded.
 
@@ -154,10 +163,12 @@ class ParallelSelfAttention(nn.Module):
     ``num_kv_heads`` (GQA, Ainslie et al. 2023): K/V carry only
     H_kv < H heads, shared by groups of H/H_kv query heads. The QKV
     projection and — crucially — the decode KV cache shrink by
-    H/H_kv; K/V are broadcast to the full head count right at the
-    attention (`_repeat_kv`), so every attention kernel (dot, flash,
-    ring, ...) runs unchanged. H_kv = H (default None) is exact MHA
-    with identical parameters.
+    H/H_kv. Kernels that declare ``native_gqa`` (the Pallas flash
+    kernel) receive K/V at H_kv width and index-map heads internally
+    — no repeat ever materializes; every other kernel (dot,
+    blockwise, ring, ...) gets K/V broadcast to the full head count
+    right at the attention (`_repeat_kv`) and runs unchanged.
+    H_kv = H (default None) is exact MHA with identical parameters.
     """
 
     num_heads: int
@@ -210,8 +221,14 @@ class ParallelSelfAttention(nn.Module):
         else:
             q, k = self._maybe_rope(q, k)
             if self.attn_fn is not None:
-                o = self.attn_fn(q, self._repeat_kv(k),
-                                 self._repeat_kv(v), mask)
+                if _native_gqa(self.attn_fn):
+                    # e.g. the Pallas flash kernel: K/V consumed at
+                    # their Hkv width via index maps — never pay the
+                    # H/Hkv x repeat materialization in HBM.
+                    o = self.attn_fn(q, k, v, mask)
+                else:
+                    o = self.attn_fn(q, self._repeat_kv(k),
+                                     self._repeat_kv(v), mask)
             else:
                 o = dot_product_attention(q, self._repeat_kv(k),
                                           self._repeat_kv(v), mask)
