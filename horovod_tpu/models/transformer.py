@@ -54,7 +54,8 @@ from horovod_tpu.parallel.tensor import (
 
 Dtype = Any
 
-ATTN_IMPLS = ("dot", "blockwise", "flash", "ring", "ulysses")
+ATTN_IMPLS = ("dot", "blockwise", "flash", "ring", "ring_flash",
+              "ulysses")
 
 
 def make_attn_fn(impl: str, *, causal: bool = True,
@@ -93,9 +94,16 @@ def make_attn_fn(impl: str, *, causal: bool = True,
         # heads); let ParallelSelfAttention skip the repeat.
         attn.native_gqa = True
         return attn
-    if impl in ("ring", "ulysses"):
-        sp_fn = (ring_attention_gspmd if impl == "ring"
-                 else ulysses_attention_gspmd)
+    if impl in ("ring", "ring_flash", "ulysses"):
+        if impl == "ulysses":
+            sp_fn = ulysses_attention_gspmd
+        elif impl == "ring_flash":
+            # Pallas flash kernel on every ring rotation; partials
+            # merge by logsumexp (sequence._ring_attention_flash).
+            sp_fn = functools.partial(ring_attention_gspmd,
+                                      block_impl="flash")
+        else:
+            sp_fn = ring_attention_gspmd
 
         def attn(q, k, v, m):
             _no_mask(m)

@@ -168,13 +168,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     # Skip blocks entirely outside the causal band (future keys, or —
     # with a window — keys entirely in the past) and clamped
-    # duplicates past the banded grid's end.
+    # duplicates past the banded grid's end. Non-causal keeps a traced
+    # trivially-true guard ("block intersects real keys"): an
+    # UNGUARDED body trips a varying-manual-axes mismatch inside the
+    # pallas interpreter under shard_map(check_vma=True).
     rel = _relevant_block(q_start, k_start, causal=causal,
                           window=window, block_q=block_q,
                           block_k=block_k)
+    if rel is None:
+        rel = jnp.asarray(jc) * block_k < kv_len
     if banded:
-        rel = in_range if rel is None else jnp.logical_and(rel, in_range)
-    pl.when(rel)(_block) if rel is not None else _block()
+        rel = jnp.logical_and(rel, in_range)
+    pl.when(rel)(_block)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -396,9 +401,11 @@ def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, dvec_ref, k_ref,
 
     rel = _relevant_block(q_start, k_start, causal=causal, window=window,
                         block_q=block_q, block_k=block_k)
+    if rel is None:  # traced guard; see _flash_kernel
+        rel = jnp.asarray(j) * block_k < kv_len
     if banded:
-        rel = in_range if rel is None else jnp.logical_and(rel, in_range)
-    pl.when(rel)(_block) if rel is not None else _block()
+        rel = jnp.logical_and(rel, in_range)
+    pl.when(rel)(_block)
 
     @pl.when(inner == nin - 1)
     def _fin():
@@ -452,9 +459,11 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dvec_ref, k_ref,
 
     rel = _relevant_block(q_start, k_start, causal=causal, window=window,
                         block_q=block_q, block_k=block_k)
+    if rel is None:  # traced guard; see _flash_kernel
+        rel = jnp.asarray(jc) * block_k < kv_len
     if banded:
-        rel = in_range if rel is None else jnp.logical_and(rel, in_range)
-    pl.when(rel)(_block) if rel is not None else _block()
+        rel = jnp.logical_and(rel, in_range)
+    pl.when(rel)(_block)
 
     @pl.when(j == nk - 1)
     def _fin():
@@ -463,7 +472,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dvec_ref, k_ref,
 
 
 def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
-                    k_offset, block_q, block_k, interpret):
+                    k_offset, block_q, block_k, interpret, dlse=None):
     """Fused Pallas backward (FlashAttention-2 style): recompute each
     probability tile from Q/K and the saved row logsumexp, never
     materializing [Sq, Sk] — two kernels (dK/dV with q innermost, dQ
@@ -494,7 +503,13 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
         kt, vt = jnp.pad(kt, pad), jnp.pad(vt, pad)
     # D_i = Σ_d dO_id · O_id (rowwise) — the softmax-jacobian term;
     # cheap elementwise+reduce, XLA fuses it into the transposes.
+    # When the row logsumexp is itself an output with a cotangent
+    # (`flash_attention_lse`, e.g. under a ring merge):
+    # ∂lse_i/∂s_ij = p_ij, so ds = p·(dp − (D − dlse)) — the same
+    # kernels run with dvec = D − dlse.
     dvec = (gt.astype(jnp.float32) * ot.astype(jnp.float32)).sum(-1)
+    if dlse is not None:
+        dvec = dvec - dlse.astype(jnp.float32)
 
     # Sliding window: both sweeps shrink to the band, mirroring the
     # forward grid — out-of-band blocks are never DMA'd.
@@ -715,6 +730,82 @@ def _make_flash(causal, window, q_offset, k_offset, block_q, block_k,
 
     flash.defvjp(fwd, bwd)
     return flash
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash_lse(causal, window, q_offset, k_offset, block_q,
+                    block_k, interpret):
+    """`(o, lse)`-returning flash with a fused VJP that honors a
+    cotangent on lse (∂lse/∂s = p folds into the dvec term) — the
+    primitive for cross-block softmax merging (ring attention)."""
+
+    @jax.custom_vjp
+    def flash_lse(q, k, v):
+        o, lse = _flash_forward(
+            q, k, v, causal=causal, window=window,
+            q_offset=q_offset, k_offset=k_offset,
+            block_q=block_q, block_k=block_k, interpret=interpret)
+        return o, lse[:, :, :q.shape[1]]
+
+    def fwd(q, k, v):
+        o, lse = _flash_forward(
+            q, k, v, causal=causal, window=window,
+            q_offset=q_offset, k_offset=k_offset,
+            block_q=block_q, block_k=block_k, interpret=interpret)
+        return (o, lse[:, :, :q.shape[1]]), (q, k, v, o, lse)
+
+    def bwd(res, cot):
+        q, k, v, o, lse = res
+        g, dlse = cot
+        pad = lse.shape[2] - q.shape[1]
+        if pad:
+            dlse = jnp.pad(dlse, ((0, 0), (0, 0), (0, pad)))
+        return _flash_backward(
+            q, k, v, o, lse, g, causal=causal, window=window,
+            q_offset=q_offset, k_offset=k_offset,
+            block_q=block_q, block_k=block_k, interpret=interpret,
+            dlse=dlse)
+
+    flash_lse.defvjp(fwd, bwd)
+    return flash_lse
+
+
+def flash_attention_lse(q: jax.Array, k: jax.Array, v: jax.Array,
+                        *, causal: bool = False,
+                        window: Optional[int] = None,
+                        q_offset: int = 0, k_offset: int = 0,
+                        block_q: int = 128, block_k: int = 128,
+                        interpret: Optional[bool] = None):
+    """Flash attention that ALSO returns the row logsumexp.
+
+    Returns `(out [B, Sq, H, D], lse [B, H, Sq] float32)`; lse is -inf
+    on fully-masked rows (their out rows are 0). Two partial
+    attentions over disjoint key sets merge exactly via
+    `m = max(lse1, lse2); w_i = exp(lse_i - m);
+    out = Σ w_i·out_i / Σ w_i; lse = m + log Σ w_i` — how
+    `parallel.sequence.ring_attention(block_impl="flash")` runs the
+    Pallas kernel on every ring rotation. Differentiable in all of
+    (out, lse); GQA-native like `flash_attention`.
+
+    Fused-backward-only: the HOROVOD_FLASH_BWD=recompute escape hatch
+    applies to `flash_attention`, not this entry point (the blockwise
+    fallback has no lse output to differentiate through) — if the
+    fused backward misbehaves, use `ring_attention(block_impl="xla")`
+    instead."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
+    from horovod_tpu.parallel.sequence import check_window
+    check_window(window)
+    if interpret is None:
+        interpret = _auto_interpret()
+    fn = _make_flash_lse(bool(causal),
+                         None if window is None else int(window),
+                         int(q_offset), int(k_offset),
+                         int(block_q), int(block_k), bool(interpret))
+    return fn(q, k, v)
+
+
+flash_attention_lse.native_gqa = True
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -169,10 +169,75 @@ def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return _finalize(o, m, l, q.dtype)
 
 
+def _merge_partials(o1, l1, o2, l2):
+    """Exact merge of two softmax partials over disjoint key sets.
+
+    o [B, S, H, D] float32 (normalized partial outputs),
+    l [B, H, S] float32 (row logsumexp, -inf where the partial saw no
+    keys). The flash-ring accumulator."""
+    m = jnp.maximum(l1, l2)
+    m_ = jnp.where(jnp.isneginf(m), 0.0, m)   # exp(-inf - 0) = 0
+    w1 = jnp.exp(l1 - m_)
+    w2 = jnp.exp(l2 - m_)
+    den = w1 + w2
+    wt = jnp.where(den == 0.0, 1.0, den)
+    o = (o1 * (w1 / wt).transpose(0, 2, 1)[..., None]
+         + o2 * (w2 / wt).transpose(0, 2, 1)[..., None])
+    lse = jnp.where(den == 0.0, -jnp.inf, m_ + jnp.log(wt))
+    return o, lse
+
+
+def _ring_attention_flash(q, k, v, *, axis_name, causal, window):
+    """Ring attention with the Pallas flash kernel on every rotation.
+
+    The ring loop is UNROLLED (sp is static): at step d the resident
+    K/V block sits d hops behind this rank, so its causal structure is
+    expressible with STATIC flash offsets (`q_offset = d·S`) — except
+    for wrapped ranks (idx < d), where the block is strictly in the
+    future and a `lax.cond` substitutes the empty partial. Partials
+    merge via `_merge_partials` (logsumexp algebra); the lse cotangent
+    flows back through `flash_attention_lse`'s fused VJP.
+    """
+    from horovod_tpu.ops.flash_attention import flash_attention_lse
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    S = q.shape[1]
+
+    def zero_partial():
+        """(o=0 [B,S,H,D], lse=-inf [B,H,S]), float32 regardless of
+        q.dtype (the lax.cond branches must match flash's f32 lse),
+        derived from q to inherit its varying-manual-axes type. Built
+        fresh each use — -inf entries in an accumulator would turn
+        `acc * 0` into NaN."""
+        z = q.astype(jnp.float32)
+        return z * 0.0, z[..., 0].transpose(0, 2, 1) * 0.0 - jnp.inf
+
+    o_acc, lse_acc = zero_partial()
+    kc, vc = k, v
+    for d in range(sp):
+        def partial(kc=kc, vc=vc, d=d):
+            o, lse = flash_attention_lse(
+                q, kc, vc, causal=causal, window=window,
+                q_offset=d * S, k_offset=0)
+            return o.astype(jnp.float32), lse
+
+        if causal and d > 0:
+            o_d, lse_d = lax.cond(idx >= d, partial, zero_partial)
+        else:
+            o_d, lse_d = partial()
+        o_acc, lse_acc = _merge_partials(o_acc, lse_acc, o_d, lse_d)
+        if d < sp - 1:
+            perm = [(i, (i + 1) % sp) for i in range(sp)]
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+    return o_acc.astype(q.dtype)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    *, axis_name: str = AXIS_SEQ,
                    causal: bool = False,
-                   window: "int | None" = None) -> jax.Array:
+                   window: "int | None" = None,
+                   block_impl: str = "xla") -> jax.Array:
     """Ring attention over the ``seq`` mesh axis (SPMD; inside shard_map).
 
     Each rank holds a contiguous sequence block [B, S/sp, H, D]. K/V
@@ -182,10 +247,22 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     `causal=True`, blocks strictly in the future contribute -inf bias and
     their compute is skipped by masking (XLA still schedules the permute,
     keeping the ring in lockstep — required for collective correctness).
+
+    ``block_impl="flash"`` runs the Pallas flash kernel on each
+    rotation (`_ring_attention_flash`): per-block compute is
+    VMEM-tiled and banded under a window; partials merge by logsumexp.
+    The default "xla" keeps the plain online-softmax scan (the oracle,
+    and the fallback off-TPU/for custom dtypes).
     """
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
     check_window(window)
+    if block_impl not in ("xla", "flash"):
+        raise ValueError(
+            f"block_impl must be xla|flash, got {block_impl!r}")
+    if block_impl == "flash":
+        return _ring_attention_flash(q, k, v, axis_name=axis_name,
+                                     causal=causal, window=window)
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     B, S, H, D = q.shape
@@ -281,19 +358,22 @@ def _ambient_mesh(mesh):
 
 def ring_attention_gspmd(mesh, q, k, v, *, causal: bool = False,
                          window: "int | None" = None,
-                         seq_axis: str = AXIS_SEQ) -> jax.Array:
+                         seq_axis: str = AXIS_SEQ,
+                         block_impl: str = "xla") -> jax.Array:
     """Ring attention as a shard_map region inside a pjit'ed model.
 
     Activations are global-shaped [B, S, H, D] sharded
     (data, seq, model, -); the shard_map boundary hands each device its
     local block and the ring runs over ``seq``. This is how the flagship
     transformer calls it. `mesh=None` uses the ambient mesh installed by
-    `horovod_tpu.parallel.use()`.
+    `horovod_tpu.parallel.use()`. ``block_impl="flash"`` runs the
+    Pallas kernel on each rotation (see `ring_attention`).
     """
     mesh = _ambient_mesh(mesh)
     spec = P(AXIS_DATA, seq_axis, AXIS_MODEL, None)
     fn = functools.partial(ring_attention, axis_name=seq_axis,
-                           causal=causal, window=window)
+                           causal=causal, window=window,
+                           block_impl=block_impl)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=spec)(q, k, v)
 

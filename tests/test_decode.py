@@ -345,7 +345,8 @@ class TestGenerate:
         np.testing.assert_allclose(np.asarray(wide), np.asarray(full),
                                    atol=2e-5)
 
-    @pytest.mark.parametrize("sp_impl", ["ring", "ulysses"])
+    @pytest.mark.parametrize("sp_impl", ["ring", "ring_flash",
+                                         "ulysses"])
     def test_window_sequence_parallel_matches(self, hvd, sp_impl):
         """Window masking uses GLOBAL positions, so it is exact across
         ring-rotated / Ulysses-swapped sequence shards."""

@@ -177,6 +177,71 @@ class TestSequenceParallel:
                                    _ref_attention(q, k, v, causal),
                                    rtol=2e-5, atol=2e-5)
 
+    @pytest.mark.parametrize("causal,window", [(False, None),
+                                               (True, None), (True, 6)])
+    def test_ring_flash_matches_full(self, causal, window):
+        """Ring attention with the Pallas kernel per rotation
+        (block_impl='flash'): logsumexp-merged partials equal full
+        attention, fwd and grads, for non-causal, causal, and
+        sliding-window — including the lse-cotangent path through
+        `flash_attention_lse`'s fused VJP."""
+        mesh = par.make_mesh(seq=4, data=2)
+        rng = np.random.RandomState(2)
+        q, k, v = (jnp.asarray(rng.randn(2, 32, 2, 8), jnp.float32)
+                   for _ in range(3))
+        spec = P("data", "seq", None, None)
+        S = q.shape[1]
+        mask = None
+        if causal:
+            from horovod_tpu.parallel.sequence import banded_causal_mask
+            mask = banded_causal_mask(jnp.arange(S), jnp.arange(S),
+                                      window)[None, None]
+        fn = functools.partial(par.ring_attention, causal=causal,
+                               window=window, block_impl="flash")
+        sm = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec)
+        got = sm(q, k, v)
+        ref = par.dot_product_attention(q, k, v, mask)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+        g1 = jax.jit(jax.grad(
+            lambda q, k, v: (sm(q, k, v) ** 2).sum(),
+            argnums=(0, 1, 2)))(q, k, v)
+        g2 = jax.jit(jax.grad(
+            lambda q, k, v: (par.dot_product_attention(
+                q, k, v, mask) ** 2).sum(),
+            argnums=(0, 1, 2)))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_ring_flash_bf16_causal(self):
+        """bf16 inputs through the causal lax.cond path (regression:
+        the empty-partial branch built its lse in q.dtype, so bf16
+        tripped the cond's equal-output-types check)."""
+        mesh = par.make_mesh(seq=4, data=2)
+        rng = np.random.RandomState(3)
+        q, k, v = (jnp.asarray(rng.randn(2, 32, 2, 8), jnp.bfloat16)
+                   for _ in range(3))
+        spec = P("data", "seq", None, None)
+        fn = functools.partial(par.ring_attention, causal=True,
+                               block_impl="flash")
+        got = jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec)(q, k, v)
+        assert got.dtype == jnp.bfloat16
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+        ref = par.dot_product_attention(q, k, v, mask)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32),
+            atol=3e-2)  # bf16 tolerance
+
+    def test_ring_flash_rejects_bad_block_impl(self):
+        q = jnp.zeros((1, 8, 1, 4))
+        with pytest.raises(ValueError, match="block_impl"):
+            par.ring_attention(q, q, q, block_impl="nope")
+
     def test_ulysses_flash_pallas_bwd_grads(self):
         """The flagship long-context composition: Ulysses SP with the
         Pallas flash kernel (fused backward) as attn_impl — gradients
