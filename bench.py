@@ -396,8 +396,11 @@ def main():
                     help="sliding-window attention span")
     # head_dim 128 fills the MXU lanes — measured 1.56x over 64.
     ap.add_argument("--head-dim", type=int, default=128)
+    # Full impl list incl. ring_flash/ulysses_flash (SP impls fall
+    # back to local blockwise on the bench's data-only mesh).
     ap.add_argument("--attn-impl", default="flash",
-                    choices=["dot", "blockwise", "flash"])
+                    choices=["dot", "blockwise", "flash", "ring",
+                             "ring_flash", "ulysses", "ulysses_flash"])
     ap.add_argument("--loss-chunk", type=int, default=None,
                     help="transformer: fused head+loss scanned over "
                          "seq chunks (no [B,S,V] logits)")

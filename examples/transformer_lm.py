@@ -36,9 +36,8 @@ def main():
     ap.add_argument("--window", type=int, default=None,
                     help="sliding-window attention span")
     ap.add_argument("--head-dim", type=int, default=64)
-    ap.add_argument("--attn", default="ring",
-                    choices=["dot", "blockwise", "flash", "ring",
-                             "ulysses"])
+    from horovod_tpu.models.transformer import ATTN_IMPLS
+    ap.add_argument("--attn", default="ring", choices=list(ATTN_IMPLS))
     ap.add_argument("--moe-every", type=int, default=0)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--data", type=int, default=-1)

@@ -55,7 +55,7 @@ from horovod_tpu.parallel.tensor import (
 Dtype = Any
 
 ATTN_IMPLS = ("dot", "blockwise", "flash", "ring", "ring_flash",
-              "ulysses")
+              "ulysses", "ulysses_flash")
 
 
 def make_attn_fn(impl: str, *, causal: bool = True,
@@ -94,9 +94,15 @@ def make_attn_fn(impl: str, *, causal: bool = True,
         # heads); let ParallelSelfAttention skip the repeat.
         attn.native_gqa = True
         return attn
-    if impl in ("ring", "ring_flash", "ulysses"):
+    if impl in ("ring", "ring_flash", "ulysses", "ulysses_flash"):
         if impl == "ulysses":
             sp_fn = ulysses_attention_gspmd
+        elif impl == "ulysses_flash":
+            # Local attention after the head-swap all_to_alls is the
+            # Pallas flash kernel instead of the blockwise scan.
+            from horovod_tpu.ops.flash_attention import flash_attention
+            sp_fn = functools.partial(ulysses_attention_gspmd,
+                                      attn_impl=flash_attention)
         elif impl == "ring_flash":
             # Pallas flash kernel on every ring rotation; partials
             # merge by logsumexp (sequence._ring_attention_flash).
@@ -105,6 +111,8 @@ def make_attn_fn(impl: str, *, causal: bool = True,
         else:
             sp_fn = ring_attention_gspmd
 
+        native_gqa = impl in ("ring_flash", "ulysses_flash")
+
         def attn(q, k, v, m):
             _no_mask(m)
             # Off-mesh (e.g. model.init, single-device eval) there is no
@@ -112,11 +120,21 @@ def make_attn_fn(impl: str, *, causal: bool = True,
             # and attention has no params, so the init trace is identical.
             mesh = jax.sharding.get_abstract_mesh()
             if mesh is None or mesh.empty:
+                if native_gqa and k.shape[2] != q.shape[2]:
+                    # The flash paths take grouped K/V natively; the
+                    # blockwise fallback needs the repeat inline.
+                    g = q.shape[2] // k.shape[2]
+                    k = jnp.repeat(k, g, axis=2)
+                    v = jnp.repeat(v, g, axis=2)
                 return blockwise_attention(q, k, v, causal=causal,
                                            window=window,
                                            block_size=block_size)
             return sp_fn(None, q, k, v, causal=causal, window=window)
 
+        # K/V stay at kv-head width through the ppermute hops /
+        # all_to_alls — 1/group the ICI payload (the kernel index-maps
+        # kv heads; see flash_attention.native_gqa).
+        attn.native_gqa = native_gqa
         return attn
     raise ValueError(f"attn_impl must be one of {ATTN_IMPLS}, got {impl!r}")
 

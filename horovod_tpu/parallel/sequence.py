@@ -313,6 +313,11 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             f"ulysses_attention needs heads % seq_degree == 0, got "
             f"{H} heads over seq axis of size {sp}; use ring_attention "
             f"for head counts that don't divide")
+    if k.shape[2] != H and k.shape[2] % sp:
+        raise ValueError(
+            f"ulysses_attention with grouped K/V needs kv_heads % "
+            f"seq_degree == 0, got {k.shape[2]} kv heads over seq "
+            f"axis of size {sp}; use ring_attention instead")
 
     def seq_to_heads(t):  # [B, S/sp, H, D] -> [B, S, H/sp, D]
         return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,

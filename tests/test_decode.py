@@ -345,8 +345,33 @@ class TestGenerate:
         np.testing.assert_allclose(np.asarray(wide), np.asarray(full),
                                    atol=2e-5)
 
+    @pytest.mark.parametrize("sp_impl", ["ring_flash", "ulysses_flash"])
+    def test_gqa_sp_flash_matches(self, hvd, sp_impl):
+        """GQA + SP flash impls: K/V ride the ring hops / all_to_alls
+        at kv-head width (native_gqa) and still match the blockwise
+        reference (which sees repeated K/V)."""
+        from horovod_tpu.parallel.mesh import make_mesh, use
+        from horovod_tpu.parallel.tensor import shard_params
+        toks = _tokens(B=4, S=16, seed=27)
+        ref_model = _tiny_model("blockwise", num_kv_heads=2)
+        variables = ref_model.init(jax.random.PRNGKey(28), toks)
+        ref = ref_model.apply(variables, toks)
+        # model=1: ulysses needs kv_heads % seq == 0 after the head
+        # shard (2 kv heads over seq=2).
+        mesh = make_mesh(data=4, seq=2, model=1)
+        sp_model = _tiny_model(sp_impl, num_kv_heads=2)
+        with use(mesh):
+            params = shard_params(mesh, variables["params"])
+            toks_sh = jax.device_put(
+                toks, NamedSharding(mesh, P("data", "seq")))
+            out = jax.jit(lambda p, t: sp_model.apply(
+                {"params": p}, t))(params, toks_sh)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   atol=2e-4)
+
     @pytest.mark.parametrize("sp_impl", ["ring", "ring_flash",
-                                         "ulysses"])
+                                         "ulysses", "ulysses_flash"])
     def test_window_sequence_parallel_matches(self, hvd, sp_impl):
         """Window masking uses GLOBAL positions, so it is exact across
         ring-rotated / Ulysses-swapped sequence shards."""
