@@ -396,8 +396,12 @@ def main():
                     help="sliding-window attention span")
     # head_dim 128 fills the MXU lanes — measured 1.56x over 64.
     ap.add_argument("--head-dim", type=int, default=128)
-    # Full impl list incl. ring_flash/ulysses_flash (SP impls fall
-    # back to local blockwise on the bench's data-only mesh).
+    # Mirrors models.transformer.ATTN_IMPLS by hand: importing it here
+    # would pull jax in before the backend watchdog (the whole point
+    # of this file's lazy imports). On the bench's data-only mesh the
+    # SP impls run their real shard_map path at seq degree 1 — e.g.
+    # ring_flash times the Pallas kernel, it is NOT a blockwise
+    # fallback (that branch only triggers with no ambient mesh).
     ap.add_argument("--attn-impl", default="flash",
                     choices=["dot", "blockwise", "flash", "ring",
                              "ring_flash", "ulysses", "ulysses_flash"])

@@ -350,8 +350,6 @@ class TestGenerate:
         """GQA + SP flash impls: K/V ride the ring hops / all_to_alls
         at kv-head width (native_gqa) and still match the blockwise
         reference (which sees repeated K/V)."""
-        from horovod_tpu.parallel.mesh import make_mesh, use
-        from horovod_tpu.parallel.tensor import shard_params
         toks = _tokens(B=4, S=16, seed=27)
         ref_model = _tiny_model("blockwise", num_kv_heads=2)
         variables = ref_model.init(jax.random.PRNGKey(28), toks)
