@@ -156,14 +156,16 @@ class TransformerBlock(nn.Module):
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
     decode: bool = False
+    chunked_prefill: bool = False   # see ParallelSelfAttention
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         d = x.shape[-1]
-        # Decode ticks attend against the KV cache inside the attention
-        # module; the training attn_fn (flash/ring/...) is bypassed.
-        attn_fn = (None if self.decode else
-                   make_attn_fn(self.attn_impl, window=self.window))
+        # Decode ticks (S=1) attend against the KV cache inside the
+        # attention module; the attn_fn (flash/ring/...) is used by the
+        # ONE-PASS PREFILL (S>1 from an empty cache), which is plain
+        # causal attention over the prompt block — flash-able.
+        attn_fn = make_attn_fn(self.attn_impl, window=self.window)
         mask = None
         if attn_fn is None and not self.decode:
             # dot baseline materializes the banded causal mask
@@ -176,6 +178,7 @@ class TransformerBlock(nn.Module):
             num_kv_heads=self.num_kv_heads, pos_emb=self.pos_emb,
             rope_theta=self.rope_theta, window=self.window,
             dtype=self.dtype, attn_fn=attn_fn, decode=self.decode,
+            chunked_prefill=self.chunked_prefill,
             name="attn")(h, mask)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
@@ -216,6 +219,10 @@ class TransformerLM(nn.Module):
     moe_capacity_factor: float = 1.25
     remat: bool = False
     decode: bool = False        # autoregressive inference w/ KV cache
+    # S>1 decode calls append to a non-empty cache (general cache-wide
+    # mask) instead of the one-pass empty-cache prefill; see
+    # ParallelSelfAttention.chunked_prefill.
+    chunked_prefill: bool = False
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
@@ -267,7 +274,9 @@ class TransformerLM(nn.Module):
                 attn_impl=self.attn_impl, moe=moe,
                 num_experts=self.num_experts, moe_k=self.moe_k,
                 moe_capacity_factor=self.moe_capacity_factor,
-                decode=self.decode, name=f"block_{i}")(x)
+                decode=self.decode,
+                chunked_prefill=self.chunked_prefill,
+                name=f"block_{i}")(x)
             x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
 
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)

@@ -180,6 +180,12 @@ class ParallelSelfAttention(nn.Module):
     pos_emb: str = "none"        # "none" | "rope"
     rope_theta: float = 10000.0
     window: Optional[int] = None  # sliding-window (decode mask)
+    # Decode-mode S>1 calls: False (default) = one-pass prefill from
+    # an EMPTY cache through the model's kernel (flash-able; what
+    # `models.generate` does); True = chunked prefill — attend the
+    # cached prefix via the general cache-wide mask (correct for any
+    # cache_index, at [S, cache_len] mask cost).
+    chunked_prefill: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -220,18 +226,7 @@ class ParallelSelfAttention(nn.Module):
             o = self._decode_attention(q, k, v)
         else:
             q, k = self._maybe_rope(q, k)
-            if self.attn_fn is not None:
-                if _native_gqa(self.attn_fn):
-                    # e.g. the Pallas flash kernel: K/V consumed at
-                    # their Hkv width via index maps — never pay the
-                    # H/Hkv x repeat materialization in HBM.
-                    o = self.attn_fn(q, k, v, mask)
-                else:
-                    o = self.attn_fn(q, self._repeat_kv(k),
-                                     self._repeat_kv(v), mask)
-            else:
-                o = dot_product_attention(q, self._repeat_kv(k),
-                                          self._repeat_kv(v), mask)
+            o = self._dispatch_attn(q, k, v, mask)
         o = o.reshape(*o.shape[:-2], features)
         if o.ndim == 2:
             o = constrain(o, AXIS_SEQ, AXIS_MODEL)
@@ -257,6 +252,51 @@ class ParallelSelfAttention(nn.Module):
         if reps == 1:
             return t
         return jnp.repeat(t, reps, axis=-2)
+
+    def _dispatch_attn(self, q, k, v, mask):
+        """THE attn_fn / native-GQA / dot dispatch (single site —
+        train, init trace, and prefill all route through here)."""
+        if self.attn_fn is not None:
+            if _native_gqa(self.attn_fn):
+                # e.g. the Pallas flash kernel: K/V consumed at their
+                # Hkv width via index maps — never pay the H/Hkv x
+                # repeat materialization in HBM.
+                return self.attn_fn(q, k, v, mask)
+            return self.attn_fn(q, self._repeat_kv(k),
+                                self._repeat_kv(v), mask)
+        return dot_product_attention(q, self._repeat_kv(k),
+                                     self._repeat_kv(v), mask)
+
+    def _causal_block_attn(self, q, k, v):
+        """Causal(+window) attention over the current block alone via
+        the model's kernel (the attn_fn carries the band rule; the dot
+        fallback materializes it)."""
+        if self.attn_fn is not None:
+            return self._dispatch_attn(q, k, v, None)
+        pos = jnp.arange(q.shape[-3])
+        m = banded_causal_mask(pos, pos, self.window)[None, None]
+        return self._dispatch_attn(q, k, v, m)
+
+    def _cache_write(self, cached_k, cached_v, index, k, v, i, S, W):
+        """Append S new K/V at position i (linear cache) or into their
+        rolling slots (window cache); advances the index."""
+        if self.window is None:
+            z = jnp.zeros((), i.dtype)
+            cached_k.value = lax.dynamic_update_slice(
+                cached_k.value, k, (z, i, z, z))
+            cached_v.value = lax.dynamic_update_slice(
+                cached_v.value, v, (z, i, z, z))
+        else:
+            # Last min(S, W) keys land in their slots (earlier ones
+            # would be overwritten within this block anyway).
+            t = min(S, W)
+            qpos = i + jnp.arange(S, dtype=i.dtype)
+            slots = (qpos[S - t:]) % W
+            cached_k.value = cached_k.value.at[:, slots].set(
+                k[:, S - t:])
+            cached_v.value = cached_v.value.at[:, slots].set(
+                v[:, S - t:])
+        index.value = i + S
 
     def _decode_attention(self, q, k, v):
         """One decode tick: append k/v at `cache_index`, attend q
@@ -284,13 +324,8 @@ class ParallelSelfAttention(nn.Module):
         index = self.variable("cache", "cache_index",
                               lambda: jnp.zeros((), jnp.int32))
         if not is_init:
-            S = q.shape[-3]
             q, k = self._maybe_rope(q, k)
-            pos_ = jnp.arange(S)
-            causal = banded_causal_mask(pos_, pos_,
-                                        self.window)[None, None]
-            return dot_product_attention(
-                q, self._repeat_kv(k), self._repeat_kv(v), causal)
+            return self._causal_block_attn(q, k, v)
 
         S = q.shape[-3]
         W = cached_k.value.shape[-3]
@@ -298,6 +333,20 @@ class ParallelSelfAttention(nn.Module):
         # Rotate at the ABSOLUTE position; keys enter the cache
         # already rotated, so the prefix needs no re-rotation.
         q, k = self._maybe_rope(q, k, offset=i)
+
+        if S > 1 and not self.chunked_prefill:
+            # ONE-PASS PREFILL — the S>1 decode-mode call
+            # `models.generate` makes; contract: the cache is EMPTY
+            # (i = 0), so attending the cached prefix equals causal
+            # (+window) attention over the current block alone. Runs
+            # through the model's kernel (flash: VMEM-tiled, banded
+            # under a window, GQA-native) — prefill cost follows the
+            # PROMPT, never a [S, cache_len] mask materialized against
+            # max_len/window slots. For S>1 appends to a NON-empty
+            # cache, set ``chunked_prefill=True`` to keep the general
+            # cache-wide-mask path below (correct for any i).
+            self._cache_write(cached_k, cached_v, index, k, v, i, S, W)
+            return self._causal_block_attn(q, k, v)
 
         if self.window is None:
             z = jnp.zeros((), i.dtype)  # match index dtype under x64
@@ -334,13 +383,7 @@ class ParallelSelfAttention(nn.Module):
         out = dot_product_attention(q, self._repeat_kv(key),
                                     self._repeat_kv(val),
                                     keep[None, None])
-        # Write the last min(S, W) new keys into their slots (earlier
-        # ones would be overwritten within this block anyway).
-        t = min(S, W)
-        slots = (qpos[S - t:]) % W
-        cached_k.value = cached_k.value.at[:, slots].set(k[:, S - t:])
-        cached_v.value = cached_v.value.at[:, slots].set(v[:, S - t:])
-        index.value = i + S
+        self._cache_write(cached_k, cached_v, index, k, v, i, S, W)
         return out
 
 

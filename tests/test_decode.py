@@ -345,6 +345,34 @@ class TestGenerate:
         np.testing.assert_allclose(np.asarray(wide), np.asarray(full),
                                    atol=2e-5)
 
+    def test_chunked_prefill_matches_one_pass(self, hvd):
+        """chunked_prefill=True: two S>1 appends onto a growing cache
+        equal the one-pass prefill's cache + logits — the general
+        cache-wide-mask path stays correct for any cache_index (the
+        default fast path is contractually empty-cache-only)."""
+        model = _tiny_model("blockwise")
+        toks = _tokens(B=2, S=12, seed=31)
+        variables = model.init(jax.random.PRNGKey(32), toks)
+        params = unbox(variables["params"])
+
+        dec = model.clone(decode=True, chunked_prefill=True)
+        shapes = jax.eval_shape(
+            dec.init, jax.random.PRNGKey(0),
+            jnp.zeros((2, model.max_len), toks.dtype))
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             shapes["cache"])
+        # chunk 1: positions 0..5; chunk 2: positions 6..11
+        out1, mut = dec.apply({"params": params, "cache": cache},
+                              toks[:, :6], mutable=["cache"])
+        out2, mut = dec.apply(
+            {"params": params, "cache": mut["cache"]},
+            toks[:, 6:], mutable=["cache"])
+        # oracle: the training-mode forward over the full prefix
+        ref = model.apply(variables, toks)
+        np.testing.assert_allclose(
+            np.asarray(out2, np.float32),
+            np.asarray(ref[:, 6:], np.float32), atol=2e-4)
+
     @pytest.mark.parametrize("sp_impl", ["ring_flash", "ulysses_flash"])
     def test_gqa_sp_flash_matches(self, hvd, sp_impl):
         """GQA + SP flash impls: K/V ride the ring hops / all_to_alls

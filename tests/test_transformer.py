@@ -567,6 +567,8 @@ class TestSPMDCleanCompile:
             cwd=repo, env=env, capture_output=True, text=True,
             timeout=420)
         assert res.returncode == 0, res.stdout + res.stderr
+        if repo not in sys.path:  # __graft_entry__ lives at repo root
+            sys.path.insert(0, repo)
         from __graft_entry__ import DRYRUN_LM_CONFIGS
         assert (res.stdout.count("SPMD_CLEAN_OK")
                 == len(DRYRUN_LM_CONFIGS)), res.stdout
