@@ -474,6 +474,34 @@ class TestTransformerLM:
         assert np.isfinite(float(loss))
 
 
+class TestBf16Flagship:
+    @pytest.mark.parametrize("attn_impl", ["flash", "ring_flash"])
+    def test_bf16_train_step_decreases(self, hvd, attn_impl):
+        """The flagship configs at their PRODUCTION dtype (bf16 —
+        most oracle tests run f32): full train step over dp x sp x tp,
+        finite and decreasing loss. Guards dtype drift like the
+        bf16-vs-f32 lse branch mismatch the f32 suite can't see."""
+        mesh = make_mesh(data=2, seq=2, model=2)
+        model = TransformerLM(vocab_size=64, num_layers=2, num_heads=4,
+                              head_dim=8, num_kv_heads=2,
+                              pos_emb="rope", window=8,
+                              max_len=32, dtype=jnp.bfloat16,
+                              attn_impl=attn_impl)
+        toks = _tokens(B=4, S=16, seed=40)
+        tx = optax.adamw(1e-2)
+        params, opt_state = init_lm_state(
+            model, tx, jax.random.PRNGKey(0), mesh, toks)
+        step = make_lm_train_step(model, tx, mesh)
+        toks_sh = jax.device_put(
+            toks, NamedSharding(mesh, P("data", "seq")))
+        losses = []
+        for _ in range(4):
+            params, opt_state, loss = step(params, opt_state, toks_sh)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0], losses
+
+
 class TestPipelineTransformer:
     def test_blockstack_pipeline_matches_sequential(self, hvd):
         """GPipe over ``pipe`` on transformer blocks == applying the
