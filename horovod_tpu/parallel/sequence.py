@@ -328,6 +328,14 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                               tiled=True)
 
     check_window(window)
+    # Grouped K/V (kv_heads < heads) with an attention impl that is
+    # not GQA-native (no `native_gqa` marker — e.g. the default
+    # blockwise path): repeat K/V to full head count AFTER the
+    # all_to_all, so the impl sees matching head axes instead of an
+    # opaque downstream shape error. GQA-native kernels fold the
+    # group internally and skip the materialized repeat.
+    gqa_repeat = (k.shape[2] != H
+                  and not getattr(attn_impl, "native_gqa", False))
     # Only forward window= when set, so pre-existing custom attn_impl
     # callables without the kwarg keep working in window-less models —
     # but refuse up front (before tracing) when window IS set and the
@@ -347,6 +355,10 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 f"attn_impl(q, k, v, *, causal, window) -> out)")
         attn_impl = functools.partial(attn_impl, causal=causal, **kw)
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if gqa_repeat:
+        g = qh.shape[2] // kh.shape[2]
+        kh = jnp.repeat(kh, g, axis=2)
+        vh = jnp.repeat(vh, g, axis=2)
     oh = attn_impl(qh, kh, vh)
     return heads_to_seq(oh)
 

@@ -345,6 +345,16 @@ class ParallelSelfAttention(nn.Module):
             # max_len/window slots. For S>1 appends to a NON-empty
             # cache, set ``chunked_prefill=True`` to keep the general
             # cache-wide-mask path below (correct for any i).
+            # Best-effort contract enforcement: with a concrete index
+            # (eager apply) a non-empty cache is a hard error instead
+            # of silently attending only the current block; under jit
+            # `i` is a tracer and the contract stays documented-only.
+            if not isinstance(i, jax.core.Tracer) and int(i) != 0:
+                raise ValueError(
+                    "one-pass prefill (chunked_prefill=False) requires "
+                    f"an empty cache, but cache_index={int(i)}; use "
+                    "chunked_prefill=True for S>1 appends to a "
+                    "non-empty cache")
             self._cache_write(cached_k, cached_v, index, k, v, i, S, W)
             return self._causal_block_attn(q, k, v)
 

@@ -373,6 +373,27 @@ class TestGenerate:
             np.asarray(out2, np.float32),
             np.asarray(ref[:, 6:], np.float32), atol=2e-4)
 
+    def test_one_pass_prefill_nonempty_cache_raises(self, hvd):
+        """One-pass prefill (chunked_prefill=False) contractually
+        requires an empty cache; an eager S>1 append onto a non-empty
+        cache (concrete cache_index > 0) is a hard ValueError naming
+        chunked_prefill, not a silently-wrong output (advisor r3 #1)."""
+        model = _tiny_model("blockwise")
+        toks = _tokens(B=2, S=12, seed=41)
+        variables = model.init(jax.random.PRNGKey(42), toks)
+        params = unbox(variables["params"])
+        dec = model.clone(decode=True, chunked_prefill=False)
+        shapes = jax.eval_shape(
+            dec.init, jax.random.PRNGKey(0),
+            jnp.zeros((2, model.max_len), toks.dtype))
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                             shapes["cache"])
+        _, mut = dec.apply({"params": params, "cache": cache},
+                           toks[:, :6], mutable=["cache"])
+        with pytest.raises(ValueError, match="chunked_prefill"):
+            dec.apply({"params": params, "cache": mut["cache"]},
+                      toks[:, 6:], mutable=["cache"])
+
     @pytest.mark.parametrize("sp_impl", ["ring_flash", "ulysses_flash"])
     def test_gqa_sp_flash_matches(self, hvd, sp_impl):
         """GQA + SP flash impls: K/V ride the ring hops / all_to_alls

@@ -275,6 +275,27 @@ class TestSequenceParallel:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
 
+    def test_ulysses_grouped_kv_non_gqa_impl_repeats(self):
+        """Grouped K/V (GQA) through ulysses with a NON-GQA-native
+        attn_impl (the default blockwise path): K/V are repeated to
+        full head count after the all_to_all instead of dying on an
+        opaque downstream shape error (advisor r3 #3)."""
+        mesh = par.make_mesh(data=4, seq=2)
+        rng = np.random.RandomState(11)
+        q = jnp.asarray(rng.randn(4, 16, 4, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(4, 16, 2, 8), jnp.float32)  # Hkv=2
+        v = jnp.asarray(rng.randn(4, 16, 2, 8), jnp.float32)
+        spec = P("data", "seq", None, None)
+        got = jax.shard_map(
+            functools.partial(par.ulysses_attention, causal=True),
+            mesh=mesh, in_specs=(spec, spec, spec),
+            out_specs=spec)(q, k, v)
+        ref = _ref_attention(np.asarray(q),
+                             np.repeat(np.asarray(k), 2, axis=2),
+                             np.repeat(np.asarray(v), 2, axis=2), True)
+        np.testing.assert_allclose(np.asarray(got), ref,
+                                   rtol=2e-5, atol=2e-5)
+
     def test_ulysses_rejects_windowless_custom_attn_impl(self):
         """window= with a custom attn_impl that can't take it must be a
         clear ValueError naming the contract, not a TypeError from
