@@ -27,6 +27,83 @@ from jax import lax
 ModuleDef = Any
 
 
+def _space_to_depth(x, b):
+    """[N, H, W, C] -> [N, H/b, W/b, b*b*C]; channel packing is
+    (row-in-block, col-in-block, channel), matching the kernel
+    re-pack in `SpaceToDepthStem`."""
+    N, H, W, C = x.shape
+    x = x.reshape(N, H // b, b, W // b, b, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        N, H // b, W // b, b * b * C)
+
+
+class SpaceToDepthStem(nn.Module):
+    """The ResNet 7x7/s2 stem computed as a space-to-depth conv — the
+    standard MLPerf TPU trick for MXU underfill at the input layer.
+
+    The plain stem convolves [N,H,W,3] with a [7,7,3,F] kernel: a
+    3-channel contraction fills ~3/128 of an MXU pass, so the stem's
+    ~25 % share of early FLOPs runs at a few percent efficiency
+    (docs/mfu.md culprit #1). Here the image is 4x4 space-to-depth'd
+    to [N,H/4,W/4,48] and convolved with a [3,3,48,4F] re-pack of the
+    SAME [7,7,3,F] parameter (stride 1, VALID), then a 2x2
+    depth-to-space restores [N,H/2,W/2,F] — numerically identical to
+    the plain stem (oracle: tests/test_models.py) with a 16x larger
+    contraction dim.
+
+    The parameter tree is exactly nn.Conv's ({"kernel": [7,7,C,F]})
+    under the same module name, so `s2d_stem` is a pure compute-path
+    flag: checkpoints and inits are interchangeable with the plain
+    stem.
+
+    Derivation (1-D, per output column p = 2P + a, a in {0,1}): the
+    SAME-padded stride-2 conv reads original pixels 2p-2+u, u in
+    [0,7). With the image zero-padded by (2, 6) the window for s2d
+    cell P starts at padded pixel 4P and spans 12 pixels = 3 cells;
+    sub-position a selects kernel taps w[4U+du-2a], which is the
+    [7,7] kernel embedded at offset (2a, 2b) in a [12,12] zero block.
+    The extra trailing zero-pad columns (6 vs SAME's 3) multiply
+    zeros in both formulations, so equality is exact.
+    """
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        N, H, W, C = x.shape
+        if H % 4 or W % 4:
+            raise ValueError(
+                f"space-to-depth stem needs H, W divisible by 4, got "
+                f"{(H, W)}; use s2d_stem=False for this input")
+        F = self.features
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (7, 7, C, F))
+        x = jnp.pad(x, ((0, 0), (2, 6), (2, 6), (0, 0)))
+        x = _space_to_depth(x, 4).astype(self.dtype)
+
+        k = kernel.astype(self.dtype)
+        taps = []
+        for a in (0, 1):
+            for b in (0, 1):
+                kab = jnp.zeros((12, 12, C, F), k.dtype)
+                kab = kab.at[2 * a:2 * a + 7, 2 * b:2 * b + 7].set(k)
+                taps.append(
+                    kab.reshape(3, 4, 3, 4, C, F)
+                    .transpose(0, 2, 1, 3, 4, 5)
+                    .reshape(3, 3, 16 * C, F))
+        # Output packing o*4 + a*2 + b — undone by the depth-to-space
+        # below.
+        w = jnp.stack(taps, axis=-1).reshape(3, 3, 16 * C, 4 * F)
+
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        P, Q = y.shape[1], y.shape[2]
+        y = y.reshape(N, P, Q, F, 2, 2)
+        return y.transpose(0, 1, 4, 2, 5, 3).reshape(
+            N, 2 * P, 2 * Q, F)
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: Tuple[int, int]
@@ -62,6 +139,10 @@ class ResNet(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     sync_bn: bool = False
     axis_name: str = "data"
+    # MXU-friendly stem (SpaceToDepthStem): same parameters, same
+    # outputs, 16x larger stem contraction dim. Off by default so the
+    # benchmark measures plain vs s2d explicitly (bench.py --stem).
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -73,7 +154,11 @@ class ResNet(nn.Module):
                        axis_name=bn_axis)
 
         x = x.astype(self.dtype)
-        x = conv(self.width, (7, 7), (2, 2), name="stem_conv")(x)
+        if self.s2d_stem:
+            x = SpaceToDepthStem(self.width, dtype=self.dtype,
+                                 name="stem_conv")(x)
+        else:
+            x = conv(self.width, (7, 7), (2, 2), name="stem_conv")(x)
         x = norm(name="stem_bn")(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
