@@ -28,7 +28,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from horovod_tpu.ops import eager
-from horovod_tpu.ops.fusion import fused_allreduce_tree
+from horovod_tpu.ops.fusion import (combiner_override_options,
+                                    fused_allreduce_tree)
 from horovod_tpu.ops.sparse import IndexedSlices
 from horovod_tpu.runtime import state as _state
 from horovod_tpu.runtime.config import config
@@ -342,4 +343,6 @@ def make_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
     )
     donate_argnums = (0, 1) if donate else ()
     from horovod_tpu.utils.timeline import step_bracket
-    return step_bracket(jax.jit(sharded, donate_argnums=donate_argnums))
+    return step_bracket(jax.jit(
+        sharded, donate_argnums=donate_argnums,
+        compiler_options=combiner_override_options() or None))

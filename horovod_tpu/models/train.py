@@ -22,7 +22,8 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from horovod_tpu.ops.fusion import fused_allreduce_tree
+from horovod_tpu.ops.fusion import (combiner_override_options,
+                                    fused_allreduce_tree)
 from horovod_tpu.runtime import state as _state
 
 
@@ -81,7 +82,9 @@ def make_cnn_train_step(model, tx: optax.GradientTransformation,
     )
     donate_argnums = (0,) if donate else ()
     from horovod_tpu.utils.timeline import step_bracket
-    return step_bracket(jax.jit(sharded, donate_argnums=donate_argnums))
+    return step_bracket(jax.jit(
+        sharded, donate_argnums=donate_argnums,
+        compiler_options=combiner_override_options() or None))
 
 
 def init_cnn_state(model, tx: optax.GradientTransformation, rng,

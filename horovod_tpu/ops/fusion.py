@@ -27,6 +27,35 @@ from jax import lax
 from horovod_tpu.runtime.config import config
 
 
+# XLA's backend collective-combiner passes re-merge independent
+# all-reduces into one tuple all-reduce AFTER our bucketing (observed
+# on the CPU backend: N independent bucket psums compile to a single
+# tuple all-reduce scheduled after the whole backward — voiding the
+# per-bucket overlap structure docs/scaling.md's model rests on).
+# `xla_disable_hlo_passes` is the generic, per-compile escape hatch;
+# unknown pass names are ignored, so one list covers every backend
+# (verified on CPU: "cpu-all-reduce-combiner" is the pass that
+# re-merges; "all-reduce-combiner" is the OSS/GPU/TPU pass name).
+_COMBINER_PASSES = "all-reduce-combiner,cpu-all-reduce-combiner"
+
+
+def combiner_override_options() -> dict:
+    """jit `compiler_options` that pin HOROVOD_FUSION_THRESHOLD's
+    bucket granularity through XLA's backend passes.
+
+    The reference's fusion threshold controls collective granularity
+    end to end (`mpi_ops.cc:1392-1419` merges *up to* the threshold,
+    never past it); without this override the XLA backend combiner
+    silently re-merges our buckets, so the env var's semantic — and
+    the bucket-level backward/collective overlap — would stop at the
+    IR. Returns {} when HOROVOD_XLA_COMBINER=xla (opt out: let XLA
+    choose granularity).
+    """
+    if config.xla_combiner == "xla":
+        return {}
+    return {"xla_disable_hlo_passes": _COMBINER_PASSES}
+
+
 def _leaf_bytes(leaf) -> int:
     return int(np.prod(leaf.shape)) * leaf.dtype.itemsize if leaf.ndim else leaf.dtype.itemsize
 

@@ -51,6 +51,11 @@ class Config:
     allreduce_dtype: str = ""          # e.g. "bfloat16" to reduce in bf16
     mesh_axis_name: str = "data"       # default 1-D data-parallel axis
     use_native: bool = True            # load the C++ control plane
+    # "pin" (default): disable XLA's backend AllReduceCombiner in the
+    # train-step compile so HOROVOD_FUSION_THRESHOLD's bucket
+    # granularity survives to the executed module; "xla": let the
+    # backend re-merge (ops/fusion.py combiner_override_options).
+    xla_combiner: str = "pin"
 
     def refresh(self) -> "Config":
         self.fusion_threshold = _env_int(
@@ -63,6 +68,7 @@ class Config:
         self.allreduce_dtype = os.environ.get("HOROVOD_ALLREDUCE_DTYPE", "")
         self.mesh_axis_name = os.environ.get("HOROVOD_MESH_AXIS", "data")
         self.use_native = os.environ.get("HOROVOD_NO_NATIVE", "") == ""
+        self.xla_combiner = os.environ.get("HOROVOD_XLA_COMBINER", "pin")
         return self
 
 

@@ -141,3 +141,76 @@ class TestOverlapStructure:
         # collective granularity of the IR end to end.
         txt = self._stablehlo(1 << 26)
         assert len(re.findall(r"stablehlo\.all_reduce", txt)) == 2
+
+    def test_post_optimization_bucket_structure(self, hvd):
+        """Close the overlap-model loophole (VERDICT r3 next-#3): the
+        backend AllReduceCombiner re-merges our independent bucket
+        all-reduces into one tuple all-reduce (the hazard
+        docs/scaling.md flags), and `combiner_override_options()` —
+        applied by the train-step factories under the default
+        HOROVOD_XLA_COMBINER=pin — provably keeps one independent
+        all-reduce per bucket in the POST-optimization HLO, not just
+        the pre-pass IR."""
+        import re
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from horovod_tpu import models
+        from horovod_tpu.models import make_cnn_train_step
+        from horovod_tpu.models.train import init_cnn_state
+        from horovod_tpu.ops.fusion import combiner_override_options
+
+        n_grad_leaves = 8  # MnistConvNet: 4 layers x (kernel, bias)
+        model = models.MnistConvNet(dtype=jnp.float32)
+        tx = optax.sgd(0.1)
+        state = init_cnn_state(model, tx, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 28, 28, 1), jnp.float32))
+        step = make_cnn_train_step(model, tx, fusion_threshold=1)
+        x = jnp.zeros((8, 28, 28, 1))
+        y = jnp.zeros((8,), jnp.int32)
+        lowered = step.__wrapped__.lower(
+            state, (x, y), jax.random.PRNGKey(1))
+
+        def count_all_reduces(compiled):
+            txt = compiled.as_text()  # post-optimization HLO
+            return len(re.findall(r"= \S+ all-reduce\(", txt)), txt
+
+        # The factory's jit carries the pin (HOROVOD_XLA_COMBINER
+        # defaults to "pin"): 8 per-leaf buckets + the loss pmean
+        # survive every backend pass as INDEPENDENT all-reduces.
+        n_pinned, txt = count_all_reduces(lowered.compile())
+        assert n_pinned == n_grad_leaves + 1, txt[:2000]
+        # Independence in the optimized module: no all-reduce operand
+        # is another all-reduce's result.
+        results = {m.lstrip("%") for m in
+                   re.findall(r"(\S+) = \S+ all-reduce\(", txt)}
+        for operands in re.findall(r"= \S+ all-reduce\(([^)]*)\)", txt):
+            for name in re.findall(r"%?[\w.-]+", operands):
+                assert name.lstrip("%") not in results
+
+        # And the hazard is real: the same step built with
+        # HOROVOD_XLA_COMBINER=xla (combiner left on) re-merges the
+        # antichain into fewer (tuple) all-reduces — this is what the
+        # default pin defends against. (Counted, not assumed, so a
+        # future XLA that stops combining makes this assertion fail
+        # loudly and the pin can be retired.)
+        from horovod_tpu.runtime.config import config as hvd_config
+        assert combiner_override_options() == {
+            "xla_disable_hlo_passes":
+                "all-reduce-combiner,cpu-all-reduce-combiner"}
+        old = hvd_config.xla_combiner
+        try:
+            hvd_config.xla_combiner = "xla"
+            assert combiner_override_options() == {}
+            unpinned = make_cnn_train_step(model, tx,
+                                           fusion_threshold=1)
+            n_merged, _ = count_all_reduces(
+                unpinned.__wrapped__.lower(
+                    state, (x, y), jax.random.PRNGKey(1)).compile())
+        finally:
+            hvd_config.xla_combiner = old
+        assert n_merged < n_grad_leaves + 1, (
+            f"backend no longer combines ({n_merged}); "
+            f"revisit combiner_override_options")
