@@ -39,6 +39,12 @@ import time
 
 P100_RESNET101_IMG_S = 138.0  # per-GPU fp32 baseline (paper-era setup)
 
+# Error substrings that mark an infrastructure flake (tunneled-backend
+# remote_compile drops), not a benchmark failure — shared by the main
+# retry loop and the flash-proof cache's staleness check.
+TRANSIENT_ERRORS = ("remote_compile", "read body", "UNAVAILABLE",
+                    "DEADLINE_EXCEEDED", "Connection reset")
+
 # Analytic training FLOPs per image at 224²/299² (3× forward pass);
 # used for the MFU estimate when XLA cost analysis is unavailable.
 TRAIN_GFLOPS_PER_IMG = {
@@ -344,9 +350,20 @@ def run_transformer(args, devices, n_chips, log):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default="resnet101",
+    ap.add_argument("--model", default=None,
                     choices=["resnet50", "resnet101", "vgg16",
-                             "inception3", "mnist", "transformer"])
+                             "inception3", "mnist", "transformer"],
+                    help="single model to bench; omitted (the driver "
+                         "default) = resnet101 plus an --all-models "
+                         "pass over the other BASELINE.md models")
+    ap.add_argument("--all-models", action="store_true",
+                    help="after the primary model, also time "
+                         "resnet101+s2d, inception3, vgg16 (each "
+                         "failure-isolated; one JSON line per model)")
+    ap.add_argument("--stem", default="plain", choices=["plain", "s2d"],
+                    help="resnet stem: plain 7x7/s2 conv or the "
+                         "numerically-identical space-to-depth re-pack "
+                         "(MXU-friendly; docs/mfu.md culprit #1)")
     ap.add_argument("--batch", type=int, default=None,
                     help="per-chip batch size (default: 128 for CNNs, "
                          "8 for the transformer)")
@@ -417,6 +434,10 @@ def main():
                          "steps into DIR (overlap/MFU analysis)")
     args = ap.parse_args()
 
+    if args.model is None:  # driver default: full BASELINE.md coverage
+        args.model = "resnet101"
+        args.all_models = True
+
     is_lm = args.model == "transformer"
     if args.batch is None:
         args.batch = 8 if is_lm else 128
@@ -466,8 +487,7 @@ def main():
         # ("read body: response body closed…", observed r2) — an
         # infrastructure flake, not a benchmark failure. Retry before
         # reporting.
-        transient = ("remote_compile", "read body", "UNAVAILABLE",
-                     "DEADLINE_EXCEEDED", "Connection reset")
+        transient = TRANSIENT_ERRORS
         for attempt in range(max(1, args.retries + 1)):
             try:
                 _bench_body(args, devices, n_chips, metric, unit,
@@ -493,6 +513,110 @@ def main():
 _FLASH_DONE = {}  # the proof runs once even across transient retries
 
 
+def _flash_proof_pending(args):
+    """The proof should (re)run when there is no cached outcome, or
+    when the cached outcome is a TRANSIENT error — a one-off tunnel
+    drop must not pin a stale failure into every retry's report
+    (ADVICE r3). A successful timing or a genuine kernel failure is
+    cached for the life of the process."""
+    if args.no_flash:
+        return False
+    if "result" not in _FLASH_DONE:
+        return True
+    ms, err = _FLASH_DONE["result"]
+    return (ms is None and err is not None
+            and any(t in err for t in TRANSIENT_ERRORS))
+
+
+def _make_cnn_model(args, name, stem):
+    """(model, input shape, num_classes) for a CNN benchmark config."""
+    import jax.numpy as jnp
+
+    from horovod_tpu import models
+    if name == "mnist":
+        return (models.MnistConvNet(dtype=jnp.float32),
+                (1, 28, 28, 1), 10)
+    if name == "vgg16":
+        return (models.VGG16(num_classes=1000),
+                (1, args.image_size, args.image_size, 3), 1000)
+    if name == "inception3":
+        return (models.InceptionV3(num_classes=1000),
+                (1, max(args.image_size, 299),
+                 max(args.image_size, 299), 3), 1000)
+    cls = (models.ResNet50 if name == "resnet50" else models.ResNet101)
+    return (cls(num_classes=1000, s2d_stem=(stem == "s2d")),
+            (1, args.image_size, args.image_size, 3), 1000)
+
+
+def _cnn_bench(args, name, stem, n_chips):
+    """Build one CNN config and return its `run(threshold, batch=None,
+    steps=None)` timing closure (img/s global). State init happens
+    here, once; each run clones it (the train step donates buffers)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models import make_cnn_train_step
+    from horovod_tpu.models.train import init_cnn_state
+
+    model, shape, num_classes = _make_cnn_model(args, name, stem)
+    tx = optax.sgd(0.1, momentum=0.9)
+    rng = jax.random.PRNGKey(0)
+    log(f"initializing {name} ({stem} stem) params...")
+    state = init_cnn_state(model, tx, rng,
+                           jnp.zeros(shape, jnp.bfloat16))
+
+    _batches = {}  # per-chip size -> device arrays (fusion sweeps
+    # reuse the same batch; only the batch sweep builds new shapes)
+
+    def make_batch(per_chip):
+        if per_chip not in _batches:
+            gb = per_chip * n_chips
+            x = np.random.RandomState(0).randn(
+                gb, *shape[1:]).astype(np.float32)
+            y = np.random.RandomState(1).randint(
+                0, num_classes, size=(gb,))
+            _batches[per_chip] = (jnp.asarray(x, jnp.bfloat16),
+                                  jnp.asarray(y))
+        return _batches[per_chip]
+
+    def run(threshold, batch=None):
+        steps = args.steps
+        step = make_cnn_train_step(model, tx,
+                                   fusion_threshold=threshold,
+                                   remat=args.remat)
+        xb, yb = make_batch(args.batch if batch is None else batch)
+        gb = xb.shape[0]
+        # Fresh state per run: the step donates its input buffers,
+        # so a sweep's second run would otherwise read deleted
+        # arrays.
+        st0 = jax.tree.map(jnp.array, state)
+        st, loss, dt, compile_s = time_steps(
+            step, st0, (xb, yb), rng, steps, args.warmup,
+            profile_dir=args.profile)
+        img_s = steps * gb / dt
+        log(f"{name}[{stem}] thr={threshold} b={gb // n_chips}: "
+            f"{img_s:.1f} img/s ({img_s / n_chips:.1f}/chip, "
+            f"step {dt / steps * 1e3:.1f} ms, "
+            f"warmup {compile_s:.1f}s, loss={loss:.3f})")
+        return img_s
+
+    run.shape = shape
+    return run
+
+
+def _cnn_mfu(name, shape, img_s_chip, device_kind):
+    """Analytic-FLOPs MFU estimate (coarse but honest; docs/mfu.md)."""
+    peak = PEAK_BF16.get(device_kind)
+    if not peak or name not in TRAIN_GFLOPS_PER_IMG:
+        return None
+    base = 299 if name == "inception3" else 224
+    scale = 1.0 if name == "mnist" else (shape[1] / base) ** 2
+    return round(img_s_chip * TRAIN_GFLOPS_PER_IMG[name] * scale
+                 * 1e9 / peak, 4)
+
+
 def _bench_body(args, devices, n_chips, metric, unit,
                 platform, device_kind):
     import jax
@@ -510,9 +634,10 @@ def _bench_body(args, devices, n_chips, metric, unit,
     # even if the heavy model bench below times out. The final model
     # line is still the LAST line (what the driver parses). Runs once
     # even if a transient error re-enters this body via the retry
-    # loop; the first attempt's outcome (timing OR error) is cached so
-    # retries re-report it instead of dropping it.
-    if not args.no_flash and "result" not in _FLASH_DONE:
+    # loop; a successful timing (or genuine kernel failure) is cached
+    # so retries re-report it, while a transient-error outcome is
+    # retried (`_flash_proof_pending`).
+    if _flash_proof_pending(args):
         ms = err = impl = None
         try:
             ms, impl = flash_attention_proof(platform)
@@ -529,6 +654,9 @@ def _bench_body(args, devices, n_chips, metric, unit,
     flash_ms, flash_err = _FLASH_DONE.get("result", (None, None))
 
     is_lm = args.model == "transformer"
+    if is_lm and args.all_models:
+        log("--all-models applies to CNN primaries only; "
+            "ignored with --model transformer")
     if is_lm and args.decode:
         r = run_decode(args, devices, n_chips, log)
         emit({
@@ -568,65 +696,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
         })
         return
 
-    if args.model == "mnist":
-        model = models.MnistConvNet(dtype=jnp.float32)
-        shape = (1, 28, 28, 1)
-        num_classes = 10
-    elif args.model == "vgg16":
-        model = models.VGG16(num_classes=1000)
-        shape = (1, args.image_size, args.image_size, 3)
-        num_classes = 1000
-    elif args.model == "inception3":
-        model = models.InceptionV3(num_classes=1000)
-        shape = (1, max(args.image_size, 299),
-                 max(args.image_size, 299), 3)
-        num_classes = 1000
-    else:
-        cls = (models.ResNet50 if args.model == "resnet50"
-               else models.ResNet101)
-        model = cls(num_classes=1000)
-        shape = (1, args.image_size, args.image_size, 3)
-        num_classes = 1000
-
-    tx = optax.sgd(0.1, momentum=0.9)
-    rng = jax.random.PRNGKey(0)
-    log("initializing params...")
-    state = init_cnn_state(model, tx, rng,
-                           jnp.zeros(shape, jnp.bfloat16))
-
-    _batches = {}  # per-chip size -> device arrays (fusion sweeps
-    # reuse the same batch; only the batch sweep builds new shapes)
-
-    def make_batch(per_chip):
-        if per_chip not in _batches:
-            gb = per_chip * n_chips
-            x = np.random.RandomState(0).randn(
-                gb, *shape[1:]).astype(np.float32)
-            y = np.random.RandomState(1).randint(
-                0, num_classes, size=(gb,))
-            _batches[per_chip] = (jnp.asarray(x, jnp.bfloat16),
-                                  jnp.asarray(y))
-        return _batches[per_chip]
-
-    def run(threshold, batch=None):
-        step = make_cnn_train_step(model, tx,
-                                   fusion_threshold=threshold,
-                                   remat=args.remat)
-        xb, yb = make_batch(args.batch if batch is None else batch)
-        gb = xb.shape[0]
-        # Fresh state per run: the step donates its input buffers,
-        # so a sweep's second run would otherwise read deleted
-        # arrays.
-        st0 = jax.tree.map(jnp.array, state)
-        st, loss, dt, compile_s = time_steps(
-            step, st0, (xb, yb), rng, args.steps, args.warmup,
-            profile_dir=args.profile)
-        img_s = args.steps * gb / dt
-        log(f"{args.model} thr={threshold} b={gb // n_chips}: "
-            f"{img_s:.1f} img/s ({img_s / n_chips:.1f}/chip, "
-            f"step {dt / args.steps * 1e3:.1f} ms, "
-            f"warmup {compile_s:.1f}s, loss={loss:.3f})")
-        return img_s
+    run = _cnn_bench(args, args.model, args.stem, n_chips)
 
     sweep = batch_sweep = None
     if args.sweep_batch:
@@ -669,17 +739,6 @@ def _bench_body(args, devices, n_chips, metric, unit,
 
     # MFU estimate: analytic training FLOPs over the chip's bf16
     # peak — coarse but honest (stated per VERDICT r1 next-#2).
-    mfu = None
-    peak = PEAK_BF16.get(device_kind)
-    if peak:
-        # Analytic table assumes the canonical resolution; conv
-        # FLOPs scale with pixel count.
-        base = 299 if args.model == "inception3" else 224
-        scale = 1.0 if args.model == "mnist" else \
-            (shape[1] / base) ** 2
-        gflops = TRAIN_GFLOPS_PER_IMG[args.model] * scale
-        mfu = round(img_s_chip * gflops * 1e9 / peak, 4)
-
     result = {
         "metric": metric,
         "value": round(img_s_chip, 2),
@@ -690,7 +749,9 @@ def _bench_body(args, devices, n_chips, metric, unit,
         "device_kind": device_kind,
         "chips": n_chips,
         "per_chip_batch": args.batch,
-        "mfu_estimate": mfu,
+        "stem": args.stem,
+        "mfu_estimate": _cnn_mfu(args.model, run.shape, img_s_chip,
+                                 device_kind),
     }
     if sweep is not None:
         result["sweep_fusion_img_s_per_chip"] = sweep
@@ -700,6 +761,42 @@ def _bench_body(args, devices, n_chips, metric, unit,
         result["flash_attn_ms"] = flash_ms
     if flash_err is not None:
         result["flash_attn_error"] = flash_err
+    if not args.all_models:
+        emit(result)
+        return
+
+    # --all-models (the no-args driver default): one tunnel window
+    # yields every BASELINE.md model (VERDICT r3 next-#7) plus the
+    # s2d-stem variant (next-#2), each as its OWN emitted line so a
+    # late failure can't erase earlier numbers; the final line is the
+    # primary metric again, augmented with the extras, because the
+    # driver parses the LAST line.
+    emit(result)  # primary survives even if an extra dies below
+    extras = {}
+    for name, stem in (("resnet101", "s2d"), ("inception3", "plain"),
+                       ("vgg16", "plain")):
+        if (name, stem) == (args.model, args.stem):
+            continue  # already timed as the primary
+        key = name if stem == "plain" else f"{name}_{stem}"
+        try:
+            r = _cnn_bench(args, name, stem, n_chips)
+            v = r(args.fusion_threshold) / n_chips
+            extras[key] = {
+                "img_s_per_chip": round(v, 2),
+                "mfu_estimate": _cnn_mfu(name, r.shape, v, device_kind),
+            }
+            emit({"metric": f"{key}_images_per_sec_per_chip",
+                  "value": round(v, 2), "unit": unit,
+                  "vs_baseline": None, "platform": platform,
+                  "device_kind": device_kind, "chips": n_chips,
+                  "per_chip_batch": args.batch,
+                  "mfu_estimate": extras[key]["mfu_estimate"]})
+        except Exception as e:  # noqa: BLE001 — keep the artifact
+            if any(t in repr(e) for t in TRANSIENT_ERRORS):
+                raise  # tunnel flake: let main()'s retry loop re-run
+            log(f"all-models extra {key} failed: {e!r}")
+            extras[key] = {"error": repr(e)[:300]}
+    result["models"] = extras
     emit(result)
 
 
