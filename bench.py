@@ -772,12 +772,14 @@ def _bench_body(args, devices, n_chips, metric, unit,
     # primary metric again, augmented with the extras, because the
     # driver parses the LAST line.
     emit(result)  # primary survives even if an extra dies below
+    run = None  # drop the primary's params/opt-state/batches from HBM
     extras = {}
     for name, stem in (("resnet101", "s2d"), ("inception3", "plain"),
                        ("vgg16", "plain")):
         if (name, stem) == (args.model, args.stem):
             continue  # already timed as the primary
         key = name if stem == "plain" else f"{name}_{stem}"
+        r = None
         try:
             r = _cnn_bench(args, name, stem, n_chips)
             v = r(args.fusion_threshold) / n_chips
@@ -796,6 +798,8 @@ def _bench_body(args, devices, n_chips, metric, unit,
                 raise  # tunnel flake: let main()'s retry loop re-run
             log(f"all-models extra {key} failed: {e!r}")
             extras[key] = {"error": repr(e)[:300]}
+        finally:
+            r = None  # free this model's state before the next init
     result["models"] = extras
     emit(result)
 

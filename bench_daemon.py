@@ -96,14 +96,16 @@ def run_config(cfg):
     except subprocess.TimeoutExpired as e:
         # Salvage partial output: bench.py emits one JSON line per
         # completed sub-benchmark, so a timeout mid-suite still
-        # carries every number produced before the hang.
+        # carries every number produced before the hang. Still NOT ok —
+        # the config stays pending so a later window can finish the
+        # suite (partial lines are kept until a full run replaces them).
         stdout = e.stdout or ""
         if isinstance(stdout, bytes):
             stdout = stdout.decode(errors="replace")
         lines = [json.loads(ln) for ln in stdout.splitlines()
                  if ln.strip().startswith("{") and _is_json(ln)]
-        return bool(lines), {
-            "ok": bool(lines), "lines": lines, "error": "timeout",
+        return False, {
+            "ok": False, "lines": lines, "error": "timeout",
             "elapsed_s": round(time.time() - t0, 1),
             "captured_at": datetime.datetime.now(
                 datetime.timezone.utc).isoformat(timespec="seconds")}
@@ -148,12 +150,13 @@ def main():
             datetime.timezone.utc).isoformat(timespec="seconds"),
         "probes": 0, "windows": 0,
     }, "complete": False, "results": {}}
-    # Resume: keep results from an earlier daemon run in this session.
+    # Resume: keep results/attempts from an earlier daemon run.
     if os.path.exists(args.out):
         try:
             with open(args.out) as f:
                 prev = json.load(f)
             state["results"] = prev.get("results", {})
+            state["attempts"] = prev.get("attempts", {})
             state["provenance"]["resumed"] = True
         except Exception:  # noqa: BLE001
             pass
@@ -164,18 +167,33 @@ def main():
             json.dump(state, f, indent=1)
         os.replace(tmp, args.out)
 
+    attempts = state.setdefault("attempts", {})
     deadline = time.time() + args.max_hours * 3600
     flush()
     while time.time() < deadline:
         configs = load_configs(args.configs)
-        pending = [c for c in configs
-                   if not state["results"].get(c["name"], {}).get("ok")]
+        # A config is retried until it succeeds or exhausts its attempt
+        # budget (deterministic failures must not burn the TPU window
+        # in a hot loop); backend_unavailable outcomes don't count as
+        # attempts — the tunnel being down says nothing about the
+        # config.
+        def _done(c):
+            return state["results"].get(c["name"], {}).get("ok")
+
+        exhausted = [c["name"] for c in configs if not _done(c)
+                     and attempts.get(c["name"], 0)
+                     >= c.get("max_attempts", 5)]
+        pending = [c for c in configs if not _done(c)
+                   and c["name"] not in exhausted]
         if not pending:
-            state["complete"] = True
+            state["complete"] = not exhausted
+            if exhausted:
+                state["exhausted"] = exhausted
             state["provenance"]["finished_at"] = datetime.datetime.now(
                 datetime.timezone.utc).isoformat(timespec="seconds")
             flush()
-            log("all configs captured; daemon done")
+            log(f"daemon done (complete={state['complete']}, "
+                f"exhausted={exhausted})")
             return
         state["provenance"]["probes"] += 1
         ok, info = probe_backend(args.probe_timeout)
@@ -192,15 +210,19 @@ def main():
             log(f"running config {cfg['name']}...")
             ok, rec = run_config(cfg)
             state["results"][cfg["name"]] = rec
+            tunnel_down = (not ok and "backend_unavailable"
+                           in str(rec.get("error")))
+            if not tunnel_down:
+                attempts[cfg["name"]] = attempts.get(cfg["name"], 0) + 1
             flush()
             log(f"config {cfg['name']}: "
                 f"{'ok' if ok else 'FAILED (' + str(rec.get('error'))[:120] + ')'} "
                 f"in {rec['elapsed_s']:.0f}s")
-            if not ok and "backend_unavailable" in str(rec.get("error")):
+            if tunnel_down:
                 log("tunnel dropped mid-suite; back to probing")
                 break
-        else:
-            continue
+        # Always pace between sweeps — a deterministically-failing
+        # config must not rerun back-to-back for the whole session.
         time.sleep(args.probe_interval)
     state["provenance"]["finished_at"] = datetime.datetime.now(
         datetime.timezone.utc).isoformat(timespec="seconds")

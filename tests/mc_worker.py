@@ -95,6 +95,37 @@ def main():
     except CollectiveMismatchError:
         pass
 
+    # reducescatter of plain per-process arrays (r4: the last eager API
+    # with a NotImplementedError branch): rank r's shard of the sum.
+    x = np.arange(6, dtype=np.float32) + r  # sum: [1,3,5,7,9,11]
+    got = np.asarray(hvd.reducescatter(x))
+    np.testing.assert_allclose(
+        got, np.array([1, 3, 5, 7, 9, 11], np.float32)[r * 3:(r + 1) * 3])
+    got = np.asarray(hvd.reducescatter(x, average=True))
+    np.testing.assert_allclose(
+        got, (np.arange(6) + 0.5)[r * 3:(r + 1) * 3])
+    # integer dtype stays exact through the duplication correction
+    gi = np.asarray(hvd.reducescatter(np.arange(4, dtype=np.int32) + r))
+    np.testing.assert_array_equal(
+        gi, (2 * np.arange(4) + 1)[r * 2:(r + 1) * 2])
+
+    # alltoall of plain per-process arrays: process p receives slice p
+    # from every process, concatenated.
+    x = np.arange(4, dtype=np.float32) + 10 * r
+    # proc0 sends [0,1|2,3]; proc1 sends [10,11|12,13]
+    got = np.asarray(hvd.alltoall(x))
+    exp = (np.array([0, 1, 10, 11], np.float32) if r == 0
+           else np.array([2, 3, 12, 13], np.float32))
+    np.testing.assert_allclose(got, exp)
+
+    # mismatched reducescatter dtype must raise on every process.
+    try:
+        hvd.reducescatter(
+            np.zeros((4,), np.float32 if r == 0 else np.float64))
+        raise AssertionError("expected reducescatter mismatch error")
+    except CollectiveMismatchError:
+        pass
+
     # SPMD train step with per-process data shards.
     import jax.numpy as jnp
     import optax

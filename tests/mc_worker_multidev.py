@@ -59,6 +59,29 @@ def main():
         np.full((2,), float(r * 5), np.float32), 1))
     np.testing.assert_allclose(got, 5.0)
 
+    # reducescatter with k=2 local devices: the psum_scatter path
+    # (dim0 % size == 0) must correct the k-fold duplication exactly.
+    x = np.arange(8, dtype=np.float32) + r  # sum: 2*arange+1
+    np.testing.assert_allclose(
+        np.asarray(hvd.reducescatter(x)),
+        (2 * np.arange(8) + 1)[r * 4:(r + 1) * 4])
+    # dim0 % nproc == 0 but % size != 0: the psum+slice fallback.
+    x = np.arange(6, dtype=np.float32) + r
+    np.testing.assert_allclose(
+        np.asarray(hvd.reducescatter(x)),
+        (2 * np.arange(6) + 1)[r * 3:(r + 1) * 3])
+    # integer exactness through both paths
+    np.testing.assert_array_equal(
+        np.asarray(hvd.reducescatter(np.arange(4, dtype=np.int32) + r)),
+        (2 * np.arange(4) + 1)[r * 2:(r + 1) * 2])
+
+    # alltoall with k=2 local devices: k parallel one-device-per-
+    # process exchange groups, every local device holds the result.
+    x = np.arange(4, dtype=np.float32) + 10 * r
+    exp = (np.array([0, 1, 10, 11], np.float32) if r == 0
+           else np.array([2, 3, 12, 13], np.float32))
+    np.testing.assert_allclose(np.asarray(hvd.alltoall(x)), exp)
+
     gathered = np.asarray(hvd.allgather(
         np.full((r + 1, 2), float(r), np.float32)))
     assert gathered.shape == (3, 2), gathered.shape

@@ -143,6 +143,35 @@ def test_reducescatter(hvd):
         np.testing.assert_allclose(result[r], summed[r * 3:(r + 1) * 3])
 
 
+def test_alltoall_replicated_and_dim0_contract(hvd):
+    """Plain (replicated) alltoall: row r = size copies of slice r —
+    consistent with reducescatter's replicated convention; non-divisible
+    dim 0 is a clear ValueError (r4: no eager API raises
+    NotImplementedError)."""
+    n = hvd.size()
+    x = np.arange(n * 2, dtype=np.float32)
+    out = np.asarray(hvd.alltoall(x))
+    for r in range(n):
+        np.testing.assert_array_equal(
+            out[r], np.tile(x[r * 2:(r + 1) * 2], n))
+    with pytest.raises(ValueError, match="divisible"):
+        hvd.alltoall(np.zeros((n * 2 + 1,), np.float32))
+    with pytest.raises(ValueError, match="divisible"):
+        hvd.reducescatter(np.zeros((n * 2 + 1,), np.float32))
+
+
+def test_alltoall_reducescatter_mismatch(hvd):
+    """Cross-rank dtype disagreement raises the precondition error on
+    the new PerRank validation of alltoall/reducescatter too."""
+    n = hvd.size()
+    vals = [np.zeros((n * 2,), np.float32 if r == 0 else np.float64)
+            for r in range(n)]
+    with pytest.raises(CollectiveMismatchError):
+        hvd.alltoall(hvd.per_rank(vals))
+    with pytest.raises(CollectiveMismatchError):
+        hvd.reducescatter(hvd.per_rank(vals))
+
+
 # ---- negative tests: coordinator validation parity (mpi_ops_test.py:284+)
 
 def test_allreduce_shape_mismatch(hvd):
