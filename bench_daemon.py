@@ -209,6 +209,14 @@ def main():
         for cfg in pending:
             log(f"running config {cfg['name']}...")
             ok, rec = run_config(cfg)
+            # Never lose salvaged lines to a later, earlier-dying
+            # attempt: keep the richer capture until a better one
+            # replaces it.
+            prev_rec = state["results"].get(cfg["name"], {})
+            if (not ok and len(rec.get("lines") or [])
+                    < len(prev_rec.get("lines") or [])):
+                rec["lines"] = prev_rec["lines"]
+                rec["lines_from"] = prev_rec.get("captured_at")
             state["results"][cfg["name"]] = rec
             tunnel_down = (not ok and "backend_unavailable"
                            in str(rec.get("error")))

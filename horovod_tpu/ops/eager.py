@@ -171,11 +171,18 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
     metas_by_rank = {st.process_rank: meta}  # own request: no round-trip
     pending = [r for r in range(st.num_processes)
                if r != st.process_rank]
-    # Fast path: ONE blocking read per peer (bounded by the stall
-    # threshold), preserving the 2-round-trip-per-op negotiation count;
-    # laggards drop into the poll-and-warn loop below.
+    # Fast path: ONE blocking read per peer, preserving the
+    # 2-round-trip-per-op negotiation count. The TOTAL fast-path
+    # blocking is bounded by the stall threshold (not stall_s per
+    # peer), so the first warning below fires on time even when
+    # several peers are missing; laggards drop into the poll-and-warn
+    # loop.
+    t_fast = _time.time()
     for r in list(pending):
-        budget = min(stall_s, max(0.0, deadline - _time.time()))
+        budget = min(stall_s - (_time.time() - t_fast),
+                     deadline - _time.time())
+        if budget <= 0:
+            break
         v = st.native.kv_get(f"req/{opname}/{cnt}/{r}",
                              timeout_ms=int(budget * 1000))
         if v is not None:
@@ -660,6 +667,10 @@ def alltoall(tensor, name: Optional[str] = None):
                     f"per_rank got {len(vals)} values for world size {st.size}")
             _validate_per_rank(st, opname, "alltoall", vals)
             stacked = np.stack(vals)  # [world, world*chunk, ...]
+            if stacked.shape[1] % st.size:
+                raise ValueError(
+                    f"alltoall dim 0 ({stacked.shape[1]}) must be "
+                    f"divisible by world size {st.size}")
             _timeline(st, opname, "TOP_LEVEL", "ALLTOALL")
 
             def _kernel(x):
@@ -783,7 +794,13 @@ def reducescatter(tensor, average: bool = False, name: Optional[str] = None):
             proc_of_pos, positions = _mc_positions(st)
 
             if scatter_ok:
-                # Wire-optimal: one psum_scatter over the device axis.
+                # One psum_scatter over the device axis. With k > 1
+                # local devices the block still crosses the wire k
+                # times (each duplicate device participates); the
+                # `_mc_mesh2` chunked scheme mc allreduce uses would
+                # shave that and is the follow-up if eager
+                # reducescatter ever becomes hot — eager ops pay a
+                # host round-trip anyway.
                 # psum_scatter hands chunk i to mesh POSITION i, and
                 # positions are not process-contiguous in general, so
                 # the summand's chunks are pre-permuted (sum commutes)

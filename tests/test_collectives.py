@@ -158,6 +158,9 @@ def test_alltoall_replicated_and_dim0_contract(hvd):
         hvd.alltoall(np.zeros((n * 2 + 1,), np.float32))
     with pytest.raises(ValueError, match="divisible"):
         hvd.reducescatter(np.zeros((n * 2 + 1,), np.float32))
+    with pytest.raises(ValueError, match="divisible"):
+        hvd.alltoall(hvd.per_rank(
+            [np.zeros((n * 2 + 1,), np.float32)] * n))
 
 
 def test_alltoall_reducescatter_mismatch(hvd):
