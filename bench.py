@@ -50,6 +50,7 @@ TRANSIENT_ERRORS = ("remote_compile", "read body", "UNAVAILABLE",
 TRAIN_GFLOPS_PER_IMG = {
     "resnet50": 3 * 4.1, "resnet101": 3 * 7.8, "vgg16": 3 * 15.5,
     "inception3": 3 * 5.7, "mnist": 3 * 0.01,
+    "vit": 3 * 17.6,  # ViT-B/16 @224 (Dosovitskiy et al. Table 6)
 }
 # Peak bf16 TFLOP/s by device kind (public TPU specs).
 PEAK_BF16 = {
@@ -352,7 +353,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=None,
                     choices=["resnet50", "resnet101", "vgg16",
-                             "inception3", "mnist", "transformer"],
+                             "inception3", "vit", "mnist",
+                             "transformer"],
                     help="single model to bench; omitted (the driver "
                          "default) = resnet101 plus an --all-models "
                          "pass over the other BASELINE.md models")
@@ -543,6 +545,9 @@ def _make_cnn_model(args, name, stem):
         return (models.InceptionV3(num_classes=1000),
                 (1, max(args.image_size, 299),
                  max(args.image_size, 299), 3), 1000)
+    if name == "vit":
+        return (models.ViT_B16(num_classes=1000),
+                (1, args.image_size, args.image_size, 3), 1000)
     cls = (models.ResNet50 if name == "resnet50" else models.ResNet101)
     return (cls(num_classes=1000, s2d_stem=(stem == "s2d")),
             (1, args.image_size, args.image_size, 3), 1000)
@@ -566,6 +571,13 @@ def _cnn_bench(args, name, stem, n_chips):
     log(f"initializing {name} ({stem} stem) params...")
     state = init_cnn_state(model, tx, rng,
                            jnp.zeros(shape, jnp.bfloat16))
+    # ViT blocks carry TP partition annotations, which need the
+    # full-axes mesh (size-1 defaults) rather than init()'s 1-D mesh.
+    mesh = None
+    if name == "vit":
+        from horovod_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(devices=jax.devices()[:n_chips],
+                         data=n_chips)
 
     _batches = {}  # per-chip size -> device arrays (fusion sweeps
     # reuse the same batch; only the batch sweep builds new shapes)
@@ -583,7 +595,7 @@ def _cnn_bench(args, name, stem, n_chips):
 
     def run(threshold, batch=None):
         steps = args.steps
-        step = make_cnn_train_step(model, tx,
+        step = make_cnn_train_step(model, tx, mesh=mesh,
                                    fusion_threshold=threshold,
                                    remat=args.remat)
         xb, yb = make_batch(args.batch if batch is None else batch)

@@ -216,7 +216,8 @@ def main():
             if (not ok and len(rec.get("lines") or [])
                     < len(prev_rec.get("lines") or [])):
                 rec["lines"] = prev_rec["lines"]
-                rec["lines_from"] = prev_rec.get("captured_at")
+                rec["lines_from"] = (prev_rec.get("lines_from")
+                                     or prev_rec.get("captured_at"))
             state["results"][cfg["name"]] = rec
             tunnel_down = (not ok and "backend_unavailable"
                            in str(rec.get("error")))

@@ -13,6 +13,7 @@ from horovod_tpu.models.resnet import ResNet, ResNet50, ResNet101, ResNet152
 from horovod_tpu.models.vgg import VGG16
 from horovod_tpu.models.inception import InceptionV3
 from horovod_tpu.models.word2vec import Word2Vec
+from horovod_tpu.models.vit import VisionTransformer, ViT_B16, ViT_S16
 from horovod_tpu.models.train import make_cnn_train_step
 from horovod_tpu.models.transformer import (
     TransformerLM, generate, init_lm_state, lm_fsdp_specs,
@@ -21,7 +22,8 @@ from horovod_tpu.models.transformer import (
 
 __all__ = [
     "MnistConvNet", "ResNet", "ResNet50", "ResNet101", "ResNet152",
-    "VGG16", "InceptionV3", "Word2Vec", "make_cnn_train_step",
+    "VGG16", "InceptionV3", "Word2Vec", "VisionTransformer",
+    "ViT_B16", "ViT_S16", "make_cnn_train_step",
     "TransformerLM", "generate", "init_lm_state", "lm_fsdp_specs",
     "make_lm_eval_step", "make_lm_train_step",
 ]

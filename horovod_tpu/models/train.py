@@ -95,7 +95,11 @@ def init_cnn_state(model, tx: optax.GradientTransformation, rng,
     individually, which takes minutes for Inception-sized models."""
     variables = jax.jit(lambda r, x: model.init(r, x, train=False))(
         rng, sample_input)
-    params = variables["params"]
+    # Strip nn.Partitioned boxes (TP-annotated models like ViT): the
+    # train step passes plain arrays through apply, same as the LM
+    # path; CNN models without annotations are untouched.
+    from horovod_tpu.parallel.tensor import unbox
+    params = unbox(variables["params"])
     batch_stats = variables.get("batch_stats", {})
     return {"params": params, "batch_stats": batch_stats,
             "opt_state": tx.init(params)}

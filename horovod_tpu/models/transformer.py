@@ -157,18 +157,29 @@ class TransformerBlock(nn.Module):
     moe_capacity_factor: float = 1.25
     decode: bool = False
     chunked_prefill: bool = False   # see ParallelSelfAttention
+    causal: bool = True     # False = bidirectional (encoder / ViT)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         d = x.shape[-1]
+        if self.window is not None and not self.causal:
+            # Every masked impl raises this from inside its scan; the
+            # dot baseline would silently drop the window instead —
+            # make the contract uniform and early.
+            raise ValueError(
+                "window (sliding-window attention) requires "
+                "causal=True; bidirectional windowed attention is not "
+                "implemented")
         # Decode ticks (S=1) attend against the KV cache inside the
         # attention module; the attn_fn (flash/ring/...) is used by the
         # ONE-PASS PREFILL (S>1 from an empty cache), which is plain
         # causal attention over the prompt block — flash-able.
-        attn_fn = make_attn_fn(self.attn_impl, window=self.window)
+        attn_fn = make_attn_fn(self.attn_impl, causal=self.causal,
+                               window=self.window)
         mask = None
-        if attn_fn is None and not self.decode:
+        if attn_fn is None and not self.decode and self.causal:
             # dot baseline materializes the banded causal mask
+            # (bidirectional attention = no mask at all)
             S = x.shape[-2]
             pos = jnp.arange(S)
             mask = banded_causal_mask(pos, pos, self.window)[None, None]

@@ -190,6 +190,17 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
             pending.remove(r)
     warned = False
     while pending:
+        # Sweep BEFORE diagnosing: one slow peer exhausting the shared
+        # fast-path budget must not get healthy already-posted peers
+        # misreported as missing in the warning below.
+        for r in list(pending):
+            v = st.native.kv_get(f"req/{opname}/{cnt}/{r}",
+                                 timeout_ms=2000)
+            if v is not None:
+                metas_by_rank[r] = json.loads(v.decode())
+                pending.remove(r)
+        if not pending:
+            break
         if not warned:
             # The reference's ready-ranks diagnostic
             # (CheckForStalledTensors, mpi_ops.cc:1150-1193): name the
@@ -215,12 +226,6 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
                 f"(ready: {sorted(metas_by_rank)})")
             publish_error(exc)
             raise exc
-        for r in list(pending):
-            v = st.native.kv_get(f"req/{opname}/{cnt}/{r}",
-                                 timeout_ms=2000)
-            if v is not None:
-                metas_by_rank[r] = json.loads(v.decode())
-                pending.remove(r)
     metas = [metas_by_rank[r] for r in range(st.num_processes)]
     # Uniform-ownership check on the *exchanged* counts: uneven device
     # ownership would make the duplication corrections in the mc
