@@ -618,6 +618,27 @@ def _cnn_bench(args, name, stem, n_chips):
     return run
 
 
+def _measured_overlap(args):
+    """Measured exposed-collective fraction α from the --profile trace
+    (utils/profile_analysis) — None off-profile or when the capture has
+    no device timeline (CPU backend). Replaces docs/scaling.md's
+    modeled α=0.3 with a measurement whenever a profiled run lands."""
+    if not args.profile:
+        return None
+    from horovod_tpu.utils.profile_analysis import analyze_profile_dir
+    try:
+        r = analyze_profile_dir(args.profile)
+    except Exception as e:  # noqa: BLE001 — diagnostics must not kill
+        log(f"overlap analysis failed: {e!r}")
+        return None
+    if r is not None:
+        log(f"measured overlap: alpha={r['alpha']} "
+            f"(comm {r['t_comm_us']}us, exposed "
+            f"{r['t_comm_exposed_us']}us over {r['n_collectives']} "
+            f"collectives)")
+    return r
+
+
 def _cnn_mfu(name, shape, img_s_chip, device_kind):
     """Analytic-FLOPs MFU estimate (coarse but honest; docs/mfu.md)."""
     peak = PEAK_BF16.get(device_kind)
@@ -684,6 +705,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "params_m": round(r["n_params"] / 1e6, 1),
             "ms_per_tick": round(r["ms_per_tick"], 2),
             "decode_steps": args.decode_steps,
+            "overlap_measured": _measured_overlap(args),
         })
         return
     if is_lm:
@@ -705,6 +727,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "mfu_estimate": round(
                 r["tok_s_chip"] * r["flops_per_tok"] / peak, 4)
             if peak else None,
+            "overlap_measured": _measured_overlap(args),
         })
         return
 
@@ -764,6 +787,13 @@ def _bench_body(args, devices, n_chips, metric, unit,
         "stem": args.stem,
         "mfu_estimate": _cnn_mfu(args.model, run.shape, img_s_chip,
                                  device_kind),
+        # Sweeps write one trace per configuration and the newest need
+        # not be the headline config — an alpha from a different fusion
+        # threshold/batch would misattribute, so only the single-config
+        # run reports it.
+        "overlap_measured": (
+            None if (args.sweep_fusion or args.sweep_batch)
+            else _measured_overlap(args)),
     }
     if sweep is not None:
         result["sweep_fusion_img_s_per_chip"] = sweep

@@ -190,12 +190,14 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
             pending.remove(r)
     warned = False
     while pending:
-        # Sweep BEFORE diagnosing: one slow peer exhausting the shared
-        # fast-path budget must not get healthy already-posted peers
-        # misreported as missing in the warning below.
+        # NON-BLOCKING sweep BEFORE diagnosing (timeout_ms=0: the KV
+        # server's wait_for(0) checks the predicate immediately): one
+        # slow peer exhausting the shared fast-path budget must not get
+        # healthy already-posted peers misreported as missing — and the
+        # sweep must not itself delay the warning by 2s per dead peer.
         for r in list(pending):
             v = st.native.kv_get(f"req/{opname}/{cnt}/{r}",
-                                 timeout_ms=2000)
+                                 timeout_ms=0)
             if v is not None:
                 metas_by_rank[r] = json.loads(v.decode())
                 pending.remove(r)
@@ -226,6 +228,14 @@ def _mc_negotiate(st, opname: str, op: str, arr: np.ndarray,
                 f"(ready: {sorted(metas_by_rank)})")
             publish_error(exc)
             raise exc
+        # Paced blocking poll between sweeps (bounded per peer so the
+        # deadline check above stays roughly honest).
+        for r in list(pending):
+            v = st.native.kv_get(f"req/{opname}/{cnt}/{r}",
+                                 timeout_ms=2000)
+            if v is not None:
+                metas_by_rank[r] = json.loads(v.decode())
+                pending.remove(r)
     metas = [metas_by_rank[r] for r in range(st.num_processes)]
     # Uniform-ownership check on the *exchanged* counts: uneven device
     # ownership would make the duplication corrections in the mc
