@@ -363,7 +363,7 @@ def main():
                          "resnet101+s2d, inception3, vgg16 (each "
                          "failure-isolated; one JSON line per model)")
     ap.add_argument("--stem", default="plain", choices=["plain", "s2d"],
-                    help="resnet stem: plain 7x7/s2 conv or the "
+                    help="resnet/inception stem: plain conv or the "
                          "numerically-identical space-to-depth re-pack "
                          "(MXU-friendly; docs/mfu.md culprit #1)")
     ap.add_argument("--batch", type=int, default=None,
@@ -542,7 +542,8 @@ def _make_cnn_model(args, name, stem):
         return (models.VGG16(num_classes=1000),
                 (1, args.image_size, args.image_size, 3), 1000)
     if name == "inception3":
-        return (models.InceptionV3(num_classes=1000),
+        return (models.InceptionV3(num_classes=1000,
+                                   s2d_stem=(stem == "s2d")),
                 (1, max(args.image_size, 299),
                  max(args.image_size, 299), 3), 1000)
     if name == "vit":
@@ -622,12 +623,16 @@ def _measured_overlap(args):
     """Measured exposed-collective fraction α from the --profile trace
     (utils/profile_analysis) — None off-profile or when the capture has
     no device timeline (CPU backend). Replaces docs/scaling.md's
-    modeled α=0.3 with a measurement whenever a profiled run lands."""
+    modeled α=0.3 with a measurement whenever a profiled run lands.
+    Bounded to traces written by THIS invocation (`_bench_t0`): a
+    reused profile dir must not hand back yesterday's capture."""
     if not args.profile:
         return None
     from horovod_tpu.utils.profile_analysis import analyze_profile_dir
     try:
-        r = analyze_profile_dir(args.profile)
+        r = analyze_profile_dir(args.profile,
+                                min_mtime=getattr(args, "_bench_t0",
+                                                  None))
     except Exception as e:  # noqa: BLE001 — diagnostics must not kill
         log(f"overlap analysis failed: {e!r}")
         return None
@@ -652,6 +657,7 @@ def _cnn_mfu(name, shape, img_s_chip, device_kind):
 
 def _bench_body(args, devices, n_chips, metric, unit,
                 platform, device_kind):
+    args._bench_t0 = time.time()  # staleness bound for --profile traces
     import jax
     import jax.numpy as jnp
     import numpy as np

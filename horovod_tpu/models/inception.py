@@ -19,6 +19,52 @@ from typing import Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
+
+
+class _S2DStemConv(nn.Module):
+    """The 3×3/s2/VALID stem conv computed space-to-depth — the
+    Inception analogue of `resnet.SpaceToDepthStem` (docs/mfu.md
+    culprit #1: a C_in=3 contraction fills ~3/128 MXU lanes).
+
+    Cleaner than ResNet's 7×7 case because stride (2) equals the s2d
+    block: pad the image so the width is `2·(out+1)`, space-to-depth by
+    2 ([N,H',W',C] → [N,H'/2,W'/2,4C]), and convolve with the SAME
+    [3,3,C,F] parameter re-packed into [2,2,4C,F] (zero-pad the kernel
+    to 4×4 first; tap (2U+du, 2V+dv) lands at s2d position (U,V),
+    channel (du·2+dv)·C+c), stride 1, VALID — no depth-to-space needed.
+    Extra zero pad columns multiply zeros in both formulations, so the
+    equality is exact. Declares the same `kernel` parameter as nn.Conv
+    under the same name, so `s2d_stem` stays a pure compute-path flag.
+    """
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        N, H, W, C = x.shape
+        F = self.features
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (3, 3, C, F))
+        from horovod_tpu.models.resnet import _space_to_depth
+        out_h = (H - 3) // 2 + 1
+        out_w = (W - 3) // 2 + 1
+        x = jnp.pad(x, ((0, 0), (0, 2 * (out_h + 1) - H),
+                        (0, 2 * (out_w + 1) - W), (0, 0)))
+        # Shared packing convention with the ResNet stem — the kernel
+        # re-pack below depends on exactly this (row, col, channel)
+        # order.
+        x = _space_to_depth(x, 2).astype(self.dtype)
+
+        k = kernel.astype(self.dtype)
+        k4 = jnp.zeros((4, 4, C, F), k.dtype).at[:3, :3].set(k)
+        w = (k4.reshape(2, 2, 2, 2, C, F)
+             .transpose(0, 2, 1, 3, 4, 5).reshape(2, 2, 4 * C, F))
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        assert y.shape[1:3] == (out_h, out_w), y.shape
+        return y
 
 
 class ConvBN(nn.Module):
@@ -28,12 +74,21 @@ class ConvBN(nn.Module):
     padding: str = "SAME"
     dtype: jnp.dtype = jnp.bfloat16
     train: bool = False
+    s2d: bool = False   # stem-conv-only: see _S2DStemConv
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(self.features, self.kernel, self.strides,
-                    padding=self.padding, use_bias=False,
-                    dtype=self.dtype)(x)
+        if self.s2d:
+            if (self.kernel, self.strides, self.padding) != (
+                    (3, 3), (2, 2), "VALID"):
+                raise ValueError(
+                    "s2d applies to the 3x3/s2/VALID stem conv only")
+            x = _S2DStemConv(self.features, dtype=self.dtype,
+                             name="Conv_0")(x)
+        else:
+            x = nn.Conv(self.features, self.kernel, self.strides,
+                        padding=self.padding, use_bias=False,
+                        dtype=self.dtype, name="Conv_0")(x)
         x = nn.BatchNorm(use_running_average=not self.train,
                          momentum=0.9, epsilon=1e-3, dtype=self.dtype)(x)
         return nn.relu(x)
@@ -42,13 +97,16 @@ class ConvBN(nn.Module):
 class InceptionV3(nn.Module):
     num_classes: int = 1000
     dtype: jnp.dtype = jnp.bfloat16
+    # MXU-friendly stem conv0 (same params, same outputs): _S2DStemConv
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         conv = partial(ConvBN, dtype=self.dtype, train=train)
         x = x.astype(self.dtype)
         # Stem: 299x299x3 -> 35x35x192
-        x = conv(32, (3, 3), (2, 2), padding="VALID")(x)
+        x = conv(32, (3, 3), (2, 2), padding="VALID",
+                 s2d=self.s2d_stem)(x)
         x = conv(32, (3, 3), padding="VALID")(x)
         x = conv(64, (3, 3))(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))

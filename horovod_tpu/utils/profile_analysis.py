@@ -41,20 +41,30 @@ _COLLECTIVE_RE = re.compile(
     r"(-start|-done)?(\.|$|-)", re.IGNORECASE)
 
 
-def find_trace_file(profile_dir: str) -> Optional[str]:
-    """Newest `*.trace.json.gz` under a jax.profiler trace directory."""
-    paths = glob.glob(os.path.join(
-        profile_dir, "**", "*.trace.json.gz"), recursive=True)
+def find_trace_file(profile_dir: str,
+                    min_mtime: Optional[float] = None) -> Optional[str]:
+    """Newest `*.trace.json.gz` under a jax.profiler trace directory.
+
+    `min_mtime` guards against a REUSED profile dir: each capture
+    writes a new timestamped subdir and old ones are never cleaned, so
+    without the bound a failed serialization would silently hand back a
+    previous run's trace as this run's measurement."""
+    paths = [p for p in glob.glob(
+        os.path.join(profile_dir, "**", "*.trace.json.gz"),
+        recursive=True)
+        if min_mtime is None or os.path.getmtime(p) >= min_mtime]
     return max(paths, key=os.path.getmtime) if paths else None
 
 
-def load_trace(profile_dir_or_file: str) -> Dict[str, Any]:
+def load_trace(profile_dir_or_file: str,
+               min_mtime: Optional[float] = None) -> Dict[str, Any]:
     path = profile_dir_or_file
     if os.path.isdir(path):
-        found = find_trace_file(path)
+        found = find_trace_file(path, min_mtime=min_mtime)
         if found is None:
             raise FileNotFoundError(
-                f"no *.trace.json.gz under {path!r}")
+                f"no *.trace.json.gz under {path!r}"
+                + (" (newer than min_mtime)" if min_mtime else ""))
         path = found
     with gzip.open(path, "rt") as f:
         return json.load(f)
@@ -95,8 +105,8 @@ def analyze_overlap(trace: Dict[str, Any],
     substring); by default anything naming a TPU / device / accelerator
     that is not the host.
     """
-    events = trace.get("traceEvents", trace if isinstance(trace, list)
-                       else [])
+    events = (trace if isinstance(trace, list)
+              else trace.get("traceEvents", []))
     proc_names: Dict[Any, str] = {}
     for e in events:
         if e.get("ph") == "M" and e.get("name") == "process_name":
@@ -116,7 +126,10 @@ def analyze_overlap(trace: Dict[str, Any],
 
     from collections import defaultdict, deque
 
-    comm_windows: List[Tuple[float, float]] = []
+    # (label, window) per collective — labels feed the top-exposed
+    # report, windows the headline numbers, so both rank by the same
+    # start→done extent.
+    comm: List[Tuple[str, Tuple[float, float]]] = []
     compute: List[Tuple[float, float]] = []
     # Per-occurrence FIFO pairing: a profiled run repeats each HLO op
     # once per step under the SAME name, so start/done must pair in
@@ -138,17 +151,19 @@ def analyze_overlap(trace: Dict[str, Any],
             continue
         kind = m.group(2)
         if kind == "-start":
-            start_q[name.replace("-start", "-done", 1)].append(iv)
+            start_q[name.replace("-start", "-done", 1)].append(
+                (name, iv))
         elif kind == "-done":
             q = start_q.get(name)
-            siv = q.popleft() if q else None
+            _, siv = q.popleft() if q else (None, None)
             # Async window = issue of start → retire of done; a done
             # with no matched start falls back to its own extent.
-            comm_windows.append((siv[0] if siv else iv[0], iv[1]))
+            comm.append((name, (siv[0] if siv else iv[0], iv[1])))
         else:
-            comm_windows.append(iv)       # sync collective
+            comm.append((name, iv))       # sync collective
     for q in start_q.values():            # starts with no done
-        comm_windows.extend(q)
+        comm.extend(q)
+    comm_windows = [w for _, w in comm]
     if not comm_windows:
         return {"alpha": None, "t_comm_us": 0.0, "t_comm_exposed_us": 0.0,
                 "t_compute_us": round(sum(e - s for s, e in
@@ -160,18 +175,13 @@ def analyze_overlap(trace: Dict[str, Any],
     t_comm = sum(e - s for s, e in merged_comm)
     exposed = sum((e - s) - _covered((s, e), compute_union)
                   for s, e in merged_comm)
-    # Per-window attribution for the top offenders (un-merged, so
-    # overlapping windows may double-count individually — the headline
-    # numbers above use the merged union).
-    per_op: List[Tuple[str, float]] = []
-    for e in dev_events:
-        name = e.get("name", "")
-        m = _COLLECTIVE_RE.match(name)
-        if m and m.group(2) != "-start":
-            iv = (float(e["ts"]), float(e["ts"]) + float(e["dur"]))
-            per_op.append(
-                (name, (iv[1] - iv[0]) - _covered(iv, compute_union)))
-    per_op.sort(key=lambda kv: -kv[1])
+    # Per-window attribution for the top offenders, from the SAME
+    # paired start→done windows as the headline numbers (un-merged, so
+    # overlapping windows may double-count individually).
+    per_op = sorted(
+        ((name, (w[1] - w[0]) - _covered(w, compute_union))
+         for name, w in comm),
+        key=lambda kv: -kv[1])
 
     return {
         "alpha": round(exposed / t_comm, 4) if t_comm else None,
@@ -186,11 +196,14 @@ def analyze_overlap(trace: Dict[str, Any],
     }
 
 
-def analyze_profile_dir(profile_dir: str) -> Optional[Dict[str, Any]]:
-    """Convenience: load the newest trace under `profile_dir` and
-    analyze; None when there is no trace or no device timeline."""
+def analyze_profile_dir(profile_dir: str,
+                        min_mtime: Optional[float] = None
+                        ) -> Optional[Dict[str, Any]]:
+    """Convenience: load the newest trace under `profile_dir` (written
+    at or after `min_mtime`, when given) and analyze; None when there
+    is no (fresh enough) trace or no device timeline."""
     try:
-        trace = load_trace(profile_dir)
+        trace = load_trace(profile_dir, min_mtime=min_mtime)
     except (FileNotFoundError, OSError, ValueError):
         return None
     return analyze_overlap(trace)

@@ -60,6 +60,24 @@ def test_s2d_stem_matches_plain_stem(hvd):
         s2d.apply(vars_, jnp.zeros((1, 30, 30, 3)), train=False)
 
 
+@pytest.mark.parametrize("hw", [75, 64])  # odd (pad 1) and even (pad 0)
+def test_inception_s2d_stem_matches_plain(hvd, hw):
+    """Inception stem-conv0 space-to-depth re-pack: same parameter
+    tree, same outputs as the plain 3x3/s2/VALID conv, fp32 exact."""
+    from horovod_tpu.models import InceptionV3
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(1, hw, hw, 3), jnp.float32)
+    plain = InceptionV3(num_classes=10, dtype=jnp.float32)
+    s2d = InceptionV3(num_classes=10, dtype=jnp.float32, s2d_stem=True)
+    vars_ = plain.init(jax.random.PRNGKey(0), x, train=False)
+    assert (jax.tree.structure(vars_) == jax.tree.structure(
+        s2d.init(jax.random.PRNGKey(1), x, train=False)))
+    a = plain.apply(vars_, x, train=False)
+    b = s2d.apply(vars_, x, train=False)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_vgg16_forward(hvd):
     from horovod_tpu.models import VGG16
     m = VGG16(num_classes=10, dtype=jnp.float32)
