@@ -21,6 +21,8 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.models.resnet import space_to_depth
+
 
 class _S2DStemConv(nn.Module):
     """The 3×3/s2/VALID stem conv computed space-to-depth — the
@@ -46,7 +48,6 @@ class _S2DStemConv(nn.Module):
         F = self.features
         kernel = self.param("kernel", nn.initializers.lecun_normal(),
                             (3, 3, C, F))
-        from horovod_tpu.models.resnet import _space_to_depth
         out_h = (H - 3) // 2 + 1
         out_w = (W - 3) // 2 + 1
         x = jnp.pad(x, ((0, 0), (0, 2 * (out_h + 1) - H),
@@ -54,7 +55,7 @@ class _S2DStemConv(nn.Module):
         # Shared packing convention with the ResNet stem — the kernel
         # re-pack below depends on exactly this (row, col, channel)
         # order.
-        x = _space_to_depth(x, 2).astype(self.dtype)
+        x = space_to_depth(x, 2).astype(self.dtype)
 
         k = kernel.astype(self.dtype)
         k4 = jnp.zeros((4, 4, C, F), k.dtype).at[:3, :3].set(k)

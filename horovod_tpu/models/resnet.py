@@ -27,10 +27,11 @@ from jax import lax
 ModuleDef = Any
 
 
-def _space_to_depth(x, b):
+def space_to_depth(x, b):
     """[N, H, W, C] -> [N, H/b, W/b, b*b*C]; channel packing is
-    (row-in-block, col-in-block, channel), matching the kernel
-    re-pack in `SpaceToDepthStem`."""
+    (row-in-block, col-in-block, channel) — the convention the kernel
+    re-packs in `SpaceToDepthStem` and `inception._S2DStemConv` depend
+    on (shared helper, public on purpose)."""
     N, H, W, C = x.shape
     x = x.reshape(N, H // b, b, W // b, b, C)
     return x.transpose(0, 1, 3, 2, 4, 5).reshape(
@@ -79,7 +80,7 @@ class SpaceToDepthStem(nn.Module):
         kernel = self.param("kernel", nn.initializers.lecun_normal(),
                             (7, 7, C, F))
         x = jnp.pad(x, ((0, 0), (2, 6), (2, 6), (0, 0)))
-        x = _space_to_depth(x, 4).astype(self.dtype)
+        x = space_to_depth(x, 4).astype(self.dtype)
 
         k = kernel.astype(self.dtype)
         taps = []

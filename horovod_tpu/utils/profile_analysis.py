@@ -48,11 +48,13 @@ def find_trace_file(profile_dir: str,
     `min_mtime` guards against a REUSED profile dir: each capture
     writes a new timestamped subdir and old ones are never cleaned, so
     without the bound a failed serialization would silently hand back a
-    previous run's trace as this run's measurement."""
+    previous run's trace as this run's measurement. 2s of slack
+    tolerates coarse-mtime filesystems / slight clock skew without
+    readmitting day-old captures."""
     paths = [p for p in glob.glob(
         os.path.join(profile_dir, "**", "*.trace.json.gz"),
         recursive=True)
-        if min_mtime is None or os.path.getmtime(p) >= min_mtime]
+        if min_mtime is None or os.path.getmtime(p) >= min_mtime - 2.0]
     return max(paths, key=os.path.getmtime) if paths else None
 
 

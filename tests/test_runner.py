@@ -117,6 +117,7 @@ def test_hvdrun_rejects_misconfigured_multihost():
 
 
 @pytest.mark.parametrize("example", ["examples/jax_mnist.py",
+                                     "examples/jax_vit.py",
                                      "examples/torch_mnist.py"])
 def test_examples_under_launcher(example):
     """The canonical 5-line-change examples run to completion at np=2
