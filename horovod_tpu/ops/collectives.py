@@ -132,4 +132,7 @@ def my_rank(axis_name: str = "data") -> jax.Array:
 
 
 def axis_size(axis_name: str = "data") -> jax.Array:
-    return lax.psum(1, axis_name)
+    """Size of a bound mesh axis (the SPMD `hvd.size()`); delegates to
+    the single version-insulated implementation in `parallel.mesh`."""
+    from horovod_tpu.parallel.mesh import axis_size as _axis_size
+    return _axis_size(axis_name)

@@ -33,7 +33,9 @@ from horovod_tpu.parallel.mesh import (
     AXIS_EXPERT,
 )
 from horovod_tpu.parallel.tensor import (
+    allgather_matmul,
     column_parallel_matmul,
+    matmul_reducescatter,
     row_parallel_matmul,
     ColumnParallelDense,
     RowParallelDense,
@@ -74,6 +76,7 @@ __all__ = [
     "replicate", "constrain", "use_mesh",
     "AXIS_DATA", "AXIS_SEQ", "AXIS_MODEL", "AXIS_PIPE", "AXIS_EXPERT",
     "column_parallel_matmul", "row_parallel_matmul",
+    "allgather_matmul", "matmul_reducescatter",
     "ColumnParallelDense", "RowParallelDense", "ParallelMLP",
     "ParallelSelfAttention", "apply_rope", "dot_product_attention",
     "param_specs", "shard_params", "unbox",

@@ -103,6 +103,27 @@ def mesh_axis_names(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)
 
 
+def axis_size(axis_name: str) -> int:
+    """Static size of a bound mesh axis (version-insulated:
+    `lax.axis_size` is jax ≥ 0.8)."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except (AttributeError, NameError):  # pragma: no cover
+        return jax.lax.psum(1, axis_name)
+
+
+def ring_perms(axis_name: str):
+    """(forward, backward) `ppermute` permutations for the axis ring —
+    the neighbor-exchange pattern every ring schedule here uses (ring
+    attention K/V rotation, pipeline stage hand-off, collective
+    matmuls). Single site so a topology-aware neighbor order only ever
+    needs to land once."""
+    n = axis_size(axis_name)
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    return fwd, bwd
+
+
 def use(mesh: Mesh):
     """Context manager installing `mesh` as the ambient mesh for
     P(...)-spec sharding constraints (insulates the jax API rename:

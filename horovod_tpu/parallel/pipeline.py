@@ -43,15 +43,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from horovod_tpu.parallel.mesh import AXIS_DATA, AXIS_PIPE
-
-
-def _axis_size(axis_name: str) -> int:
-    """Static size of a bound mesh axis."""
-    try:
-        return jax.lax.axis_size(axis_name)  # jax >= 0.8
-    except (AttributeError, NameError):
-        return lax.psum(1, axis_name)
+from horovod_tpu.parallel.mesh import (AXIS_DATA, AXIS_PIPE,
+                                       axis_size, ring_perms)
 
 
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -94,7 +87,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     Returns:
       [M, mb, ...] final-stage outputs, replicated across ``pipe``.
     """
-    nstages = _axis_size(axis_name)
+    nstages = axis_size(axis_name)
     v = int(num_chunks)
     idx = lax.axis_index(axis_name)
     M = microbatches.shape[0]
@@ -105,7 +98,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
             f"interleaved schedule needs microbatches % pipe == 0 "
             f"(got M={M}, P={nstages}); pad the microbatch stack")
     ticks = v * M + nstages - 1
-    fwd = [(i, (i + 1) % nstages) for i in range(nstages)]
+    fwd, _ = ring_perms(axis_name)
     group = v * nstages  # work-items per P-microbatch group
 
     def _apply(params, c, x):

@@ -37,7 +37,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from horovod_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ
+from horovod_tpu.parallel.mesh import (AXIS_DATA, AXIS_MODEL,
+                                       AXIS_SEQ, ring_perms)
 
 
 def _online_block(carry, q, k, v, logit_bias):
@@ -227,7 +228,7 @@ def _ring_attention_flash(q, k, v, *, axis_name, causal, window):
             o_d, lse_d = partial()
         o_acc, lse_acc = _merge_partials(o_acc, lse_acc, o_d, lse_d)
         if d < sp - 1:
-            perm = [(i, (i + 1) % sp) for i in range(sp)]
+            perm, _ = ring_perms(axis_name)
             kc = lax.ppermute(kc, axis_name, perm)
             vc = lax.ppermute(vc, axis_name, perm)
     return o_acc.astype(q.dtype)
@@ -279,7 +280,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     def body(carry, step):
         o, m, l, kc, vc = carry
         o, m, l = block((o, m, l), kc, vc, step)
-        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        perm, _ = ring_perms(axis_name)
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
         return (o, m, l, kc, vc), None

@@ -29,7 +29,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import flax.linen as nn
 
 from horovod_tpu.parallel.mesh import (
-    AXIS_DATA, AXIS_MODEL, AXIS_SEQ, UNCONSTRAINED, constrain,
+    AXIS_DATA, AXIS_MODEL, AXIS_SEQ, UNCONSTRAINED, axis_size,
+    constrain, ring_perms,
 )
 from horovod_tpu.parallel.sequence import banded_causal_mask
 
@@ -62,6 +63,102 @@ def row_parallel_matmul(x_shard: jax.Array, w_shard: jax.Array,
     """`psum_tp(x[:, shard] @ W[shard, :])` — the one all-reduce of a
     column→row parallel pair (Megatron's `g` operator)."""
     return lax.psum(x_shard @ w_shard, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Latency-hiding collective matmuls (ring-overlapped AG/RS forms).
+#
+# The sequence-parallel Megatron layout turns the TP pair's all-reduce
+# into all-gather (before the column matmul) + reduce-scatter (after the
+# row matmul). Issued as monolithic collectives those serialize against
+# the MXU; the ring-overlapped forms below interleave one `ppermute`
+# hop with one shard-sized matmul per step, so on TPU the async
+# collective-permute rides the ICI links WHILE the previous shard's
+# matmul occupies the MXU — compute hides all but the first hop of
+# comm ("collective matmul", Wang et al. ASPLOS'23; the same overlap
+# XLA's `--xla_tpu_enable_async_collective_fusion`-era einsum rewrites
+# perform inside GSPMD, here available to explicit shard_map code).
+# The all-gather form rotates two streams in opposite directions, using
+# both directions of each ICI link — N/2 steps instead of N-1.
+# Both are plain jax primitives, so they are differentiable and the
+# oracle tests pin equality (fwd and grad) against the monolithic forms.
+# ---------------------------------------------------------------------------
+
+def allgather_matmul(x_shard: jax.Array, w: jax.Array,
+                     axis_name: str = AXIS_MODEL) -> jax.Array:
+    """`all_gather(x_shard, tiled) @ w`, comm overlapped with compute.
+
+    ``x_shard`` [s, K] is this device's row block of a [N*s, K] input
+    (e.g. sequence-parallel activations entering a column-parallel
+    matmul); ``w`` [K, F] is resident (replicated or a column shard).
+    Returns the full [N*s, F] product, bit-ordered by source rank,
+    without ever materializing the gathered [N*s, K] input: each step
+    matmuls the shard in hand while the next shards arrive over both
+    ring directions.
+    """
+    n = axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    s = x_shard.shape[0]
+    fwd, bwd = ring_perms(axis_name)
+
+    def put(out, block, src):
+        z = jnp.zeros((), idx.dtype)
+        return lax.dynamic_update_slice(
+            out, block, (src * s,) + (z,) * (block.ndim - 1))
+
+    # Own shard first: its matmul overlaps the first hop of both rings.
+    own = x_shard @ w
+    out = jnp.zeros((n * s, *own.shape[1:]), own.dtype)
+    out = put(out, own, idx)
+    hi, lo = x_shard, x_shard
+    for step in range(1, n // 2 + 1):
+        # After `step` hops: `hi` holds rank (idx - step)'s shard
+        # (travelling forward), `lo` holds rank (idx + step)'s.
+        hi = lax.ppermute(hi, axis_name, fwd)
+        last = (step == n // 2) and (n % 2 == 0)
+        if not last:
+            lo = lax.ppermute(lo, axis_name, bwd)
+        out = put(out, hi @ w, (idx - step) % n)
+        # The two streams deliver the same shard only when 2·step ≡ 0
+        # (mod n), i.e. the even-N half-way step — exactly `last`.
+        if not last:
+            out = put(out, lo @ w, (idx + step) % n)
+    return out
+
+
+def matmul_reducescatter(x: jax.Array, w_shard: jax.Array,
+                         axis_name: str = AXIS_MODEL) -> jax.Array:
+    """`psum_scatter(x @ w_shard, tiled)` — the row-parallel epilogue of
+    the sequence-parallel pair — with each partial block's matmul
+    computed just-in-time as its accumulator rides the ring.
+
+    ``x`` [R, Ks] holds this device's contraction shard of the input
+    (R divisible by N); ``w_shard`` [Ks, F] the matching row block of
+    W. Returns this rank's [R/N, F] block of the reduced product: the
+    step-t matmul of one [R/N, Ks] x-block overlaps the ppermute of the
+    accumulator computed at step t-1.
+    """
+    n = axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    if x.shape[0] % n:
+        raise ValueError(
+            f"leading dim {x.shape[0]} not divisible by axis size {n}")
+    c = x.shape[0] // n
+    fwd, _ = ring_perms(axis_name)
+
+    def chunk_mm(j):
+        z = jnp.zeros((), idx.dtype)
+        blk = lax.dynamic_slice(
+            x, (j * c,) + (z,) * (x.ndim - 1), (c, *x.shape[1:]))
+        return blk @ w_shard
+
+    # Chunk j enters the ring at rank (j+1): after n-1 forward hops it
+    # lands on rank j having accumulated every rank's partial product.
+    acc = chunk_mm((idx - 1) % n)
+    for t in range(1, n):
+        acc = lax.ppermute(acc, axis_name, fwd)
+        acc = acc + chunk_mm((idx - t - 1) % n)
+    return acc
 
 
 # ---------------------------------------------------------------------------
