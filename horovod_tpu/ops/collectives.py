@@ -131,8 +131,9 @@ def my_rank(axis_name: str = "data") -> jax.Array:
     return lax.axis_index(axis_name)
 
 
-def axis_size(axis_name: str = "data") -> jax.Array:
-    """Size of a bound mesh axis (the SPMD `hvd.size()`); delegates to
-    the single version-insulated implementation in `parallel.mesh`."""
+def axis_size(axis_name: str = "data") -> int:
+    """Static size of a bound mesh axis (the SPMD `hvd.size()`);
+    delegates to the single version-insulated implementation in
+    `parallel.mesh`."""
     from horovod_tpu.parallel.mesh import axis_size as _axis_size
     return _axis_size(axis_name)

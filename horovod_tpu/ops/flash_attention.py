@@ -52,6 +52,15 @@ except Exception:  # pragma: no cover
 
 NEG_INF = float("-inf")
 
+# The row-logsumexp rides between the fwd and bwd kernels lane-
+# replicated to a full 128-lane trailing dim: real Mosaic requires the
+# last two block dims to be (8k, 128m) or equal to the array dims, so a
+# rank-3 [B, H, S] lse with (1, 1, bq) blocks is UNLOWERABLE on
+# hardware (it only ever worked in interpret mode); and after (8, 128)
+# tile padding a narrower trailing dim would occupy the same HBM
+# anyway. Kernel-internal only — the public API still returns [B,H,S].
+LSE_LANES = 128
+
 
 def _band_j0(qi, *, window, q_offset, k_offset, block_q, block_k):
     """First k-block index that can intersect q-block ``qi``'s band —
@@ -190,16 +199,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         # fully-masked rows (the bwd kernels turn those into p = 0).
         m = m_ref[...][:, :1]
         lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(denom))
-        lse_ref[0, 0, :] = lse.reshape(-1)
+        lse_ref[0, 0, :, :] = jnp.broadcast_to(
+            lse, (lse.shape[0], LSE_LANES))
 
 
 def _flash_forward(q, k, v, *, causal, window, q_offset, k_offset,
                    block_q, block_k, interpret):
     """[B, S, H, D] flash attention forward via pallas_call.
 
-    Returns `(out [B, Sq, H, D], lse [B, H, nq*bq] f32)` — the row
-    logsumexp rides along for the fused Pallas backward (head-major,
-    padded to the block grid; -inf on fully-masked rows)."""
+    Returns `(out [B, Sq, H, D], lse [B, H, nq*bq, LSE_LANES] f32)` —
+    the row logsumexp rides along for the fused Pallas backward
+    (head-major, lane-replicated, padded to the block grid; -inf on
+    fully-masked rows)."""
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     group = _gqa_group(q, k, v)
@@ -254,11 +265,12 @@ def _flash_forward(q, k, v, *, causal, window, q_offset, k_offset,
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, bq, LSE_LANES),
+                         lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             _sds((B, H, nq * bq, D), q.dtype, qt, kt, vt),
-            _sds((B, H, nq * bq), jnp.float32, qt, kt, vt),
+            _sds((B, H, nq * bq, LSE_LANES), jnp.float32, qt, kt, vt),
         ],
         scratch_shapes=[
             _scratch((bq, D), jnp.float32),
@@ -269,6 +281,9 @@ def _flash_forward(q, k, v, *, causal, window, q_offset, k_offset,
         interpret=interpret,
     )(qt, kt, vt)
     out = out[:, :, :Sq, :]
+    # lse stays rank-4 (lane-replicated) so a fused backward can DMA it
+    # straight back in without a 128x re-broadcast; public surfaces
+    # slice `[..., 0]`.
     return jnp.transpose(out, (0, 2, 1, 3)), lse
 
 
@@ -323,9 +338,9 @@ def _recompute_p(q_ref, k_ref, lse_ref, *, scale, causal, window,
                        block_q=block_q, block_k=block_k)
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
-    lse = lse_ref[0, 0, :]                                 # [bq]
-    p = jnp.where(jnp.isfinite(lse)[:, None],
-                  jnp.exp(s - lse[:, None]), 0.0)          # [bq, bk]
+    lse = lse_ref[0, 0, :, :1]                             # [bq, 1]
+    p = jnp.where(jnp.isfinite(lse),
+                  jnp.exp(s - lse), 0.0)                   # [bq, bk]
     return qs, kb, p
 
 
@@ -393,7 +408,7 @@ def _flash_bwd_dkv_kernel(q_ref, do_ref, lse_ref, dvec_ref, k_ref,
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
-        ds = p * (dp - dvec_ref[0, 0, :][:, None])
+        ds = p * (dp - dvec_ref[0, 0, :, :1])
         # s = (scale·q)·kᵀ, so dk = dsᵀ·(scale·q) — qs carries scale.
         dk_acc[...] += jax.lax.dot_general(
             ds, qs, (((0,), (0,)), ((), ())),
@@ -452,7 +467,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, dvec_ref, k_ref,
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
-        ds = p * (dp - dvec_ref[0, 0, :][:, None])
+        ds = p * (dp - dvec_ref[0, 0, :, :1])
         dq_acc[...] += jax.lax.dot_general(
             ds, kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, D]
@@ -510,6 +525,9 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
     dvec = (gt.astype(jnp.float32) * ot.astype(jnp.float32)).sum(-1)
     if dlse is not None:
         dvec = dvec - dlse.astype(jnp.float32)
+    # dvec is born rank-3 here; lane-replicate it for Mosaic (see
+    # LSE_LANES). lse arrives already rank-4 from the forward.
+    dvec = jnp.broadcast_to(dvec[..., None], (*dvec.shape, LSE_LANES))
 
     # Sliding window: both sweeps shrink to the band, mirroring the
     # forward grid — out-of-band blocks are never DMA'd.
@@ -529,12 +547,6 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
                           block_q=bq, block_k=bk)
             i = jnp.minimum(i0 + inner % nqb, nq - 1)
             return (b, hkv * group + inner // nqb, i, 0)
-
-        def dkv_r_map(b, hkv, j, inner):
-            i0 = _band_i0(j, q_offset=q_offset, k_offset=k_offset,
-                          block_q=bq, block_k=bk)
-            i = jnp.minimum(i0 + inner % nqb, nq - 1)
-            return (b, hkv * group + inner // nqb, i)
     else:
         nkb, nqb = nk, nq
 
@@ -544,14 +556,12 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
         def dkv_q_map(b, hkv, j, inner):
             return (b, hkv * group + inner // nqb, inner % nqb, 0)
 
-        def dkv_r_map(b, hkv, j, inner):
-            return (b, hkv * group + inner // nqb, inner % nqb)
-
     common = dict(scale=D ** -0.5, causal=causal, window=window,
                   banded=banded, q_offset=q_offset, k_offset=k_offset,
                   kv_len=Sk, block_q=bq, block_k=bk)
     q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
-    r_spec = pl.BlockSpec((1, 1, bq), lambda b, h, i, j: (b, h, i))
+    r_spec = pl.BlockSpec((1, 1, bq, LSE_LANES),
+                          lambda b, h, i, j: (b, h, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, nk_total=nk, **common),
@@ -570,7 +580,7 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
     )(qt, gt, lse, dvec, kt, vt)
 
     kq_spec = pl.BlockSpec((1, 1, bq, D), dkv_q_map)
-    kr_spec = pl.BlockSpec((1, 1, bq), dkv_r_map)
+    kr_spec = pl.BlockSpec((1, 1, bq, LSE_LANES), dkv_q_map)
     kk_spec = pl.BlockSpec((1, 1, bk, D),
                            lambda b, hkv, j, inner: (b, hkv, j, 0))
     Hkv = H // group
@@ -745,14 +755,14 @@ def _make_flash_lse(causal, window, q_offset, k_offset, block_q,
             q, k, v, causal=causal, window=window,
             q_offset=q_offset, k_offset=k_offset,
             block_q=block_q, block_k=block_k, interpret=interpret)
-        return o, lse[:, :, :q.shape[1]]
+        return o, lse[:, :, :q.shape[1], 0]
 
     def fwd(q, k, v):
         o, lse = _flash_forward(
             q, k, v, causal=causal, window=window,
             q_offset=q_offset, k_offset=k_offset,
             block_q=block_q, block_k=block_k, interpret=interpret)
-        return (o, lse[:, :, :q.shape[1]]), (q, k, v, o, lse)
+        return (o, lse[:, :, :q.shape[1], 0]), (q, k, v, o, lse)
 
     def bwd(res, cot):
         q, k, v, o, lse = res

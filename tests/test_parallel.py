@@ -165,14 +165,23 @@ class TestCollectiveMatmul:
                 in_specs=(P(None, "model"), P("model", None)),
                 out_specs=P("model", None)))(x, w)
 
-    def test_collective_matmul_grads_match(self):
+    @pytest.mark.parametrize("tp", [4, 5])
+    def test_collective_matmul_grads_match(self, tp):
         """d/dx, d/dw of the overlapped sequence-parallel pair
-        (AG-matmul up, matmul-RS down) == the monolithic pair's."""
-        mesh = par.make_mesh(model=4, data=2)
+        (AG-matmul up, matmul-RS down) == the monolithic pair's —
+        at an even ring (the half-step dedup branch fires) and an odd
+        one (it must not)."""
+        if tp == 5:
+            if jax.device_count() < 5:
+                pytest.skip("needs 5 virtual devices")
+            from jax.sharding import Mesh
+            mesh = Mesh(np.array(jax.devices()[:5]), ("model",))
+        else:
+            mesh = par.make_mesh(model=4, data=2)
         rng = np.random.RandomState(2)
-        x = rng.randn(16, 12).astype(np.float32)
-        w1 = rng.randn(12, 20).astype(np.float32)
-        w2 = rng.randn(20, 12).astype(np.float32)
+        x = rng.randn(4 * tp, 12).astype(np.float32)
+        w1 = rng.randn(12, 4 * tp).astype(np.float32)
+        w2 = rng.randn(4 * tp, 12).astype(np.float32)
         specs = (P("model", None), P(None, "model"), P("model", None))
 
         def overlapped(x, w1, w2):
