@@ -271,11 +271,18 @@ def run_decode(args, devices, n_chips, log):
     params = unbox(model.init(
         jax.random.PRNGKey(0),
         jnp.zeros((B, 64), jnp.int32))["params"])
+    if args.weight_quant:
+        # Weight-only int8 serving path: block kernels stored int8,
+        # dequantized in VMEM inside the decode scan (half the weight
+        # HBM traffic per tick).
+        from horovod_tpu.ops.quantization import quantize_lm_params
+        model = model.clone(weight_quant=args.weight_quant)
+        params = quantize_lm_params(params)
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree.leaves(params))
     prompt = np.random.RandomState(0).randint(0, 32768, (B, P))
     log(f"decode: {n_params / 1e6:.1f}M params, B={B}, prompt={P}, "
-        f"steps={steps}")
+        f"steps={steps}, quant={args.weight_quant or 'none'}")
     t0 = time.time()
     out = generate(model, params, prompt, steps=steps)
     np.asarray(out)  # full device->host fence (see time_steps)
@@ -291,7 +298,8 @@ def run_decode(args, devices, n_chips, log):
     log(f"decode: {tok_s:.1f} tokens/s "
         f"({dt / steps * 1e3:.2f} ms/tick at B={B})")
     return {"tok_s_chip": tok_s, "n_params": n_params,
-            "ms_per_tick": dt / steps * 1e3}
+            "ms_per_tick": dt / steps * 1e3,
+            "weight_quant": args.weight_quant}
 
 
 def run_transformer(args, devices, n_chips, log):
@@ -431,6 +439,10 @@ def main():
                     help="transformer: benchmark KV-cache inference "
                          "(generate) instead of training")
     ap.add_argument("--decode-steps", type=int, default=256)
+    ap.add_argument("--weight-quant", default=None,
+                    choices=["int8"],
+                    help="weight-only quantization for --decode "
+                         "(block kernels int8 + per-channel scales)")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the timed "
                          "steps into DIR (overlap/MFU analysis)")

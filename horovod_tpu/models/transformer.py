@@ -158,6 +158,7 @@ class TransformerBlock(nn.Module):
     decode: bool = False
     chunked_prefill: bool = False   # see ParallelSelfAttention
     causal: bool = True     # False = bidirectional (encoder / ViT)
+    weight_quant: Optional[str] = None   # None | "int8" (block matmuls)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -190,6 +191,7 @@ class TransformerBlock(nn.Module):
             rope_theta=self.rope_theta, window=self.window,
             dtype=self.dtype, attn_fn=attn_fn, decode=self.decode,
             chunked_prefill=self.chunked_prefill,
+            weight_quant=self.weight_quant,
             name="attn")(h, mask)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
@@ -200,6 +202,7 @@ class TransformerBlock(nn.Module):
                          dtype=self.dtype, name="moe")(h)
         else:
             h = ParallelMLP(hidden=self.mlp_ratio * d, out=d,
+                            weight_quant=self.weight_quant,
                             dtype=self.dtype, name="mlp")(h)
         return x + h
 
@@ -234,6 +237,10 @@ class TransformerLM(nn.Module):
     # mask) instead of the one-pass empty-cache prefill; see
     # ParallelSelfAttention.chunked_prefill.
     chunked_prefill: bool = False
+    # "int8": block matmul kernels stored int8 + per-channel scales
+    # (weight-only, inference; `ops.quantization.quantize_lm_params`).
+    # Embedding/head and LayerNorms stay full precision.
+    weight_quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
@@ -287,6 +294,7 @@ class TransformerLM(nn.Module):
                 moe_capacity_factor=self.moe_capacity_factor,
                 decode=self.decode,
                 chunked_prefill=self.chunked_prefill,
+                weight_quant=self.weight_quant,
                 name=f"block_{i}")(x)
             x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
 

@@ -1,0 +1,116 @@
+"""Weight-only int8 quantization for inference.
+
+No reference equivalent — Horovod v0.10's inference story is a docs
+recipe for stripping ops from a frozen graph; this is part of the
+TPU-native inference extension. Decode is HBM-bound on weight and
+KV-cache reads (every parameter is re-read once per generated token),
+so storing the transformer block matmul kernels as int8 with
+per-output-channel float scales halves their HBM traffic; the
+`int8 -> bf16` dequant runs on-chip in VMEM, fused by XLA into the
+consuming matmul's operand read inside the decode `lax.scan` body.
+
+Scope: the Megatron block kernels (attention ``qkv``/``out``, MLP
+``wi``/``wo``) — ~80 % of a dense LM's parameters. Embedding table and
+LayerNorms stay at full precision (the embed doubles as the tied LM
+head, where quantization error lands directly on the logits).
+
+Flow: train (or load) a normal float tree, then
+
+    qtree = quantize_lm_params(params)
+    qmodel = TransformerLM(..., weight_quant="int8")
+    out = qmodel.apply({"params": qtree}, tokens)
+
+`TransformerLM(weight_quant="int8").init` creates the same tree
+STRUCTURE (zero weights) — real values always come from
+`quantize_lm_params`; init exists so flax shape/cache plumbing (and
+`models.generate`'s decode clone) works unchanged.
+
+Oracle (tests/test_quantization.py): the quantized model's outputs are
+exactly the plain model's outputs on the dequantized tree — the only
+approximation is the rounding in `quantize_int8`, which is bounded by
+half a quantization step per element.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Module names whose 2-D "kernel" params are quantized — the Megatron
+# block pair names used by ParallelSelfAttention / ParallelMLP.
+QUANT_KERNEL_MODULES = ("qkv", "out", "wi", "wo")
+
+
+def quantize_int8(w: jax.Array, axis: int = 0
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-channel int8 quantization of a matmul kernel.
+
+    ``axis`` is the CONTRACTION axis (0 for the [in, out] kernels flax
+    Dense uses): each output channel gets one scale, so dequantized
+    columns are exact rescalings and the matmul's accumulation error
+    stays per-channel-bounded. Returns ``(q int8, scale f32)`` with
+    `w ≈ q * scale` and `|w - q·scale| <= scale/2` elementwise.
+    All-zero channels get scale 1 (q = 0) to avoid 0/0.
+    """
+    w = jnp.asarray(w)
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0,
+                      amax.astype(jnp.float32) / 127.0)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, jnp.squeeze(scale, axis=axis)
+
+
+def dequantize_int8(q: jax.Array, scale: jax.Array,
+                    dtype=jnp.float32, axis: int = 0) -> jax.Array:
+    """`q * scale` back at ``dtype`` (scale re-expanded on ``axis``)."""
+    return q.astype(dtype) * jnp.expand_dims(scale, axis).astype(dtype)
+
+
+def _is_quant_site(path: Tuple[str, ...], leaf_dict: Any) -> bool:
+    return (path and path[-1] in QUANT_KERNEL_MODULES
+            and isinstance(leaf_dict, dict)
+            and "kernel" in leaf_dict
+            and getattr(leaf_dict["kernel"], "ndim", 0) == 2)
+
+
+def quantize_lm_params(params: Any) -> Any:
+    """Transform a float LM param tree into the structure
+    `TransformerLM(weight_quant="int8")` consumes: each block-matmul
+    ``kernel`` becomes ``kernel_q`` (int8) + ``kernel_scale`` (f32 per
+    output channel); everything else passes through unchanged.
+
+    Works on the UNSHARDED host tree: scales are computed over full
+    contraction columns, so TP-sharding the result afterwards keeps
+    every shard consistent with the same per-channel scale.
+    """
+    def walk(node, path):
+        if not isinstance(node, dict):
+            return node
+        if _is_quant_site(path, node):
+            q, scale = quantize_int8(node["kernel"], axis=0)
+            out = {k: v for k, v in node.items() if k != "kernel"}
+            out["kernel_q"] = q
+            out["kernel_scale"] = scale
+            return out
+        return {k: walk(v, path + (k,)) for k, v in node.items()}
+
+    return walk(params, ())
+
+
+def dequantize_lm_params(qparams: Any, dtype=jnp.float32) -> Any:
+    """Inverse structural transform (the oracle's reference path):
+    rebuilds a plain float tree from a `quantize_lm_params` output."""
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        if "kernel_q" in node and "kernel_scale" in node:
+            out = {k: v for k, v in node.items()
+                   if k not in ("kernel_q", "kernel_scale")}
+            out["kernel"] = dequantize_int8(
+                node["kernel_q"], node["kernel_scale"], dtype)
+            return out
+        return {k: walk(v) for k, v in node.items()}
+
+    return walk(qparams)

@@ -165,6 +165,43 @@ def matmul_reducescatter(x: jax.Array, w_shard: jax.Array,
 # GSPMD flax modules.
 # ---------------------------------------------------------------------------
 
+def _dense_kernel(mod: nn.Module, in_features: int, features: int,
+                  kernel_sharding: Tuple[Optional[str], Optional[str]],
+                  ) -> jax.Array:
+    """The kernel of a parallel Dense at the module dtype — plain, or
+    weight-only int8 when ``mod.weight_quant == "int8"``.
+
+    Quantized layout: ``kernel_q`` int8 [in, out] + ``kernel_scale``
+    f32 [out] (per-output-channel), dequantized on-chip via the SAME
+    `ops.quantization.dequantize_int8` the oracle tests pin — inside a
+    decode scan the int8 HBM read replaces the bf16 one (half the
+    weight traffic) and XLA fuses the dequant into the consuming
+    matmul. Real values come from `quantize_lm_params`; quantized init
+    is structural (zeros). The scale is sharded like the kernel's
+    output dim so column-parallel shards carry their own scales.
+    """
+    if mod.weight_quant == "int8":
+        from horovod_tpu.ops.quantization import dequantize_int8
+        q = mod.param(
+            "kernel_q",
+            nn.with_partitioning(nn.initializers.zeros,
+                                 kernel_sharding),
+            (in_features, features), jnp.int8)
+        scale = mod.param(
+            "kernel_scale",
+            nn.with_partitioning(nn.initializers.ones,
+                                 (kernel_sharding[1],)),
+            (features,), jnp.float32)
+        return dequantize_int8(q, scale, mod.dtype, axis=0)
+    if mod.weight_quant is not None:
+        raise ValueError(
+            f"unsupported weight_quant {mod.weight_quant!r}")
+    return jnp.asarray(mod.param(
+        "kernel",
+        nn.with_partitioning(mod.kernel_init, kernel_sharding),
+        (in_features, features), jnp.float32), mod.dtype)
+
+
 class ColumnParallelDense(nn.Module):
     """Dense with the kernel's output dim sharded over ``model``."""
 
@@ -173,14 +210,13 @@ class ColumnParallelDense(nn.Module):
     dtype: Optional[Dtype] = None
     kernel_init: Callable = nn.initializers.lecun_normal()
     axis: str = AXIS_MODEL
+    weight_quant: Optional[str] = None   # None | "int8"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        kernel = self.param(
-            "kernel",
-            nn.with_partitioning(self.kernel_init, (None, self.axis)),
-            (x.shape[-1], self.features), jnp.float32)
-        y = jnp.asarray(x, self.dtype) @ jnp.asarray(kernel, self.dtype)
+        kernel = _dense_kernel(self, x.shape[-1], self.features,
+                               (None, self.axis))
+        y = jnp.asarray(x, self.dtype) @ kernel
         if self.use_bias:
             bias = self.param(
                 "bias",
@@ -204,14 +240,13 @@ class RowParallelDense(nn.Module):
     dtype: Optional[Dtype] = None
     kernel_init: Callable = nn.initializers.lecun_normal()
     axis: str = AXIS_MODEL
+    weight_quant: Optional[str] = None   # None | "int8"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        kernel = self.param(
-            "kernel",
-            nn.with_partitioning(self.kernel_init, (self.axis, None)),
-            (x.shape[-1], self.features), jnp.float32)
-        y = jnp.asarray(x, self.dtype) @ jnp.asarray(kernel, self.dtype)
+        kernel = _dense_kernel(self, x.shape[-1], self.features,
+                               (self.axis, None))
+        y = jnp.asarray(x, self.dtype) @ kernel
         # Feature dim pinned unsharded ⇒ the partial products over the
         # ``model``-sharded contraction are psum-reduced here; leading
         # dims stay UNCONSTRAINED to preserve data/seq sharding.
@@ -232,12 +267,17 @@ class ParallelMLP(nn.Module):
     out: int
     dtype: Optional[Dtype] = None
     activation: Callable = nn.gelu
+    weight_quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        h = ColumnParallelDense(self.hidden, dtype=self.dtype, name="wi")(x)
+        h = ColumnParallelDense(self.hidden, dtype=self.dtype,
+                                weight_quant=self.weight_quant,
+                                name="wi")(x)
         h = self.activation(h)
-        return RowParallelDense(self.out, dtype=self.dtype, name="wo")(h)
+        return RowParallelDense(self.out, dtype=self.dtype,
+                                weight_quant=self.weight_quant,
+                                name="wo")(h)
 
 
 class ParallelSelfAttention(nn.Module):
@@ -283,6 +323,7 @@ class ParallelSelfAttention(nn.Module):
     # cached prefix via the general cache-wide mask (correct for any
     # cache_index, at [S, cache_len] mask cost).
     chunked_prefill: bool = False
+    weight_quant: Optional[str] = None   # None | "int8" (projections)
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -298,6 +339,7 @@ class ParallelSelfAttention(nn.Module):
         kv_features = Hkv * self.head_dim
         qkv = ColumnParallelDense(features + 2 * kv_features,
                                   use_bias=False,
+                                  weight_quant=self.weight_quant,
                                   dtype=self.dtype, name="qkv")(x)
         q = qkv[..., :features]
         k = qkv[..., features:features + kv_features]
@@ -330,8 +372,9 @@ class ParallelSelfAttention(nn.Module):
         else:
             o = constrain(o, AXIS_DATA, *([None] * (o.ndim - 3)),
                           AXIS_SEQ, AXIS_MODEL)
-        return RowParallelDense(features, use_bias=False, dtype=self.dtype,
-                                name="out")(o)
+        return RowParallelDense(features, use_bias=False,
+                                weight_quant=self.weight_quant,
+                                dtype=self.dtype, name="out")(o)
 
     def _maybe_rope(self, q, k, offset=0):
         """Rotate q/k at absolute positions offset+arange(S) when
