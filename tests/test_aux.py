@@ -218,3 +218,36 @@ def test_sparse_allreduce_eager(hvd):
     # Replicated input: each of size() ranks contributes the same slices.
     assert out.values.shape == (2 * hvd.size(), 3)
     np.testing.assert_allclose(np.asarray(out.values), 1.0)
+
+
+def test_bench_deadline_watchdog_paths():
+    """bench.py's global deadline watchdog (tunneled-backend silent-
+    hang salvage): with a completed primary it re-emits that result
+    tagged `watchdog` and exits 0; with none it emits a diagnostic
+    error line and exits 1 — either way the driver-parsed LAST line is
+    meaningful."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run(best):
+        seed = ('import bench; bench._BEST_RESULT.update('
+                '{"metric": "m", "value": 1.5, "unit": "u"})\n'
+                if best else 'import bench\n')
+        code = (seed + 'import time\n'
+                'bench.start_deadline_watchdog("m", "u", 0.3)\n'
+                'time.sleep(30)\n')
+        return subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, timeout=25, cwd=repo)
+
+    r = run(best=True)
+    d = json.loads(r.stdout.strip().splitlines()[-1])
+    assert d["value"] == 1.5 and "watchdog" in d
+    assert r.returncode == 0
+
+    r = run(best=False)
+    d = json.loads(r.stdout.strip().splitlines()[-1])
+    assert d["value"] == 0.0 and "watchdog" in d["error"]
+    assert r.returncode == 1
