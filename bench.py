@@ -334,6 +334,10 @@ def run_decode(args, devices, n_chips, log):
         from horovod_tpu.ops.quantization import quantize_lm_params
         model = model.clone(weight_quant=args.weight_quant)
         params = quantize_lm_params(params)
+    if args.kv_quant:
+        # int8 KV cache: 2x context per byte of cache HBM, half the
+        # per-tick cache read traffic.
+        model = model.clone(kv_quant=args.kv_quant)
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree.leaves(params))
     prompt = np.random.RandomState(0).randint(0, 32768, (B, P))
@@ -505,6 +509,9 @@ def main():
                     choices=["int8"],
                     help="weight-only quantization for --decode "
                          "(block kernels int8 + per-channel scales)")
+    ap.add_argument("--kv-quant", default=None, choices=["int8"],
+                    help="int8 decode KV cache (per-(position, head) "
+                         "scales; 2x context per byte of cache HBM)")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the timed "
                          "steps into DIR (overlap/MFU analysis)")
@@ -788,6 +795,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "ms_per_tick": round(r["ms_per_tick"], 2),
             "decode_steps": args.decode_steps,
             "weight_quant": args.weight_quant,
+            "kv_quant": args.kv_quant,
             "overlap_measured": _measured_overlap(args),
         })
         emit(_BEST_RESULT)

@@ -159,6 +159,7 @@ class TransformerBlock(nn.Module):
     chunked_prefill: bool = False   # see ParallelSelfAttention
     causal: bool = True     # False = bidirectional (encoder / ViT)
     weight_quant: Optional[str] = None   # None | "int8" (block matmuls)
+    kv_quant: Optional[str] = None       # None | "int8" (decode cache)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -192,6 +193,7 @@ class TransformerBlock(nn.Module):
             dtype=self.dtype, attn_fn=attn_fn, decode=self.decode,
             chunked_prefill=self.chunked_prefill,
             weight_quant=self.weight_quant,
+            kv_quant=self.kv_quant,
             name="attn")(h, mask)
         x = x + h
         h = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
@@ -241,6 +243,9 @@ class TransformerLM(nn.Module):
     # (weight-only, inference; `ops.quantization.quantize_lm_params`).
     # Embedding/head and LayerNorms stay full precision.
     weight_quant: Optional[str] = None
+    # "int8": decode KV cache stored int8 with per-(position, head)
+    # scales — 2x context length per byte of cache HBM.
+    kv_quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
@@ -295,6 +300,7 @@ class TransformerLM(nn.Module):
                 decode=self.decode,
                 chunked_prefill=self.chunked_prefill,
                 weight_quant=self.weight_quant,
+                kv_quant=self.kv_quant,
                 name=f"block_{i}")(x)
             x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
 

@@ -324,6 +324,12 @@ class ParallelSelfAttention(nn.Module):
     # cache_index, at [S, cache_len] mask cost).
     chunked_prefill: bool = False
     weight_quant: Optional[str] = None   # None | "int8" (projections)
+    # "int8": decode KV cache stored int8 with per-(position, head)
+    # f32 scales over the head_dim — 2x the context length per byte of
+    # HBM (and half the cache read traffic per tick); K/V are
+    # quantized at cache-write time and dequantized at the module
+    # dtype on read. Decode-mode only; ignored when decode=False.
+    kv_quant: Optional[str] = None
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -417,15 +423,59 @@ class ParallelSelfAttention(nn.Module):
         m = banded_causal_mask(pos, pos, self.window)[None, None]
         return self._dispatch_attn(q, k, v, m)
 
-    def _cache_write(self, cached_k, cached_v, index, k, v, i, S, W):
+    def _kv_cache_vars(self, k, v, L0):
+        """Cache storage for K/V (+ per-(position, head) scale vars
+        when ``kv_quant``). Shape args are only read at creation time
+        (model.init)."""
+        cache_shape = (*k.shape[:-3], L0, *k.shape[-2:])
+        store = jnp.int8 if self.kv_quant == "int8" else k.dtype
+        if self.kv_quant not in (None, "int8"):
+            raise ValueError(
+                f"unsupported kv_quant {self.kv_quant!r}")
+        cached_k = self.variable("cache", "cached_key",
+                                 jnp.zeros, cache_shape, store)
+        cached_v = self.variable("cache", "cached_value",
+                                 jnp.zeros, cache_shape, store)
+        if self.kv_quant == "int8":
+            s_shape = (*k.shape[:-3], L0, k.shape[-2])
+            scale_k = self.variable("cache", "cached_key_scale",
+                                    jnp.ones, s_shape, jnp.float32)
+            scale_v = self.variable("cache", "cached_value_scale",
+                                    jnp.ones, s_shape, jnp.float32)
+        else:
+            scale_k = scale_v = None
+        return cached_k, cached_v, scale_k, scale_v
+
+    def _cache_read(self, cached, scale):
+        """The cache at the compute dtype (dequantized under
+        ``kv_quant`` via the single tested codec)."""
+        if scale is None:
+            return cached.value
+        from horovod_tpu.ops.quantization import dequantize_int8
+        return dequantize_int8(cached.value, scale.value,
+                               self.dtype or jnp.float32, axis=-1)
+
+    def _cache_write(self, cached_k, cached_v, scale_k, scale_v,
+                     index, k, v, i, S, W):
         """Append S new K/V at position i (linear cache) or into their
-        rolling slots (window cache); advances the index."""
+        rolling slots (window cache); advances the index. Under
+        ``kv_quant`` the block is quantized here (symmetric int8 over
+        head_dim, one scale per (position, head)) and the scales land
+        in the same slots."""
+        if self.kv_quant == "int8":
+            k, sk = _kv_quantize(k)
+            v, sv = _kv_quantize(v)
         if self.window is None:
             z = jnp.zeros((), i.dtype)
             cached_k.value = lax.dynamic_update_slice(
                 cached_k.value, k, (z, i, z, z))
             cached_v.value = lax.dynamic_update_slice(
                 cached_v.value, v, (z, i, z, z))
+            if scale_k is not None:
+                scale_k.value = lax.dynamic_update_slice(
+                    scale_k.value, sk, (z, i, z))
+                scale_v.value = lax.dynamic_update_slice(
+                    scale_v.value, sv, (z, i, z))
         else:
             # Last min(S, W) keys land in their slots (earlier ones
             # would be overwritten within this block anyway).
@@ -436,6 +486,11 @@ class ParallelSelfAttention(nn.Module):
                 k[:, S - t:])
             cached_v.value = cached_v.value.at[:, slots].set(
                 v[:, S - t:])
+            if scale_k is not None:
+                scale_k.value = scale_k.value.at[:, slots].set(
+                    sk[:, S - t:])
+                scale_v.value = scale_v.value.at[:, slots].set(
+                    sv[:, S - t:])
         index.value = i + S
 
     def _decode_attention(self, q, k, v):
@@ -453,14 +508,10 @@ class ParallelSelfAttention(nn.Module):
         # Cache length: full at plain decode, exactly `window` slots
         # when sliding-window — NOT min(init_len, window): a cache
         # shorter than the window would silently evict in-band keys
-        # once the position counter passes the init length. (Shape
-        # args are only read at creation, i.e. during model.init.)
+        # once the position counter passes the init length.
         L0 = k.shape[-3] if self.window is None else self.window
-        cache_shape = (*k.shape[:-3], L0, *k.shape[-2:])
-        cached_k = self.variable("cache", "cached_key",
-                                 jnp.zeros, cache_shape, k.dtype)
-        cached_v = self.variable("cache", "cached_value",
-                                 jnp.zeros, cache_shape, v.dtype)
+        cached_k, cached_v, scale_k, scale_v = self._kv_cache_vars(
+            k, v, L0)
         index = self.variable("cache", "cache_index",
                               lambda: jnp.zeros((), jnp.int32))
         if not is_init:
@@ -495,18 +546,18 @@ class ParallelSelfAttention(nn.Module):
                     f"an empty cache, but cache_index={int(i)}; use "
                     "chunked_prefill=True for S>1 appends to a "
                     "non-empty cache")
-            self._cache_write(cached_k, cached_v, index, k, v, i, S, W)
+            self._cache_write(cached_k, cached_v, scale_k, scale_v,
+                              index, k, v, i, S, W)
             return self._causal_block_attn(q, k, v)
 
         if self.window is None:
-            z = jnp.zeros((), i.dtype)  # match index dtype under x64
-            key = lax.dynamic_update_slice(
-                cached_k.value, k, (z, i, z, z))
-            val = lax.dynamic_update_slice(
-                cached_v.value, v, (z, i, z, z))
-            cached_k.value = key
-            cached_v.value = val
-            index.value = i + S
+            # Write first, then attend over the (possibly dequantized)
+            # updated cache — the current token reads back through the
+            # same codec later ticks will see.
+            self._cache_write(cached_k, cached_v, scale_k, scale_v,
+                              index, k, v, i, S, W)
+            key = self._cache_read(cached_k, scale_k)
+            val = self._cache_read(cached_v, scale_v)
             # Valid positions: the prefix plus the causal part of the
             # new block — position p attends to cached positions
             # <= i + its own offset.
@@ -528,13 +579,25 @@ class ParallelSelfAttention(nn.Module):
         keep = banded_causal_mask(qpos, kv_pos, self.window)
         keep &= jnp.concatenate(
             [valid, jnp.ones((S,), bool)])[None, :]
-        key = jnp.concatenate([cached_k.value, k], axis=-3)
-        val = jnp.concatenate([cached_v.value, v], axis=-3)
+        key = jnp.concatenate(
+            [self._cache_read(cached_k, scale_k), k], axis=-3)
+        val = jnp.concatenate(
+            [self._cache_read(cached_v, scale_v), v], axis=-3)
         out = dot_product_attention(q, self._repeat_kv(key),
                                     self._repeat_kv(val),
                                     keep[None, None])
-        self._cache_write(cached_k, cached_v, index, k, v, i, S, W)
+        self._cache_write(cached_k, cached_v, scale_k, scale_v,
+                          index, k, v, i, S, W)
         return out
+
+
+def _kv_quantize(t: jax.Array):
+    """Symmetric int8 over the head_dim: one f32 scale per
+    (..., position, head) — the KV-cache codec (`kv_quant="int8"`).
+    Delegates to the single tested codec in `ops.quantization`
+    (same scale rule, clipping, and half-step error bound)."""
+    from horovod_tpu.ops.quantization import quantize_int8
+    return quantize_int8(t, axis=-1)
 
 
 def apply_rope(x: jax.Array, positions: jax.Array,
