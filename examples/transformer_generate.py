@@ -10,6 +10,7 @@ Run (any device count; generation itself is single-replica):
   python examples/transformer_generate.py --steps 60
   python examples/transformer_generate.py --temperature 0.8 --top-k 8
   python examples/transformer_generate.py --window 12 --gen-len 96
+  python examples/transformer_generate.py --int8     # quantized serving
 """
 
 import argparse
@@ -32,6 +33,9 @@ def main():
     ap.add_argument("--top-k", type=int, default=None)
     ap.add_argument("--top-p", type=float, default=None)
     ap.add_argument("--lr", type=float, default=5e-3)
+    ap.add_argument("--int8", action="store_true",
+                    help="serve quantized: int8 block weights "
+                         "(quantize_lm_params) + int8 KV cache")
     args = ap.parse_args()
 
     import jax
@@ -71,6 +75,14 @@ def main():
               flush=True)
 
     prompt = np.asarray([[0, 1, 2, 3]], np.int32)
+    if args.int8:
+        # Post-training quantized serving: same generate() API, int8
+        # block kernels + int8 KV cache (docs/inference.md).
+        from horovod_tpu.ops.quantization import quantize_lm_params
+        model = model.clone(weight_quant="int8", kv_quant="int8")
+        params = quantize_lm_params(params)
+        if hvd.rank() == 0:
+            print("serving int8 (weights + KV cache)", flush=True)
     out = generate(model, params, prompt, steps=args.gen_len,
                    temperature=args.temperature, top_k=args.top_k,
                    top_p=args.top_p,

@@ -130,6 +130,18 @@ def test_examples_under_launcher(example):
     assert "final loss" in res.stdout
 
 
+def test_generate_example_int8_serving():
+    """The train-then-generate example through the quantized serving
+    path (int8 block weights + int8 KV cache) — single process, tiny
+    budget; prints the quantized-serving marker and a generation."""
+    res = _run(["-np", "1", "--", sys.executable,
+                "examples/transformer_generate.py",
+                "--steps", "4", "--gen-len", "6", "--int8"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "serving int8" in res.stdout
+    assert "generated:" in res.stdout
+
+
 def test_checkpoint_resume_across_launches(tmp_path):
     """The §5.4 contract under the launcher: run 1 saves on rank 0
     only; run 2 discovers the newest step, restores, broadcasts, and
