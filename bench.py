@@ -383,7 +383,9 @@ def run_transformer(args, devices, n_chips, log):
         pos_emb=args.pos_emb, window=args.window,
         head_dim=args.head_dim,
         max_len=args.seq, dtype=jnp.bfloat16,
-        attn_impl=args.attn_impl, remat=args.remat)
+        attn_impl=args.attn_impl, remat=args.remat,
+        flash_block_q=args.flash_block_q,
+        flash_block_k=args.flash_block_k)
     toks = np.random.RandomState(0).randint(
         0, 32768, (args.batch * n_chips, args.seq))
     params, opt_state = init_lm_state(
@@ -512,6 +514,13 @@ def main():
     ap.add_argument("--kv-quant", default=None, choices=["int8"],
                     help="int8 decode KV cache (per-(position, head) "
                          "scales; 2x context per byte of cache HBM)")
+    ap.add_argument("--flash-block-q", type=int, default=128,
+                    help="Pallas flash kernel q-tile (LM, "
+                         "--attn-impl flash only; sweep on hardware "
+                         "— VMEM vs grid-steps trade)")
+    ap.add_argument("--flash-block-k", type=int, default=128,
+                    help="Pallas flash kernel k-tile (LM, "
+                         "--attn-impl flash only)")
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the timed "
                          "steps into DIR (overlap/MFU analysis)")

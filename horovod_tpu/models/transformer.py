@@ -60,13 +60,26 @@ ATTN_IMPLS = ("dot", "blockwise", "flash", "ring", "ring_flash",
 
 def make_attn_fn(impl: str, *, causal: bool = True,
                  block_size: int = 512,
-                 window: Optional[int] = None) -> Optional[Callable]:
+                 window: Optional[int] = None,
+                 flash_block_q: int = 128,
+                 flash_block_k: int = 128) -> Optional[Callable]:
     """attn_fn for `ParallelSelfAttention` (None = dot baseline, which
     consumes the explicit mask argument instead). ``window`` = sliding
     -window attention (last `window` positions only; requires causal).
+    ``flash_block_q``/``flash_block_k``: Pallas kernel grid tile sizes
+    (``impl="flash"``) — the VMEM-vs-grid-steps trade is shape- and
+    generation-dependent, so `bench.py --flash-block-q/-k` sweeps them
+    on hardware; defaults match the kernel's.
     """
     from horovod_tpu.parallel.sequence import check_window
     check_window(window)
+    if (flash_block_q, flash_block_k) != (128, 128) and impl != "flash":
+        # ring_flash/ulysses_flash run the kernel at its defaults (the
+        # per-shard sequences are already small); silently ignoring the
+        # knob would make a hardware sweep measure identical kernels.
+        raise ValueError(
+            f"flash_block_q/flash_block_k apply to attn_impl='flash' "
+            f"only (got impl={impl!r})")
     if impl == "dot":
         return None
 
@@ -89,7 +102,9 @@ def make_attn_fn(impl: str, *, causal: bool = True,
         def attn(q, k, v, m):
             _no_mask(m)
             return flash_attention(q, k, v, causal=causal,
-                                   window=window)
+                                   window=window,
+                                   block_q=flash_block_q,
+                                   block_k=flash_block_k)
         # The kernel consumes grouped K/V natively (index-mapped kv
         # heads); let ParallelSelfAttention skip the repeat.
         attn.native_gqa = True
@@ -160,6 +175,8 @@ class TransformerBlock(nn.Module):
     causal: bool = True     # False = bidirectional (encoder / ViT)
     weight_quant: Optional[str] = None   # None | "int8" (block matmuls)
     kv_quant: Optional[str] = None       # None | "int8" (decode cache)
+    flash_block_q: int = 128             # Pallas flash tile sizes
+    flash_block_k: int = 128
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -177,7 +194,9 @@ class TransformerBlock(nn.Module):
         # ONE-PASS PREFILL (S>1 from an empty cache), which is plain
         # causal attention over the prompt block — flash-able.
         attn_fn = make_attn_fn(self.attn_impl, causal=self.causal,
-                               window=self.window)
+                               window=self.window,
+                               flash_block_q=self.flash_block_q,
+                               flash_block_k=self.flash_block_k)
         mask = None
         if attn_fn is None and not self.decode and self.causal:
             # dot baseline materializes the banded causal mask
@@ -246,6 +265,8 @@ class TransformerLM(nn.Module):
     # "int8": decode KV cache stored int8 with per-(position, head)
     # scales — 2x context length per byte of cache HBM.
     kv_quant: Optional[str] = None
+    flash_block_q: int = 128   # Pallas flash tile sizes (bench-sweepable)
+    flash_block_k: int = 128
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
@@ -301,6 +322,8 @@ class TransformerLM(nn.Module):
                 chunked_prefill=self.chunked_prefill,
                 weight_quant=self.weight_quant,
                 kv_quant=self.kv_quant,
+                flash_block_q=self.flash_block_q,
+                flash_block_k=self.flash_block_k,
                 name=f"block_{i}")(x)
             x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
 
