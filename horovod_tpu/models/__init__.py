@@ -13,6 +13,9 @@ from horovod_tpu.models.resnet import ResNet, ResNet50, ResNet101, ResNet152
 from horovod_tpu.models.vgg import VGG16
 from horovod_tpu.models.inception import InceptionV3
 from horovod_tpu.models.word2vec import Word2Vec
+from horovod_tpu.models.bert import (BertBase, BertLarge, BertMLM,
+                                     make_mlm_batch, make_mlm_train_step,
+                                     mlm_loss)
 from horovod_tpu.models.vit import VisionTransformer, ViT_B16, ViT_S16
 from horovod_tpu.models.train import make_cnn_train_step
 from horovod_tpu.models.transformer import (
@@ -24,6 +27,8 @@ __all__ = [
     "MnistConvNet", "ResNet", "ResNet50", "ResNet101", "ResNet152",
     "VGG16", "InceptionV3", "Word2Vec", "VisionTransformer",
     "ViT_B16", "ViT_S16", "make_cnn_train_step",
+    "BertBase", "BertLarge", "BertMLM", "make_mlm_batch",
+    "make_mlm_train_step", "mlm_loss",
     "TransformerLM", "generate", "init_lm_state", "lm_fsdp_specs",
     "make_lm_eval_step", "make_lm_train_step",
 ]

@@ -1,0 +1,173 @@
+"""BERT-style masked-LM (Devlin et al. 2019) — encoder pretraining.
+
+No reference equivalent (Horovod v0.10 predates BERT; SURVEY §2.3 —
+its model surface is the tf_cnn_benchmarks CNNs). This completes the
+model zoo's pretraining objectives: causal LM (`TransformerLM`),
+image classification (CNNs/`VisionTransformer`), embeddings
+(`word2vec`), and now bidirectional masked-LM — all on the SAME
+TP/SP-composable `TransformerBlock`s, so every parallelism axis and
+attention kernel of the flagship LM applies unchanged
+(`causal=False`, like the ViT encoder).
+
+TPU notes:
+* The MLM loss reduces ONLY masked positions, but as a dense
+  `where`-weighted cross entropy over the full [B, S] grid — no
+  gather/dynamic shapes, so XLA keeps one static program and the MXU
+  sees the full [B*S, d] @ [d, V] head matmul (masked rows are free
+  relative to a ragged gather on TPU).
+* Tied embedding/head, vocab shardable over ``model`` exactly like
+  `TransformerLM`'s (the `nn.with_partitioning` annotation).
+* `make_mlm_batch` implements the standard 80/10/10 corruption rule
+  as pure jax (jit/vmap-safe, one PRNG key in).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import flax.linen as nn
+
+from horovod_tpu.models.transformer import TransformerBlock
+from horovod_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ, constrain
+
+Dtype = Any
+
+
+class BertMLM(nn.Module):
+    """Bidirectional encoder with a tied masked-LM head.
+
+    Input [B, S] int tokens -> [B, S, V] logits (every position; the
+    loss selects masked ones). ``segment_ids`` (optional [B, S] in
+    {0, 1}) adds the sentence-pair embedding of the original
+    pretraining setup.
+    """
+
+    vocab_size: int
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    max_len: int = 512
+    mlp_ratio: int = 4
+    num_segments: int = 2
+    dtype: Optional[Dtype] = jnp.bfloat16
+    attn_impl: str = "blockwise"
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array,
+                 segment_ids: Optional[jax.Array] = None) -> jax.Array:
+        B, S = tokens.shape
+        d = self.num_heads * self.head_dim
+        embed = self.param(
+            "embed",
+            nn.with_partitioning(nn.initializers.normal(0.02),
+                                 (AXIS_MODEL, None)),
+            (self.vocab_size, d), jnp.float32)
+        pos = self.param("pos", nn.initializers.normal(0.02),
+                         (self.max_len, d), jnp.float32)
+        x = jnp.take(embed, tokens, axis=0) + pos[:S]
+        if segment_ids is not None:
+            seg = self.param("segment", nn.initializers.normal(0.02),
+                             (self.num_segments, d), jnp.float32)
+            x = x + jnp.take(seg, segment_ids, axis=0)
+        x = x.astype(self.dtype)
+        x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
+
+        block = partial(TransformerBlock,
+                        num_heads=self.num_heads,
+                        head_dim=self.head_dim,
+                        mlp_ratio=self.mlp_ratio,
+                        dtype=self.dtype,
+                        attn_impl=self.attn_impl,
+                        causal=False)
+        for i in range(self.num_layers):
+            x = block(name=f"block_{i}")(x)
+            x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        # Tied MLM head (the BERT transform layer folded away: one
+        # matmul against the embedding — vocab sharded over `model`).
+        logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(self.dtype))
+        return constrain(logits, AXIS_DATA, AXIS_SEQ, AXIS_MODEL)
+
+
+def make_mlm_batch(rng: jax.Array, tokens: jax.Array, *,
+                   vocab_size: int, mask_id: int,
+                   mask_rate: float = 0.15
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """The 80/10/10 corruption rule, dense and jit-safe.
+
+    Selects ~``mask_rate`` of positions; of those, 80 % become
+    ``mask_id``, 10 % a uniform random token, 10 % stay themselves.
+    Returns ``(corrupted_tokens, is_target [B, S] bool)`` — the loss
+    reduces over ``is_target`` (which marks ALL selected positions,
+    including the kept ones, per the paper).
+    """
+    k_sel, k_op, k_rand = jax.random.split(rng, 3)
+    sel = jax.random.uniform(k_sel, tokens.shape) < mask_rate
+    op = jax.random.uniform(k_op, tokens.shape)
+    rand_tok = jax.random.randint(k_rand, tokens.shape, 0, vocab_size)
+    corrupted = jnp.where(op < 0.8, mask_id,
+                          jnp.where(op < 0.9, rand_tok, tokens))
+    return jnp.where(sel, corrupted, tokens), sel
+
+
+def mlm_loss(logits: jax.Array, targets: jax.Array,
+             is_target: jax.Array) -> jax.Array:
+    """Masked cross entropy: mean over target positions only, computed
+    densely (a `where` weight, no gather) so the program stays static
+    for XLA — the zoo's shared CE numerics (optax). ``targets`` are
+    the ORIGINAL tokens."""
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets)
+    w = is_target.astype(jnp.float32)
+    return (ce * w).sum() / jnp.maximum(w.sum(), 1.0)
+
+
+def make_mlm_train_step(model: BertMLM, tx, mesh, *,
+                        mask_id: Optional[int] = None,
+                        mask_rate: float = 0.15):
+    """Jitted MLM pretraining step over the mesh: corrupt -> forward ->
+    masked CE -> grads (GSPMD inserts the DP psum / TP collectives from
+    the shardings, exactly as in `make_lm_train_step`).
+
+    ``mask_id`` defaults to the LAST vocab id — fine for synthetic
+    corpora; a real tokenizer should pass its dedicated [MASK] id so
+    genuine occurrences of the last token are not conflated with
+    masked positions. ``mask_rate`` is the paper's 15 % by default.
+    """
+    from horovod_tpu.parallel.mesh import use
+    from horovod_tpu.parallel.tensor import unbox
+
+    mid = model.vocab_size - 1 if mask_id is None else mask_id
+
+    def step(params, opt_state, tokens, rng):
+        def loss_fn(p):
+            corrupted, sel = make_mlm_batch(
+                rng, tokens, vocab_size=model.vocab_size,
+                mask_id=mid, mask_rate=mask_rate)
+            logits = model.apply({"params": p}, corrupted)
+            return mlm_loss(logits, tokens, sel)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # Donate params/opt_state like make_lm_train_step: the old state
+    # buffers are dead after the update — without donation BERT-Large
+    # + Adam would hold both generations live every step.
+    jitted = jax.jit(step, donate_argnums=(0, 1))
+
+    def run(params, opt_state, tokens, rng):
+        with use(mesh):
+            return jitted(params, opt_state, tokens, rng)
+    return run
+
+
+# BERT-Base / BERT-Large (Devlin et al. 2019).
+BertBase = partial(BertMLM, num_layers=12, num_heads=12, head_dim=64)
+BertLarge = partial(BertMLM, num_layers=24, num_heads=16, head_dim=64)
