@@ -362,6 +362,58 @@ def run_decode(args, devices, n_chips, log):
             "weight_quant": args.weight_quant}
 
 
+def run_bert(args, devices, n_chips, log):
+    """BERT-MLM pretraining throughput (tokens/sec/chip): the masked-
+    LM objective on the shared encoder blocks (`models/bert.py`) —
+    corrupt + forward + masked CE + grads per step."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from horovod_tpu.models.bert import BertMLM, make_mlm_train_step
+    from horovod_tpu.models.transformer import init_lm_state
+    from horovod_tpu.parallel.mesh import make_mesh, shard_batch
+
+    mesh = make_mesh(devices=devices, data=n_chips)
+    model = BertMLM(
+        vocab_size=32768, num_layers=args.layers,
+        num_heads=args.heads, head_dim=args.head_dim,
+        max_len=args.seq, dtype=jnp.bfloat16,
+        attn_impl=args.attn_impl)
+    toks = np.random.RandomState(0).randint(
+        0, 32768, (args.batch * n_chips, args.seq)).astype(np.int32)
+    tx = optax.adamw(3e-4)
+    # Same (rng, tokens) init signature as the LM, so the LM's state
+    # factory applies: params AND optimizer slots land sharded.
+    params, opt_state = init_lm_state(
+        model, tx, jax.random.PRNGKey(0), mesh, toks)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(params))
+    log(f"bert: {n_params / 1e6:.1f}M params, seq={args.seq}, "
+        f"global batch={args.batch * n_chips}")
+    step = make_mlm_train_step(model, tx, mesh)
+    toks_sh = shard_batch(mesh, toks)
+    rng = jax.random.PRNGKey(1)
+
+    def b_step(state, batch, _):
+        params, opt_state = state
+        params, opt_state, loss = step(params, opt_state, batch, rng)
+        return (params, opt_state), loss
+
+    _, _, dt, _ = time_steps(b_step, (params, opt_state), toks_sh,
+                             None, args.steps, args.warmup,
+                             profile_dir=args.profile)
+    tokens = args.steps * args.batch * n_chips * args.seq
+    d_model = args.heads * args.head_dim
+    # 6N matmul + full (bidirectional) attention term 12·L·S·D.
+    flops_per_tok = (6 * n_params
+                     + 12 * args.layers * args.seq * d_model)
+    return {"tok_s_chip": tokens / dt / n_chips,
+            "flops_per_tok": flops_per_tok, "n_params": n_params,
+            "step_ms": dt / args.steps * 1e3}
+
+
 def run_transformer(args, devices, n_chips, log):
     """Flagship transformer-LM throughput: tokens/sec/chip with the
     Pallas flash-attention kernel in the hot path (no reference
@@ -424,7 +476,7 @@ def main():
     ap.add_argument("--model", default=None,
                     choices=["resnet50", "resnet101", "vgg16",
                              "inception3", "vit", "mnist",
-                             "transformer"],
+                             "transformer", "bert"],
                     help="single model to bench; omitted (the driver "
                          "default) = resnet101 plus an --all-models "
                          "pass over the other BASELINE.md models")
@@ -531,12 +583,17 @@ def main():
         args.all_models = True
 
     is_lm = args.model == "transformer"
+    is_bert = args.model == "bert"
     if args.batch is None:
-        args.batch = 8 if is_lm else 128
-    metric = (("transformer_decode_tokens_per_sec_per_chip"
-               if args.decode else "transformer_tokens_per_sec_per_chip")
-              if is_lm else f"{args.model}_images_per_sec_per_chip")
-    unit = "tokens/sec/chip" if is_lm else "images/sec/chip"
+        args.batch = 8 if (is_lm or is_bert) else 128
+    if is_bert:
+        metric, unit = "bert_tokens_per_sec_per_chip", "tokens/sec/chip"
+    else:
+        metric = (("transformer_decode_tokens_per_sec_per_chip"
+                   if args.decode
+                   else "transformer_tokens_per_sec_per_chip")
+                  if is_lm else f"{args.model}_images_per_sec_per_chip")
+        unit = "tokens/sec/chip" if is_lm else "images/sec/chip"
 
     if args.deadline > 0:
         start_deadline_watchdog(metric, unit, args.deadline)
@@ -785,9 +842,35 @@ def _bench_body(args, devices, n_chips, metric, unit,
     flash_ms, flash_err = _FLASH_DONE.get("result", (None, None))
 
     is_lm = args.model == "transformer"
-    if is_lm and args.all_models:
+    if (is_lm or args.model == "bert") and args.all_models:
         log("--all-models applies to CNN primaries only; "
-            "ignored with --model transformer")
+            f"ignored with --model {args.model}")
+    if args.model == "bert" and args.decode:
+        log("--decode applies to the causal LM only; ignored with "
+            "--model bert (BertMLM has no autoregressive cache)")
+    if args.model == "bert":
+        r = run_bert(args, devices, n_chips, log)
+        peak = PEAK_BF16.get(device_kind)
+        _set_best({
+            "metric": metric,
+            "value": round(r["tok_s_chip"], 1),
+            "unit": unit,
+            "vs_baseline": None,  # no MLM in the reference (2017)
+            "platform": platform,
+            "device_kind": device_kind,
+            "chips": n_chips,
+            "per_chip_batch": args.batch,
+            "seq": args.seq,
+            "params_m": round(r["n_params"] / 1e6, 1),
+            "step_ms": round(r["step_ms"], 1),
+            "attn_impl": args.attn_impl,
+            "mfu_estimate": round(
+                r["tok_s_chip"] * r["flops_per_tok"] / peak, 4)
+            if peak else None,
+            "overlap_measured": _measured_overlap(args),
+        })
+        emit(_BEST_RESULT)
+        return
     if is_lm and args.decode:
         r = run_decode(args, devices, n_chips, log)
         _set_best({
