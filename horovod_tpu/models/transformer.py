@@ -177,6 +177,8 @@ class TransformerBlock(nn.Module):
     kv_quant: Optional[str] = None       # None | "int8" (decode cache)
     flash_block_q: int = 128             # Pallas flash tile sizes
     flash_block_k: int = 128
+    attn_bias: bool = False              # GPT-2-family checkpoints
+    ln_eps: float = 1e-6
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -204,7 +206,8 @@ class TransformerBlock(nn.Module):
             S = x.shape[-2]
             pos = jnp.arange(S)
             mask = banded_causal_mask(pos, pos, self.window)[None, None]
-        h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
+        h = nn.LayerNorm(dtype=self.dtype, epsilon=self.ln_eps,
+                         name="ln_attn")(x)
         h = ParallelSelfAttention(
             num_heads=self.num_heads, head_dim=self.head_dim,
             num_kv_heads=self.num_kv_heads, pos_emb=self.pos_emb,
@@ -213,9 +216,11 @@ class TransformerBlock(nn.Module):
             chunked_prefill=self.chunked_prefill,
             weight_quant=self.weight_quant,
             kv_quant=self.kv_quant,
+            use_bias=self.attn_bias,
             name="attn")(h, mask)
         x = x + h
-        h = nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x)
+        h = nn.LayerNorm(dtype=self.dtype, epsilon=self.ln_eps,
+                         name="ln_mlp")(x)
         if self.moe:
             h = MoELayer(num_experts=self.num_experts,
                          hidden=self.mlp_ratio * d, k=self.moe_k,
@@ -267,6 +272,8 @@ class TransformerLM(nn.Module):
     kv_quant: Optional[str] = None
     flash_block_q: int = 128   # Pallas flash tile sizes (bench-sweepable)
     flash_block_k: int = 128
+    attn_bias: bool = False    # attention projection biases (GPT-2)
+    ln_eps: float = 1e-6       # LayerNorm epsilon (GPT-2: 1e-5)
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
@@ -324,10 +331,12 @@ class TransformerLM(nn.Module):
                 kv_quant=self.kv_quant,
                 flash_block_q=self.flash_block_q,
                 flash_block_k=self.flash_block_k,
+                attn_bias=self.attn_bias, ln_eps=self.ln_eps,
                 name=f"block_{i}")(x)
             x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
 
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        x = nn.LayerNorm(dtype=self.dtype, epsilon=self.ln_eps,
+                         name="ln_f")(x)
         if return_hidden:
             # For the chunked fused head+loss (`chunked_lm_loss`): the
             # [B, S, V] logits never materialize.
@@ -355,6 +364,8 @@ class TransformerBlockStack(nn.Module):
     mlp_ratio: int = 4
     dtype: Optional[Dtype] = jnp.bfloat16
     attn_impl: str = "blockwise"
+    attn_bias: bool = False
+    ln_eps: float = 1e-6
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -365,7 +376,9 @@ class TransformerBlockStack(nn.Module):
                 pos_emb=self.pos_emb, rope_theta=self.rope_theta,
                 window=self.window,
                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
-                attn_impl=self.attn_impl, name=f"block_{i}")(x)
+                attn_impl=self.attn_impl,
+                attn_bias=self.attn_bias, ln_eps=self.ln_eps,
+                name=f"block_{i}")(x)
         return x
 
 

@@ -330,6 +330,9 @@ class ParallelSelfAttention(nn.Module):
     # quantized at cache-write time and dequantized at the module
     # dtype on read. Decode-mode only; ignored when decode=False.
     kv_quant: Optional[str] = None
+    # Projections carry no bias by default (LLaMA-style); GPT-2-family
+    # checkpoints (compat.hf) need them.
+    use_bias: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -344,7 +347,7 @@ class ParallelSelfAttention(nn.Module):
         features = H * self.head_dim
         kv_features = Hkv * self.head_dim
         qkv = ColumnParallelDense(features + 2 * kv_features,
-                                  use_bias=False,
+                                  use_bias=self.use_bias,
                                   weight_quant=self.weight_quant,
                                   dtype=self.dtype, name="qkv")(x)
         q = qkv[..., :features]
@@ -378,7 +381,7 @@ class ParallelSelfAttention(nn.Module):
         else:
             o = constrain(o, AXIS_DATA, *([None] * (o.ndim - 3)),
                           AXIS_SEQ, AXIS_MODEL)
-        return RowParallelDense(features, use_bias=False,
+        return RowParallelDense(features, use_bias=self.use_bias,
                                 weight_quant=self.weight_quant,
                                 dtype=self.dtype, name="out")(o)
 

@@ -1,0 +1,132 @@
+"""HF GPT-2 -> TransformerLM conversion parity (`compat/hf.py`).
+
+Fully offline: the torch reference is a RANDOM-INIT
+`GPT2LMHeadModel(config)` (no hub download) — the oracle is the
+transformers implementation itself running on CPU torch.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from horovod_tpu.compat import from_hf_gpt2  # noqa: E402
+
+
+def _tiny_hf(seed=0, **over):
+    cfg = dict(n_embd=32, n_layer=2, n_head=2, n_positions=64,
+               vocab_size=97, resid_pdrop=0.0, embd_pdrop=0.0,
+               attn_pdrop=0.0)
+    cfg.update(over)
+    torch.manual_seed(seed)
+    m = transformers.GPT2LMHeadModel(transformers.GPT2Config(**cfg))
+    return m.eval()
+
+
+def test_gpt2_logits_match_torch_reference():
+    """Converted weights reproduce the torch implementation's logits
+    (f32, blockwise kernel) within float tolerance."""
+    hf = _tiny_hf()
+    toks = np.random.RandomState(0).randint(0, 97, (2, 17))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(toks)).logits.numpy()
+    model, params = from_hf_gpt2(hf, dtype=jnp.float32,
+                                 attn_impl="blockwise")
+    got = np.asarray(model.apply({"params": params},
+                                 jnp.asarray(toks)), np.float32)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_flash_kernel_on_converted_weights():
+    """The Pallas flash path (interpret on CPU) runs the converted
+    model and matches the blockwise oracle."""
+    hf = _tiny_hf(seed=1, n_head=4, n_embd=64)
+    toks = np.random.RandomState(1).randint(0, 97, (1, 16))
+    base, params = from_hf_gpt2(hf, dtype=jnp.float32,
+                                attn_impl="blockwise")
+    flash = base.clone(attn_impl="flash")
+    a = base.apply({"params": params}, jnp.asarray(toks))
+    b = flash.apply({"params": params}, jnp.asarray(toks))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_greedy_decode_matches_torch_generate():
+    """Token-exact greedy generation: our KV-cache `generate` ==
+    transformers' greedy `generate` on the same weights."""
+    from horovod_tpu.models.transformer import generate
+    hf = _tiny_hf(seed=2)
+    prompt = np.random.RandomState(2).randint(0, 97, (2, 5))
+    with torch.no_grad():
+        want = hf.generate(
+            torch.from_numpy(prompt), max_new_tokens=8, do_sample=False,
+            pad_token_id=0).numpy()
+    model, params = from_hf_gpt2(hf, dtype=jnp.float32,
+                                 attn_impl="blockwise")
+    got = np.asarray(generate(model, params, prompt, steps=8))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_gpt2_tp_sharding_of_converted_tree():
+    """The converted tree TP-shards through the standard path and
+    matches the replicated apply."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu.parallel.mesh import make_mesh, use
+    from horovod_tpu.parallel.tensor import param_specs, shard_params
+    # vocab divisible by the model axis: the embed is vocab-sharded,
+    # so odd vocabs (like real GPT-2's 50257) need padding first —
+    # see the compat.hf docstring.
+    hf = _tiny_hf(seed=3, n_head=4, n_embd=64, vocab_size=96)
+    toks = np.random.RandomState(3).randint(0, 96, (4, 12))
+    model, params = from_hf_gpt2(hf, dtype=jnp.float32,
+                                 attn_impl="blockwise")
+    ref = model.apply({"params": params}, jnp.asarray(toks))
+    # Re-box via init metadata so shard_params sees the annotations.
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(toks))
+    import flax.linen as nn
+    boxed = jax.tree.map(
+        lambda meta, val: (meta.replace_boxed(jnp.asarray(val))
+                           if isinstance(meta, nn.meta.AxisMetadata)
+                           else jnp.asarray(val)),
+        variables["params"], params,
+        is_leaf=lambda x: isinstance(x, nn.meta.AxisMetadata))
+    mesh = make_mesh(data=2, model=2, seq=2)
+    with use(mesh):
+        sharded = shard_params(mesh, boxed)
+        ts = jax.device_put(jnp.asarray(toks),
+                            NamedSharding(mesh, P("data")))
+        out = jax.jit(lambda p, t: model.apply({"params": p}, t))(
+            sharded, ts)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_rejects_unsupported_activation():
+    with pytest.raises(ValueError, match="activation"):
+        from_hf_gpt2(_tiny_hf(activation_function="relu"))
+    # HF's plain "gelu" is the EXACT erf form — not parity-safe.
+    with pytest.raises(ValueError, match="activation"):
+        from_hf_gpt2(_tiny_hf(activation_function="gelu"))
+
+
+def test_rejects_math_changing_config_knobs():
+    with pytest.raises(ValueError, match="scale_attn_weights"):
+        from_hf_gpt2(_tiny_hf(scale_attn_weights=False))
+    with pytest.raises(ValueError, match="n_inner"):
+        from_hf_gpt2(_tiny_hf(n_inner=48))   # not a multiple of 32
+    # a clean non-4x ratio converts (mlp_ratio follows n_inner)
+    hf = _tiny_hf(seed=5, n_inner=64)
+    model, params = from_hf_gpt2(hf, dtype=None)
+    assert model.mlp_ratio == 2
+    toks = np.random.RandomState(5).randint(0, 97, (1, 9))
+    import torch as _torch
+    with _torch.no_grad():
+        want = hf(_torch.from_numpy(toks)).logits.numpy()
+    got = np.asarray(model.clone(dtype=jnp.float32).apply(
+        {"params": params}, jnp.asarray(toks)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
