@@ -1,6 +1,6 @@
 """Checkpoint interop: load external pretrained weights into the
-TPU-native model zoo (`compat.hf.from_hf_gpt2`)."""
+TPU-native model zoo (`compat.hf.from_hf_gpt2` / `from_hf_llama`)."""
 
-from horovod_tpu.compat.hf import from_hf_gpt2
+from horovod_tpu.compat.hf import from_hf_gpt2, from_hf_llama
 
-__all__ = ["from_hf_gpt2"]
+__all__ = ["from_hf_gpt2", "from_hf_llama"]

@@ -133,3 +133,91 @@ def from_hf_gpt2(hf_model: Any, *, dtype=jnp.bfloat16,
             },
         }
     return model, params
+
+
+def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
+                  attn_impl: str = "flash"
+                  ) -> Tuple[Any, Dict[str, Any]]:
+    """Convert a `transformers.LlamaForCausalLM` into
+    `(TransformerLM, params)` — the modern-LLM interop: RoPE, GQA
+    (consumed natively by the Pallas flash kernel), RMSNorm, SwiGLU
+    MLP, untied head, all mapping onto existing `TransformerLM` knobs.
+
+    Mapping (torch `nn.Linear` stores [out, in] — every kernel is
+    transposed, unlike GPT-2's Conv1D):
+
+        embed_tokens [V, d]          -> embed
+        self_attn.{q,k,v}_proj       -> attn.qkv (concat q|k|v on out;
+                                        K/V at kv-head width — GQA)
+        self_attn.o_proj             -> attn.out
+        input_layernorm              -> ln_attn (RMSNorm: scale only)
+        mlp.{gate,up}_proj           -> mlp.gate_up (fused, gate first)
+        mlp.down_proj                -> mlp.down
+        post_attention_layernorm     -> ln_mlp
+        model.norm                   -> ln_f
+        lm_head [V, d]               -> lm_head  (tied_head=False)
+
+    HF's rotary embedding is the half-split rotation at theta^(-2i/d)
+    — exactly `parallel.tensor.apply_rope`, so positions, caches, and
+    the ring/ulysses SP schedules all apply to converted weights.
+    """
+    from horovod_tpu.models.transformer import TransformerLM
+
+    tr = getattr(hf_model, "model", hf_model)
+    cfg = hf_model.config
+    d = cfg.hidden_size
+    H = cfg.num_attention_heads
+    Hkv = getattr(cfg, "num_key_value_heads", H) or H
+    if d % H:
+        raise ValueError(
+            f"hidden_size={d} not divisible by heads={H}")
+    if getattr(cfg, "hidden_act", "silu") != "silu":
+        raise ValueError(
+            f"unsupported hidden_act {cfg.hidden_act!r} (silu only)")
+    if getattr(cfg, "rope_scaling", None):
+        raise ValueError("rope_scaling is not supported")
+    if getattr(cfg, "attention_bias", False) or getattr(
+            cfg, "mlp_bias", False):
+        raise ValueError(
+            "attention_bias/mlp_bias checkpoints are not supported")
+    head_dim = getattr(cfg, "head_dim", None) or d // H
+    if head_dim != d // H:
+        raise ValueError(
+            f"head_dim={head_dim} != hidden_size/heads={d // H}")
+
+    tied = bool(getattr(cfg, "tie_word_embeddings", False))
+    model = TransformerLM(
+        vocab_size=cfg.vocab_size, num_layers=cfg.num_hidden_layers,
+        num_heads=H, head_dim=head_dim, num_kv_heads=Hkv,
+        max_len=cfg.max_position_embeddings,
+        pos_emb="rope", rope_theta=float(cfg.rope_theta),
+        norm="rmsnorm", mlp_impl="swiglu",
+        mlp_hidden=cfg.intermediate_size, tied_head=tied,
+        ln_eps=float(cfg.rms_norm_eps), dtype=dtype,
+        attn_impl=attn_impl)
+
+    params: Dict[str, Any] = {
+        "embed": _t(tr.embed_tokens.weight),
+        "ln_f": {"scale": _t(tr.norm.weight)},
+    }
+    if not tied:
+        params["lm_head"] = _t(hf_model.lm_head.weight)
+    for i, layer in enumerate(tr.layers):
+        sa, mlp = layer.self_attn, layer.mlp
+        qkv = np.concatenate(
+            [_t(sa.q_proj.weight).T, _t(sa.k_proj.weight).T,
+             _t(sa.v_proj.weight).T], axis=1)
+        params[f"block_{i}"] = {
+            "ln_attn": {"scale": _t(layer.input_layernorm.weight)},
+            "attn": {"qkv": {"kernel": qkv},
+                     "out": {"kernel": _t(sa.o_proj.weight).T}},
+            "ln_mlp": {
+                "scale": _t(layer.post_attention_layernorm.weight)},
+            "mlp": {
+                "gate_up": {"kernel": np.concatenate(
+                    [_t(mlp.gate_proj.weight).T,
+                     _t(mlp.up_proj.weight).T], axis=1)},
+                "down": {"kernel": _t(mlp.down_proj.weight).T},
+            },
+        }
+    return model, params

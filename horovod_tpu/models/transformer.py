@@ -48,7 +48,8 @@ from horovod_tpu.parallel.sequence import (
     ulysses_attention_gspmd,
 )
 from horovod_tpu.parallel.tensor import (
-    ParallelMLP, ParallelSelfAttention, dot_product_attention,
+    ParallelMLP, ParallelSelfAttention, ParallelSwiGLU,
+    dot_product_attention,
     param_specs, shard_params, unbox,
 )
 
@@ -154,6 +155,16 @@ def make_attn_fn(impl: str, *, causal: bool = True,
     raise ValueError(f"attn_impl must be one of {ATTN_IMPLS}, got {impl!r}")
 
 
+def _make_norm(kind: str, dtype, eps: float, name: str):
+    """The block's norm: LayerNorm (GPT family) or RMSNorm (LLaMA
+    family — scale only, no bias/mean-centering)."""
+    if kind == "layernorm":
+        return nn.LayerNorm(dtype=dtype, epsilon=eps, name=name)
+    if kind == "rmsnorm":
+        return nn.RMSNorm(dtype=dtype, epsilon=eps, name=name)
+    raise ValueError(f"norm must be layernorm|rmsnorm, got {kind!r}")
+
+
 class TransformerBlock(nn.Module):
     """Pre-LN transformer block: TP attention + TP MLP (or EP MoE)."""
 
@@ -179,6 +190,9 @@ class TransformerBlock(nn.Module):
     flash_block_k: int = 128
     attn_bias: bool = False              # GPT-2-family checkpoints
     ln_eps: float = 1e-6
+    norm: str = "layernorm"              # "layernorm" | "rmsnorm"
+    mlp_impl: str = "gelu"               # "gelu" | "swiglu" (LLaMA)
+    mlp_hidden: Optional[int] = None     # absolute width (else ratio*d)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -206,8 +220,8 @@ class TransformerBlock(nn.Module):
             S = x.shape[-2]
             pos = jnp.arange(S)
             mask = banded_causal_mask(pos, pos, self.window)[None, None]
-        h = nn.LayerNorm(dtype=self.dtype, epsilon=self.ln_eps,
-                         name="ln_attn")(x)
+        h = _make_norm(self.norm, self.dtype, self.ln_eps,
+                       "ln_attn")(x)
         h = ParallelSelfAttention(
             num_heads=self.num_heads, head_dim=self.head_dim,
             num_kv_heads=self.num_kv_heads, pos_emb=self.pos_emb,
@@ -219,17 +233,27 @@ class TransformerBlock(nn.Module):
             use_bias=self.attn_bias,
             name="attn")(h, mask)
         x = x + h
-        h = nn.LayerNorm(dtype=self.dtype, epsilon=self.ln_eps,
-                         name="ln_mlp")(x)
+        h = _make_norm(self.norm, self.dtype, self.ln_eps,
+                       "ln_mlp")(x)
         if self.moe:
             h = MoELayer(num_experts=self.num_experts,
                          hidden=self.mlp_ratio * d, k=self.moe_k,
                          capacity_factor=self.moe_capacity_factor,
                          dtype=self.dtype, name="moe")(h)
         else:
-            h = ParallelMLP(hidden=self.mlp_ratio * d, out=d,
-                            weight_quant=self.weight_quant,
-                            dtype=self.dtype, name="mlp")(h)
+            hidden = self.mlp_hidden or self.mlp_ratio * d
+            if self.mlp_impl == "swiglu":
+                h = ParallelSwiGLU(hidden=hidden, out=d,
+                                   weight_quant=self.weight_quant,
+                                   dtype=self.dtype, name="mlp")(h)
+            elif self.mlp_impl == "gelu":
+                h = ParallelMLP(hidden=hidden, out=d,
+                                weight_quant=self.weight_quant,
+                                dtype=self.dtype, name="mlp")(h)
+            else:
+                raise ValueError(
+                    f"mlp_impl must be gelu|swiglu, got "
+                    f"{self.mlp_impl!r}")
         return x + h
 
 
@@ -274,6 +298,12 @@ class TransformerLM(nn.Module):
     flash_block_k: int = 128
     attn_bias: bool = False    # attention projection biases (GPT-2)
     ln_eps: float = 1e-6       # LayerNorm epsilon (GPT-2: 1e-5)
+    norm: str = "layernorm"    # "layernorm" | "rmsnorm" (LLaMA)
+    mlp_impl: str = "gelu"     # "gelu" | "swiglu" (LLaMA)
+    mlp_hidden: Optional[int] = None   # absolute MLP width override
+    # False: a separate vocab-sharded lm_head param instead of reusing
+    # the embedding (LLaMA-family default).
+    tied_head: bool = True
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
@@ -332,19 +362,30 @@ class TransformerLM(nn.Module):
                 flash_block_q=self.flash_block_q,
                 flash_block_k=self.flash_block_k,
                 attn_bias=self.attn_bias, ln_eps=self.ln_eps,
+                norm=self.norm, mlp_impl=self.mlp_impl,
+                mlp_hidden=self.mlp_hidden,
                 name=f"block_{i}")(x)
             x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
 
-        x = nn.LayerNorm(dtype=self.dtype, epsilon=self.ln_eps,
-                         name="ln_f")(x)
+        x = _make_norm(self.norm, self.dtype, self.ln_eps,
+                       "ln_f")(x)
+        head = embed
+        if not self.tied_head:
+            head = self.param(
+                "lm_head",
+                nn.with_partitioning(nn.initializers.normal(0.02),
+                                     (AXIS_MODEL, None)),
+                (self.vocab_size, d), jnp.float32)
         if return_hidden:
             # For the chunked fused head+loss (`chunked_lm_loss`): the
-            # [B, S, V] logits never materialize.
-            return x, embed
-        # Tied LM head: logits sharded over ``model`` on vocab; the CE
-        # loss reduces over it with GSPMD-inserted collectives.
+            # [B, S, V] logits never materialize. `head` is the embed
+            # when tied, the separate lm_head otherwise.
+            return x, head
+        # LM head (tied = the embedding): logits sharded over
+        # ``model`` on vocab; the CE loss reduces over it with
+        # GSPMD-inserted collectives.
         logits = jnp.einsum("bsd,vd->bsv", x,
-                            embed.astype(self.dtype))
+                            head.astype(self.dtype))
         return constrain(logits, AXIS_DATA, AXIS_SEQ, AXIS_MODEL)
 
 
@@ -366,6 +407,9 @@ class TransformerBlockStack(nn.Module):
     attn_impl: str = "blockwise"
     attn_bias: bool = False
     ln_eps: float = 1e-6
+    norm: str = "layernorm"
+    mlp_impl: str = "gelu"
+    mlp_hidden: Optional[int] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -378,6 +422,8 @@ class TransformerBlockStack(nn.Module):
                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                 attn_impl=self.attn_impl,
                 attn_bias=self.attn_bias, ln_eps=self.ln_eps,
+                norm=self.norm, mlp_impl=self.mlp_impl,
+                mlp_hidden=self.mlp_hidden,
                 name=f"block_{i}")(x)
         return x
 

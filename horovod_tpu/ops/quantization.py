@@ -9,10 +9,12 @@ per-output-channel float scales halves their HBM traffic; the
 `int8 -> bf16` dequant runs on-chip in VMEM, fused by XLA into the
 consuming matmul's operand read inside the decode `lax.scan` body.
 
-Scope: the Megatron block kernels (attention ``qkv``/``out``, MLP
-``wi``/``wo``) — ~80 % of a dense LM's parameters. Embedding table and
-LayerNorms stay at full precision (the embed doubles as the tied LM
-head, where quantization error lands directly on the logits).
+Scope: the Megatron block kernels — attention ``qkv``/``out``, the
+gelu MLP's ``wi``/``wo``, and the SwiGLU MLP's ``gate_up``/``down``
+(LLaMA family) — ~80 % of a dense LM's parameters. Embedding table,
+LM head (tied OR the separate untied ``lm_head``), and norms stay at
+full precision: head-side quantization error lands directly on the
+logits.
 
 Flow: train (or load) a normal float tree, then
 
@@ -39,8 +41,9 @@ import jax
 import jax.numpy as jnp
 
 # Module names whose 2-D "kernel" params are quantized — the Megatron
-# block pair names used by ParallelSelfAttention / ParallelMLP.
-QUANT_KERNEL_MODULES = ("qkv", "out", "wi", "wo")
+# block pair names used by ParallelSelfAttention / ParallelMLP /
+# ParallelSwiGLU (the LLaMA-family MLP, fused gate|up).
+QUANT_KERNEL_MODULES = ("qkv", "out", "wi", "wo", "gate_up", "down")
 
 
 def quantize_int8(w: jax.Array, axis: int = 0

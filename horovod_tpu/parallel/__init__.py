@@ -40,6 +40,7 @@ from horovod_tpu.parallel.tensor import (
     ColumnParallelDense,
     RowParallelDense,
     ParallelMLP,
+    ParallelSwiGLU,
     ParallelSelfAttention,
     apply_rope,
     dot_product_attention,
@@ -78,6 +79,7 @@ __all__ = [
     "column_parallel_matmul", "row_parallel_matmul",
     "allgather_matmul", "matmul_reducescatter",
     "ColumnParallelDense", "RowParallelDense", "ParallelMLP",
+    "ParallelSwiGLU",
     "ParallelSelfAttention", "apply_rope", "dot_product_attention",
     "param_specs", "shard_params", "unbox",
     "ring_attention", "ring_attention_gspmd", "ulysses_attention",

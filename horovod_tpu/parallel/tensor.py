@@ -280,6 +280,35 @@ class ParallelMLP(nn.Module):
                                 name="wo")(h)
 
 
+class ParallelSwiGLU(nn.Module):
+    """LLaMA-family MLP: `down(silu(gate(x)) * up(x))` — gate|up as
+    ONE fused column-parallel projection (the same single-weight-fetch
+    convention as the fused qkv: one [d, 2·hidden] matmul / one int8
+    kernel read per tick instead of two), down row-parallel; still
+    exactly one all-reduce per block (the row matmul's psum). No
+    biases (the family convention). Gate occupies the first `hidden`
+    output columns — the split boundary is shard-aligned for even TP
+    degrees (and merely costs a GSPMD reshard on odd ones)."""
+
+    hidden: int
+    out: int
+    dtype: Optional[Dtype] = None
+    weight_quant: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        gu = ColumnParallelDense(2 * self.hidden, use_bias=False,
+                                 dtype=self.dtype,
+                                 weight_quant=self.weight_quant,
+                                 name="gate_up")(x)
+        g = gu[..., :self.hidden]
+        u = gu[..., self.hidden:]
+        return RowParallelDense(self.out, use_bias=False,
+                                dtype=self.dtype,
+                                weight_quant=self.weight_quant,
+                                name="down")(nn.silu(g) * u)
+
+
 class ParallelSelfAttention(nn.Module):
     """Multi-head self-attention with heads sharded over ``model``.
 
