@@ -231,6 +231,16 @@ def _profile_ctx(profile_dir):
     return jax.profiler.trace(profile_dir)
 
 
+def _lm_arch_kwargs(args):
+    """The --arch preset's TransformerLM kwargs — one shared source
+    (`models.transformer.LLAMA_ARCH_KW`), consumed by BOTH the train
+    and decode LM benches (pos_emb is resolved separately in main)."""
+    if args.arch == "llama":
+        from horovod_tpu.models.transformer import LLAMA_ARCH_KW
+        return dict(LLAMA_ARCH_KW)
+    return {}
+
+
 def time_steps(step, state, batch, rng, steps, warmup,
                profile_dir=None):
     t0 = time.time()
@@ -322,7 +332,7 @@ def run_decode(args, devices, n_chips, log):
         pos_emb=args.pos_emb, window=args.window,
         head_dim=args.head_dim,
         max_len=args.seq, dtype=jnp.bfloat16,
-        attn_impl=args.attn_impl)
+        attn_impl=args.attn_impl, **_lm_arch_kwargs(args))
     B, P, steps = args.batch, 32, args.decode_steps
     params = unbox(model.init(
         jax.random.PRNGKey(0),
@@ -437,7 +447,7 @@ def run_transformer(args, devices, n_chips, log):
         max_len=args.seq, dtype=jnp.bfloat16,
         attn_impl=args.attn_impl, remat=args.remat,
         flash_block_q=args.flash_block_q,
-        flash_block_k=args.flash_block_k)
+        flash_block_k=args.flash_block_k, **_lm_arch_kwargs(args))
     toks = np.random.RandomState(0).randint(
         0, 32768, (args.batch * n_chips, args.seq))
     params, opt_state = init_lm_state(
@@ -531,8 +541,9 @@ def main():
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--kv-heads", type=int, default=None,
                     help="GQA: fewer K/V heads (shrinks the KV cache)")
-    ap.add_argument("--pos-emb", default="learned",
-                    choices=["learned", "rope"])
+    ap.add_argument("--pos-emb", default=None,
+                    choices=["learned", "rope"],
+                    help="default: learned (gpt arch) / rope (llama)")
     ap.add_argument("--window", type=int, default=None,
                     help="sliding-window attention span")
     # head_dim 128 fills the MXU lanes — measured 1.56x over 64.
@@ -566,6 +577,10 @@ def main():
     ap.add_argument("--kv-quant", default=None, choices=["int8"],
                     help="int8 decode KV cache (per-(position, head) "
                          "scales; 2x context per byte of cache HBM)")
+    ap.add_argument("--arch", default="gpt", choices=["gpt", "llama"],
+                    help="LM architecture preset: gpt (LayerNorm/gelu/"
+                         "tied head) or llama (RMSNorm/fused SwiGLU/"
+                         "untied head, RoPE default)")
     ap.add_argument("--flash-block-q", type=int, default=128,
                     help="Pallas flash kernel q-tile (LM, "
                          "--attn-impl flash only; sweep on hardware "
@@ -586,6 +601,17 @@ def main():
     is_bert = args.model == "bert"
     if args.batch is None:
         args.batch = 8 if (is_lm or is_bert) else 128
+    # Resolve the --arch preset ONCE: only the causal LM (train and
+    # decode) honors it; anything else must fail loudly, not record a
+    # preset it never applied.
+    if args.arch != "gpt" and not is_lm:
+        fail("bert_tokens_per_sec_per_chip" if is_bert else
+             f"{args.model}_images_per_sec_per_chip",
+             "tokens/sec/chip" if is_bert else "images/sec/chip",
+             "bad_arguments",
+             f"--arch {args.arch} applies to --model transformer only")
+    if args.pos_emb is None:
+        args.pos_emb = "rope" if args.arch == "llama" else "learned"
     if is_bert:
         metric, unit = "bert_tokens_per_sec_per_chip", "tokens/sec/chip"
     else:
@@ -864,6 +890,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "params_m": round(r["n_params"] / 1e6, 1),
             "step_ms": round(r["step_ms"], 1),
             "attn_impl": args.attn_impl,
+            "arch": args.arch,
             "mfu_estimate": round(
                 r["tok_s_chip"] * r["flops_per_tok"] / peak, 4)
             if peak else None,
@@ -888,6 +915,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "decode_steps": args.decode_steps,
             "weight_quant": args.weight_quant,
             "kv_quant": args.kv_quant,
+            "arch": args.arch,
             "overlap_measured": _measured_overlap(args),
         })
         emit(_BEST_RESULT)
@@ -908,6 +936,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "params_m": round(r["n_params"] / 1e6, 1),
             "step_ms": round(r["step_ms"], 1),
             "attn_impl": args.attn_impl,
+            "arch": args.arch,
             "mfu_estimate": round(
                 r["tok_s_chip"] * r["flops_per_tok"] / peak, 4)
             if peak else None,

@@ -151,8 +151,7 @@ def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
                                         K/V at kv-head width — GQA)
         self_attn.o_proj             -> attn.out
         input_layernorm              -> ln_attn (RMSNorm: scale only)
-        mlp.{gate,up}_proj           -> mlp.gate_up (fused, gate first)
-        mlp.down_proj                -> mlp.down
+        mlp.{gate,up,down}_proj      -> mlp.{gate,up,down}
         post_attention_layernorm     -> ln_mlp
         model.norm                   -> ln_f
         lm_head [V, d]               -> lm_head  (tied_head=False)
@@ -185,16 +184,17 @@ def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
         raise ValueError(
             f"head_dim={head_dim} != hidden_size/heads={d // H}")
 
+    from horovod_tpu.models.transformer import LLAMA_ARCH_KW
     tied = bool(getattr(cfg, "tie_word_embeddings", False))
+    arch_kw = dict(LLAMA_ARCH_KW, tied_head=tied)
     model = TransformerLM(
         vocab_size=cfg.vocab_size, num_layers=cfg.num_hidden_layers,
         num_heads=H, head_dim=head_dim, num_kv_heads=Hkv,
         max_len=cfg.max_position_embeddings,
         pos_emb="rope", rope_theta=float(cfg.rope_theta),
-        norm="rmsnorm", mlp_impl="swiglu",
-        mlp_hidden=cfg.intermediate_size, tied_head=tied,
+        mlp_hidden=cfg.intermediate_size,
         ln_eps=float(cfg.rms_norm_eps), dtype=dtype,
-        attn_impl=attn_impl)
+        attn_impl=attn_impl, **arch_kw)
 
     params: Dict[str, Any] = {
         "embed": _t(tr.embed_tokens.weight),
@@ -214,9 +214,8 @@ def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
             "ln_mlp": {
                 "scale": _t(layer.post_attention_layernorm.weight)},
             "mlp": {
-                "gate_up": {"kernel": np.concatenate(
-                    [_t(mlp.gate_proj.weight).T,
-                     _t(mlp.up_proj.weight).T], axis=1)},
+                "gate": {"kernel": _t(mlp.gate_proj.weight).T},
+                "up": {"kernel": _t(mlp.up_proj.weight).T},
                 "down": {"kernel": _t(mlp.down_proj.weight).T},
             },
         }

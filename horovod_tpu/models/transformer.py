@@ -58,6 +58,12 @@ Dtype = Any
 ATTN_IMPLS = ("dot", "blockwise", "flash", "ring", "ring_flash",
               "ulysses", "ulysses_flash")
 
+# The LLaMA-family knob set — single source for `compat.hf.from_hf_llama`,
+# `bench.py --arch llama`, and the driver dryrun's llama leg, so the
+# three can never silently diverge.
+LLAMA_ARCH_KW = dict(norm="rmsnorm", mlp_impl="swiglu",
+                     tied_head=False)
+
 
 def make_attn_fn(impl: str, *, causal: bool = True,
                  block_size: int = 512,

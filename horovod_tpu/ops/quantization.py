@@ -10,8 +10,8 @@ per-output-channel float scales halves their HBM traffic; the
 consuming matmul's operand read inside the decode `lax.scan` body.
 
 Scope: the Megatron block kernels — attention ``qkv``/``out``, the
-gelu MLP's ``wi``/``wo``, and the SwiGLU MLP's ``gate_up``/``down``
-(LLaMA family) — ~80 % of a dense LM's parameters. Embedding table,
+gelu MLP's ``wi``/``wo``, and the SwiGLU MLP's ``gate``/``up``/
+``down`` (LLaMA family) — ~80 % of a dense LM's parameters. Embedding table,
 LM head (tied OR the separate untied ``lm_head``), and norms stay at
 full precision: head-side quantization error lands directly on the
 logits.
@@ -42,8 +42,8 @@ import jax.numpy as jnp
 
 # Module names whose 2-D "kernel" params are quantized — the Megatron
 # block pair names used by ParallelSelfAttention / ParallelMLP /
-# ParallelSwiGLU (the LLaMA-family MLP, fused gate|up).
-QUANT_KERNEL_MODULES = ("qkv", "out", "wi", "wo", "gate_up", "down")
+# ParallelSwiGLU (the LLaMA-family MLP).
+QUANT_KERNEL_MODULES = ("qkv", "out", "wi", "wo", "gate", "up", "down")
 
 
 def quantize_int8(w: jax.Array, axis: int = 0

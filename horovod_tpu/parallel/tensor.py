@@ -281,14 +281,18 @@ class ParallelMLP(nn.Module):
 
 
 class ParallelSwiGLU(nn.Module):
-    """LLaMA-family MLP: `down(silu(gate(x)) * up(x))` — gate|up as
-    ONE fused column-parallel projection (the same single-weight-fetch
-    convention as the fused qkv: one [d, 2·hidden] matmul / one int8
-    kernel read per tick instead of two), down row-parallel; still
-    exactly one all-reduce per block (the row matmul's psum). No
-    biases (the family convention). Gate occupies the first `hidden`
-    output columns — the split boundary is shard-aligned for even TP
-    degrees (and merely costs a GSPMD reshard on odd ones)."""
+    """LLaMA-family MLP: `down(silu(gate(x)) * up(x))` — gate and up
+    column-parallel, down row-parallel; exactly one all-reduce per
+    block (the row matmul's psum), same as `ParallelMLP`. No biases
+    (the family convention).
+
+    Gate and up are deliberately SEPARATE projections, not a fused
+    [d, 2·hidden] kernel: a gate-first fused layout puts gate columns
+    on the first half of the TP shards and up columns on the second,
+    so the elementwise `silu(g) * u` would force a per-block GSPMD
+    reshard under tensor parallelism. Two same-LHS matmuls stay
+    shard-local (and XLA's dot-merger may still combine them on a
+    single device)."""
 
     hidden: int
     out: int
@@ -297,12 +301,14 @@ class ParallelSwiGLU(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        gu = ColumnParallelDense(2 * self.hidden, use_bias=False,
-                                 dtype=self.dtype,
-                                 weight_quant=self.weight_quant,
-                                 name="gate_up")(x)
-        g = gu[..., :self.hidden]
-        u = gu[..., self.hidden:]
+        g = ColumnParallelDense(self.hidden, use_bias=False,
+                                dtype=self.dtype,
+                                weight_quant=self.weight_quant,
+                                name="gate")(x)
+        u = ColumnParallelDense(self.hidden, use_bias=False,
+                                dtype=self.dtype,
+                                weight_quant=self.weight_quant,
+                                name="up")(x)
         return RowParallelDense(self.out, use_bias=False,
                                 dtype=self.dtype,
                                 weight_quant=self.weight_quant,

@@ -188,9 +188,9 @@ def test_llama_int8_serving_composes():
     model, params = from_hf_llama(hf, dtype=jnp.float32,
                                   attn_impl="blockwise")
     qtree = quantize_lm_params(params)
-    # every block matmul (incl. fused gate_up and down) quantized
+    # every block matmul (incl. gate/up/down) actually quantized
     b0 = qtree["block_0"]["mlp"]
-    assert all("kernel_q" in b0[k] for k in ("gate_up", "down"))
+    assert all("kernel_q" in b0[k] for k in ("gate", "up", "down"))
     got = generate(model.clone(weight_quant="int8"), qtree,
                    prompt, steps=6)
     want = generate(model, dequantize_lm_params(qtree),
