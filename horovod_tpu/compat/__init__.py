@@ -1,6 +1,8 @@
 """Checkpoint interop: load external pretrained weights into the
-TPU-native model zoo (`compat.hf.from_hf_gpt2` / `from_hf_llama`)."""
+TPU-native model zoo (`compat.hf.from_hf_gpt2` / `from_hf_llama` /
+`from_hf_mistral`)."""
 
-from horovod_tpu.compat.hf import from_hf_gpt2, from_hf_llama
+from horovod_tpu.compat.hf import (from_hf_gpt2, from_hf_llama,
+                                   from_hf_mistral)
 
-__all__ = ["from_hf_gpt2", "from_hf_llama"]
+__all__ = ["from_hf_gpt2", "from_hf_llama", "from_hf_mistral"]

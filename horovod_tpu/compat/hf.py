@@ -187,11 +187,15 @@ def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
     from horovod_tpu.models.transformer import LLAMA_ARCH_KW
     tied = bool(getattr(cfg, "tie_word_embeddings", False))
     arch_kw = dict(LLAMA_ARCH_KW, tied_head=tied)
+    # Mistral = the LLaMA mapping + sliding-window attention; the
+    # band semantics match ours exactly (keep i-j < window).
+    window = getattr(cfg, "sliding_window", None)
     model = TransformerLM(
         vocab_size=cfg.vocab_size, num_layers=cfg.num_hidden_layers,
         num_heads=H, head_dim=head_dim, num_kv_heads=Hkv,
         max_len=cfg.max_position_embeddings,
         pos_emb="rope", rope_theta=float(cfg.rope_theta),
+        window=window,
         mlp_hidden=cfg.intermediate_size,
         ln_eps=float(cfg.rms_norm_eps), dtype=dtype,
         attn_impl=attn_impl, **arch_kw)
@@ -220,3 +224,15 @@ def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
             },
         }
     return model, params
+
+
+def from_hf_mistral(hf_model: Any, *, dtype=jnp.bfloat16,
+                    attn_impl: str = "flash"
+                    ) -> Tuple[Any, Dict[str, Any]]:
+    """Convert a `transformers.MistralForCausalLM`: the LLaMA-family
+    mapping plus sliding-window attention — `config.sliding_window`
+    lands on `TransformerLM.window`, whose band rule (keep
+    `i - j < window`) matches HF's sliding mask exactly, and whose
+    decode cache becomes the O(window) rolling buffer. State-dict
+    layout is identical to LLaMA's, so the same converter applies."""
+    return from_hf_llama(hf_model, dtype=dtype, attn_impl=attn_impl)
