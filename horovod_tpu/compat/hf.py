@@ -236,3 +236,138 @@ def from_hf_mistral(hf_model: Any, *, dtype=jnp.bfloat16,
     decode cache becomes the O(window) rolling buffer. State-dict
     layout is identical to LLaMA's, so the same converter applies."""
     return from_hf_llama(hf_model, dtype=dtype, attn_impl=attn_impl)
+
+
+def _lin(t) -> Any:
+    """Any array-like (incl. bf16 jax arrays — torch can't wrap
+    ml_dtypes) → contiguous f32 torch tensor; `copy_` recasts to the
+    target param's dtype."""
+    import torch
+    return torch.from_numpy(
+        np.ascontiguousarray(np.asarray(t, np.float32)))
+
+
+def to_hf_gpt2(model: Any, params: Dict[str, Any], hf_model: Any) -> Any:
+    """Write a `TransformerLM` tree (GPT-2 layout: learned positions,
+    LayerNorm, gelu MLP, biases, tied head) back into a
+    `transformers.GPT2LMHeadModel` — the EXPORT side of the interop:
+    a model trained/tuned here re-enters the HF ecosystem. The target
+    `hf_model` supplies the architecture (build it from a matching
+    `GPT2Config`); weights are overwritten in place and the model is
+    returned. Round-trip parity is oracle-tested
+    (`tests/test_hf_compat.py`)."""
+    import torch
+
+    tr = hf_model.transformer
+    cfg = hf_model.config
+    n_blocks = sum(1 for k in params if k.startswith("block_"))
+    if (cfg.n_layer != n_blocks
+            or cfg.vocab_size != params["embed"].shape[0]
+            or cfg.n_embd != params["embed"].shape[1]
+            or cfg.n_positions != params["pos"].shape[0]):
+        raise ValueError(
+            f"target GPT2 shell (layers={cfg.n_layer}, "
+            f"vocab={cfg.vocab_size}, d={cfg.n_embd}, "
+            f"pos={cfg.n_positions}) does not match the tree "
+            f"(blocks={n_blocks}, embed={params['embed'].shape}, "
+            f"pos={params['pos'].shape[0]}) — a mismatched shell "
+            "would silently export a different model")
+    with torch.no_grad():
+        tr.wte.weight.copy_(_lin(params["embed"]))
+        tr.wpe.weight.copy_(_lin(params["pos"]))
+        tr.ln_f.weight.copy_(_lin(params["ln_f"]["scale"]))
+        tr.ln_f.bias.copy_(_lin(params["ln_f"]["bias"]))
+        for i, h in enumerate(tr.h):
+            b = params[f"block_{i}"]
+            h.ln_1.weight.copy_(_lin(b["ln_attn"]["scale"]))
+            h.ln_1.bias.copy_(_lin(b["ln_attn"]["bias"]))
+            h.attn.c_attn.weight.copy_(
+                _lin(b["attn"]["qkv"]["kernel"]))
+            h.attn.c_attn.bias.copy_(
+                _lin(b["attn"]["qkv"]["bias"]))
+            h.attn.c_proj.weight.copy_(
+                _lin(b["attn"]["out"]["kernel"]))
+            h.attn.c_proj.bias.copy_(
+                _lin(b["attn"]["out"]["bias"]))
+            h.ln_2.weight.copy_(_lin(b["ln_mlp"]["scale"]))
+            h.ln_2.bias.copy_(_lin(b["ln_mlp"]["bias"]))
+            h.mlp.c_fc.weight.copy_(
+                _lin(b["mlp"]["wi"]["kernel"]))
+            h.mlp.c_fc.bias.copy_(
+                _lin(b["mlp"]["wi"]["bias"]))
+            h.mlp.c_proj.weight.copy_(
+                _lin(b["mlp"]["wo"]["kernel"]))
+            h.mlp.c_proj.bias.copy_(
+                _lin(b["mlp"]["wo"]["bias"]))
+        hf_model.lm_head.weight.copy_(
+            _lin(params["embed"]))  # tied
+    return hf_model
+
+
+def to_hf_llama(model: Any, params: Dict[str, Any], hf_model: Any) -> Any:
+    """Write a LLaMA-layout `TransformerLM` tree (RMSNorm, SwiGLU,
+    RoPE, GQA, untied head) back into a
+    `transformers.LlamaForCausalLM` / `MistralForCausalLM` — inverse
+    of `from_hf_llama` (torch Linear wants [out, in]: transposes)."""
+    import torch
+
+    tr = hf_model.model
+    cfg = hf_model.config
+    d = model.num_heads * model.head_dim
+    kvd = (model.num_kv_heads or model.num_heads) * model.head_dim
+    n_blocks = sum(1 for k in params if k.startswith("block_"))
+    mismatches = []
+    if cfg.num_hidden_layers != n_blocks:
+        mismatches.append(
+            f"layers {cfg.num_hidden_layers} != {n_blocks}")
+    if cfg.vocab_size != params["embed"].shape[0]:
+        mismatches.append(
+            f"vocab {cfg.vocab_size} != {params['embed'].shape[0]}")
+    if cfg.hidden_size != d:
+        mismatches.append(f"hidden {cfg.hidden_size} != {d}")
+    if bool(getattr(cfg, "tie_word_embeddings", False)) != bool(
+            model.tied_head):
+        mismatches.append(
+            f"tie_word_embeddings {cfg.tie_word_embeddings} != "
+            f"tied_head {model.tied_head}")
+    for knob, mine in (("rope_theta", model.rope_theta),
+                       ("rms_norm_eps", model.ln_eps)):
+        if abs(float(getattr(cfg, knob)) - float(mine)) > 1e-12:
+            mismatches.append(
+                f"{knob} {getattr(cfg, knob)} != {mine}")
+    if getattr(cfg, "sliding_window", None) != model.window:
+        mismatches.append(
+            f"sliding_window {getattr(cfg, 'sliding_window', None)} "
+            f"!= window {model.window}")
+    if mismatches:
+        raise ValueError(
+            "target shell does not match the source model/tree — a "
+            "mismatched shell would silently export a different "
+            "model: " + "; ".join(mismatches))
+    with torch.no_grad():
+        tr.embed_tokens.weight.copy_(_lin(params["embed"]))
+        tr.norm.weight.copy_(_lin(params["ln_f"]["scale"]))
+        if not model.tied_head:
+            hf_model.lm_head.weight.copy_(
+                _lin(params["lm_head"]))
+        for i, layer in enumerate(tr.layers):
+            b = params[f"block_{i}"]
+            qkv = np.asarray(b["attn"]["qkv"]["kernel"])
+            layer.input_layernorm.weight.copy_(
+                _lin(b["ln_attn"]["scale"]))
+            layer.self_attn.q_proj.weight.copy_(_lin(qkv[:, :d].T))
+            layer.self_attn.k_proj.weight.copy_(
+                _lin(qkv[:, d:d + kvd].T))
+            layer.self_attn.v_proj.weight.copy_(
+                _lin(qkv[:, d + kvd:].T))
+            layer.self_attn.o_proj.weight.copy_(
+                _lin(np.asarray(b["attn"]["out"]["kernel"]).T))
+            layer.post_attention_layernorm.weight.copy_(
+                _lin(b["ln_mlp"]["scale"]))
+            layer.mlp.gate_proj.weight.copy_(
+                _lin(np.asarray(b["mlp"]["gate"]["kernel"]).T))
+            layer.mlp.up_proj.weight.copy_(
+                _lin(np.asarray(b["mlp"]["up"]["kernel"]).T))
+            layer.mlp.down_proj.weight.copy_(
+                _lin(np.asarray(b["mlp"]["down"]["kernel"]).T))
+    return hf_model

@@ -251,3 +251,62 @@ def test_mistral_greedy_decode_matches_torch_generate():
                                     attn_impl="blockwise")
     got = np.asarray(generate(model, params, prompt, steps=10))
     np.testing.assert_array_equal(got, want)
+
+
+def test_gpt2_roundtrip_export():
+    """ours -> HF -> logits match ours: a model 'trained' here (random
+    init through OUR init) exports into transformers and computes the
+    same function there."""
+    from horovod_tpu.compat import from_hf_gpt2, to_hf_gpt2
+    from horovod_tpu.parallel.tensor import unbox
+    # Build OUR model first (its own random init), export into a
+    # fresh HF shell of the same architecture.
+    src = _tiny_hf(seed=21)
+    model, _ = from_hf_gpt2(src, dtype=jnp.float32,
+                            attn_impl="blockwise")
+    toks = np.random.RandomState(21).randint(0, 97, (2, 11))
+    params = unbox(model.init(jax.random.PRNGKey(21),
+                              jnp.asarray(toks))["params"])
+    ours = np.asarray(model.apply({"params": params},
+                                  jnp.asarray(toks)), np.float32)
+    hf = to_hf_gpt2(model, params, _tiny_hf(seed=22))
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(toks)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_roundtrip_export():
+    from horovod_tpu.compat import from_hf_llama, to_hf_llama
+    from horovod_tpu.parallel.tensor import unbox
+    src = _tiny_llama(seed=23)
+    model, _ = from_hf_llama(src, dtype=jnp.float32,
+                             attn_impl="blockwise")
+    toks = np.random.RandomState(23).randint(0, 97, (2, 9))
+    params = unbox(model.init(jax.random.PRNGKey(23),
+                              jnp.asarray(toks))["params"])
+    ours = np.asarray(model.apply({"params": params},
+                                  jnp.asarray(toks)), np.float32)
+    hf = to_hf_llama(model, params, _tiny_llama(seed=24))
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(toks)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=3e-4, atol=3e-4)
+
+
+def test_export_rejects_mismatched_shell_and_handles_bf16():
+    from horovod_tpu.compat import from_hf_gpt2, to_hf_gpt2
+    from horovod_tpu.parallel.tensor import unbox
+    src = _tiny_hf(seed=25)
+    model, _ = from_hf_gpt2(src, dtype=jnp.float32,
+                            attn_impl="blockwise")
+    toks = np.random.RandomState(25).randint(0, 97, (1, 7))
+    params = unbox(model.init(jax.random.PRNGKey(25),
+                              jnp.asarray(toks))["params"])
+    with pytest.raises(ValueError, match="does not match"):
+        to_hf_gpt2(model, params, _tiny_hf(seed=26, n_layer=1))
+    # bf16 tree (the serving dtype) must export without TypeError
+    bf16_tree = jax.tree.map(
+        lambda x: jnp.asarray(x, jnp.bfloat16), params)
+    hf = to_hf_gpt2(model, bf16_tree, _tiny_hf(seed=27))
+    with torch.no_grad():
+        out = hf(torch.from_numpy(toks)).logits
+    assert torch.isfinite(out).all()
