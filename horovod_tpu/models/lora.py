@@ -1,0 +1,99 @@
+"""LoRA fine-tuning utilities (Hu et al. 2021).
+
+No reference equivalent — parameter-efficient tuning postdates the
+reference. The adapters live INSIDE the TP Dense modules
+(`TransformerLM(lora_rank=r)`: every block Dense gains `lora_a`
+[in, r] replicated + `lora_b` [r, out] sharded like the kernel, B
+zero-init so the adapter starts as an exact no-op), so TP/SP sharding,
+the flash kernel, decode, and the DP gradient path all apply
+unchanged. These helpers supply the two things the modules don't:
+
+* freezing the base — `lora_label_fn(params)` labels every leaf
+  "lora" or "frozen" for `optax.multi_transform` (or build a bool
+  mask with `lora_mask`); only A/B receive updates, and with
+  multi_transform + `set_to_zero` the frozen base carries no
+  optimizer state (the memory point of LoRA);
+* serving — `merge_lora(params, alpha=...)` folds `W + (alpha/r)·A@B`
+  into each kernel and drops the adapter leaves, yielding a plain
+  tree for `generate`, `quantize_lm_params`, or `compat.hf` export.
+
+Distributed semantics fall out of the existing machinery: gradients
+for A/B average over ``data`` like any other param (GSPMD psum), and
+the row-parallel adapter's contraction reduce rides the same
+all-reduce slot as its base kernel's.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+LORA_LEAVES = ("lora_a", "lora_b")
+
+
+def _is_lora_path(path) -> bool:
+    return any(getattr(k, "key", None) in LORA_LEAVES for k in path)
+
+
+def lora_label_fn(params: Any) -> Any:
+    """Pytree of "lora" / "frozen" labels shaped like ``params`` — the
+    `optax.multi_transform` param_labels argument:
+
+        tx = optax.multi_transform(
+            {"lora": optax.adamw(1e-4), "frozen": optax.set_to_zero()},
+            lora_label_fn)
+    """
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: "lora" if _is_lora_path(path) else "frozen",
+        params)
+
+
+def lora_mask(params: Any) -> Any:
+    """Bool pytree (True = trainable adapter leaf) for `optax.masked`."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: _is_lora_path(path), params)
+
+
+def merge_lora(params: Any, *, model: Any = None,
+               rank: Optional[int] = None,
+               alpha: Optional[float] = None) -> Any:
+    """Fold every adapter into its base kernel and drop the A/B leaves.
+
+    Pass ``model`` (the `TransformerLM` the tree belongs to) and the
+    scale is read from its ``lora_rank``/``lora_alpha`` fields — the
+    safe form, immune to forgetting a non-default alpha. Without it,
+    ``rank`` defaults to the A matrices' own trailing dim and
+    ``alpha`` to ``rank`` (scale 1); a model trained with a custom
+    ``lora_alpha`` MUST have it passed one way or the other or the
+    merge silently mis-scales. Returns a plain tree interchangeable
+    with a `lora_rank=0` model's (what `model.clone(lora_rank=0)`
+    expects), ready for serving, int8 quantization, or HF export.
+    """
+    if model is not None:
+        if rank is None:
+            rank = model.lora_rank or None
+        if alpha is None:
+            alpha = model.lora_alpha
+    def walk(node):
+        if not isinstance(node, dict):
+            return node
+        if "lora_a" in node and "lora_b" in node:
+            a = jnp.asarray(node["lora_a"], jnp.float32)
+            b = jnp.asarray(node["lora_b"], jnp.float32)
+            r = rank if rank is not None else a.shape[-1]
+            scale = (alpha if alpha is not None else float(r)) / r
+            out = {k: walk(v) for k, v in node.items()
+                   if k not in LORA_LEAVES}
+            if "kernel" not in out:
+                raise ValueError(
+                    "lora_a/lora_b found without a sibling kernel "
+                    "(quantized tree? merge BEFORE quantize_lm_params)")
+            out["kernel"] = (jnp.asarray(out["kernel"], jnp.float32)
+                             + scale * (a @ b)).astype(
+                                 jnp.asarray(node["kernel"]).dtype)
+            return out
+        return {k: walk(v) for k, v in node.items()}
+
+    return walk(params)

@@ -199,6 +199,8 @@ class TransformerBlock(nn.Module):
     norm: str = "layernorm"              # "layernorm" | "rmsnorm"
     mlp_impl: str = "gelu"               # "gelu" | "swiglu" (LLaMA)
     mlp_hidden: Optional[int] = None     # absolute width (else ratio*d)
+    lora_rank: int = 0                   # LoRA adapters on the Denses
+    lora_alpha: Optional[float] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -237,6 +239,7 @@ class TransformerBlock(nn.Module):
             weight_quant=self.weight_quant,
             kv_quant=self.kv_quant,
             use_bias=self.attn_bias,
+            lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
             name="attn")(h, mask)
         x = x + h
         h = _make_norm(self.norm, self.dtype, self.ln_eps,
@@ -251,10 +254,14 @@ class TransformerBlock(nn.Module):
             if self.mlp_impl == "swiglu":
                 h = ParallelSwiGLU(hidden=hidden, out=d,
                                    weight_quant=self.weight_quant,
+                                   lora_rank=self.lora_rank,
+                                   lora_alpha=self.lora_alpha,
                                    dtype=self.dtype, name="mlp")(h)
             elif self.mlp_impl == "gelu":
                 h = ParallelMLP(hidden=hidden, out=d,
                                 weight_quant=self.weight_quant,
+                                lora_rank=self.lora_rank,
+                                lora_alpha=self.lora_alpha,
                                 dtype=self.dtype, name="mlp")(h)
             else:
                 raise ValueError(
@@ -310,6 +317,11 @@ class TransformerLM(nn.Module):
     # False: a separate vocab-sharded lm_head param instead of reusing
     # the embedding (LLaMA-family default).
     tied_head: bool = True
+    # LoRA (Hu et al. 2021): rank-r adapters on every block Dense;
+    # train with `models.lora.lora_label_fn` masking the base frozen,
+    # merge for serving with `models.lora.merge_lora`.
+    lora_rank: int = 0
+    lora_alpha: Optional[float] = None
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
@@ -370,6 +382,8 @@ class TransformerLM(nn.Module):
                 attn_bias=self.attn_bias, ln_eps=self.ln_eps,
                 norm=self.norm, mlp_impl=self.mlp_impl,
                 mlp_hidden=self.mlp_hidden,
+                lora_rank=self.lora_rank,
+                lora_alpha=self.lora_alpha,
                 name=f"block_{i}")(x)
             x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
 
@@ -416,6 +430,8 @@ class TransformerBlockStack(nn.Module):
     norm: str = "layernorm"
     mlp_impl: str = "gelu"
     mlp_hidden: Optional[int] = None
+    lora_rank: int = 0
+    lora_alpha: Optional[float] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -430,6 +446,8 @@ class TransformerBlockStack(nn.Module):
                 attn_bias=self.attn_bias, ln_eps=self.ln_eps,
                 norm=self.norm, mlp_impl=self.mlp_impl,
                 mlp_hidden=self.mlp_hidden,
+                lora_rank=self.lora_rank,
+                lora_alpha=self.lora_alpha,
                 name=f"block_{i}")(x)
         return x
 

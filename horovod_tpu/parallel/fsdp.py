@@ -149,7 +149,23 @@ def init_opt_state_sharded(tx, params: Any) -> Any:
         return optax.tree_map_params(
             tx, jax.lax.with_sharding_constraint, state, shardings)
 
-    return jax.jit(_init)(params)
+    try:
+        return jax.jit(_init)(params)
+    except (ValueError, TypeError) as e:
+        # Wrapper transforms whose state optax.tree_map_params cannot
+        # traverse with an extra tree (observed: optax.multi_transform
+        # — the LoRA frozen/adapter split, where masked slots are
+        # MaskedNode and the hazard is marginal). The fallback skips
+        # the sharding pin, so for a FULL optimizer state this
+        # forfeits the ZeRO-1 slot sharding — say so rather than
+        # silently regressing.
+        import logging
+        logging.getLogger("horovod_tpu").warning(
+            "init_opt_state_sharded: optimizer state of %s could not "
+            "be sharding-pinned (%s); falling back to bare tx.init — "
+            "param-shaped optimizer slots (if any are unmasked) may "
+            "materialize replicated", type(tx).__name__, e)
+        return jax.jit(tx.init)(params)
 
 
 def constrain_tree(tree: Any, specs: Any) -> Any:

@@ -202,6 +202,36 @@ def _dense_kernel(mod: nn.Module, in_features: int, features: int,
         (in_features, features), jnp.float32), mod.dtype)
 
 
+def _lora_delta(mod: nn.Module, x: jax.Array, in_features: int,
+                features: int, out_sharding) -> Optional[jax.Array]:
+    """The low-rank update `(x @ A) @ B · (alpha/r)` when
+    ``mod.lora_rank > 0`` (LoRA, Hu et al. 2021), else None.
+
+    A [in, r] starts lecun-normal and is replicated; B [r, out] starts
+    ZERO (the adapter is an exact no-op at init) and shards like the
+    kernel's output dim, so column-parallel adapters stay shard-local
+    and the row-parallel adapter's contraction psum is inserted by
+    GSPMD alongside the main kernel's. The base kernel stays frozen by
+    the optimizer mask (`models.lora.lora_label_fn`), not by the
+    module — grads still flow through both paths, and the r-rank
+    bottleneck keeps the adapter matmuls negligible."""
+    r = mod.lora_rank
+    if not r:
+        return None
+    alpha = mod.lora_alpha if mod.lora_alpha is not None else float(r)
+    a = mod.param(
+        "lora_a",
+        nn.with_partitioning(nn.initializers.lecun_normal(),
+                             (None, None)),
+        (in_features, r), jnp.float32)
+    b = mod.param(
+        "lora_b",
+        nn.with_partitioning(nn.initializers.zeros, (None, out_sharding)),
+        (r, features), jnp.float32)
+    xa = jnp.asarray(x, mod.dtype) @ jnp.asarray(a, mod.dtype)
+    return (xa @ jnp.asarray(b, mod.dtype)) * (alpha / r)
+
+
 class ColumnParallelDense(nn.Module):
     """Dense with the kernel's output dim sharded over ``model``."""
 
@@ -211,12 +241,18 @@ class ColumnParallelDense(nn.Module):
     kernel_init: Callable = nn.initializers.lecun_normal()
     axis: str = AXIS_MODEL
     weight_quant: Optional[str] = None   # None | "int8"
+    lora_rank: int = 0                   # LoRA adapter rank (0 = off)
+    lora_alpha: Optional[float] = None   # scale = alpha/r (default r)
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         kernel = _dense_kernel(self, x.shape[-1], self.features,
                                (None, self.axis))
         y = jnp.asarray(x, self.dtype) @ kernel
+        delta = _lora_delta(self, x, x.shape[-1], self.features,
+                            self.axis)
+        if delta is not None:
+            y = y + delta
         if self.use_bias:
             bias = self.param(
                 "bias",
@@ -241,12 +277,17 @@ class RowParallelDense(nn.Module):
     kernel_init: Callable = nn.initializers.lecun_normal()
     axis: str = AXIS_MODEL
     weight_quant: Optional[str] = None   # None | "int8"
+    lora_rank: int = 0
+    lora_alpha: Optional[float] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         kernel = _dense_kernel(self, x.shape[-1], self.features,
                                (self.axis, None))
         y = jnp.asarray(x, self.dtype) @ kernel
+        delta = _lora_delta(self, x, x.shape[-1], self.features, None)
+        if delta is not None:
+            y = y + delta
         # Feature dim pinned unsharded ⇒ the partial products over the
         # ``model``-sharded contraction are psum-reduced here; leading
         # dims stay UNCONSTRAINED to preserve data/seq sharding.
@@ -268,15 +309,21 @@ class ParallelMLP(nn.Module):
     dtype: Optional[Dtype] = None
     activation: Callable = nn.gelu
     weight_quant: Optional[str] = None
+    lora_rank: int = 0
+    lora_alpha: Optional[float] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         h = ColumnParallelDense(self.hidden, dtype=self.dtype,
                                 weight_quant=self.weight_quant,
+                                lora_rank=self.lora_rank,
+                                lora_alpha=self.lora_alpha,
                                 name="wi")(x)
         h = self.activation(h)
         return RowParallelDense(self.out, dtype=self.dtype,
                                 weight_quant=self.weight_quant,
+                                lora_rank=self.lora_rank,
+                                lora_alpha=self.lora_alpha,
                                 name="wo")(h)
 
 
@@ -298,21 +345,19 @@ class ParallelSwiGLU(nn.Module):
     out: int
     dtype: Optional[Dtype] = None
     weight_quant: Optional[str] = None
+    lora_rank: int = 0
+    lora_alpha: Optional[float] = None
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        g = ColumnParallelDense(self.hidden, use_bias=False,
-                                dtype=self.dtype,
-                                weight_quant=self.weight_quant,
-                                name="gate")(x)
-        u = ColumnParallelDense(self.hidden, use_bias=False,
-                                dtype=self.dtype,
-                                weight_quant=self.weight_quant,
-                                name="up")(x)
-        return RowParallelDense(self.out, use_bias=False,
-                                dtype=self.dtype,
-                                weight_quant=self.weight_quant,
-                                name="down")(nn.silu(g) * u)
+        kw = dict(use_bias=False, dtype=self.dtype,
+                  weight_quant=self.weight_quant,
+                  lora_rank=self.lora_rank,
+                  lora_alpha=self.lora_alpha)
+        g = ColumnParallelDense(self.hidden, name="gate", **kw)(x)
+        u = ColumnParallelDense(self.hidden, name="up", **kw)(x)
+        return RowParallelDense(self.out, name="down",
+                                **kw)(nn.silu(g) * u)
 
 
 class ParallelSelfAttention(nn.Module):
@@ -368,6 +413,8 @@ class ParallelSelfAttention(nn.Module):
     # Projections carry no bias by default (LLaMA-style); GPT-2-family
     # checkpoints (compat.hf) need them.
     use_bias: bool = False
+    lora_rank: int = 0
+    lora_alpha: Optional[float] = None
 
     @nn.compact
     def __call__(self, x: jax.Array,
@@ -384,6 +431,8 @@ class ParallelSelfAttention(nn.Module):
         qkv = ColumnParallelDense(features + 2 * kv_features,
                                   use_bias=self.use_bias,
                                   weight_quant=self.weight_quant,
+                                  lora_rank=self.lora_rank,
+                                  lora_alpha=self.lora_alpha,
                                   dtype=self.dtype, name="qkv")(x)
         q = qkv[..., :features]
         k = qkv[..., features:features + kv_features]
@@ -418,6 +467,8 @@ class ParallelSelfAttention(nn.Module):
                           AXIS_SEQ, AXIS_MODEL)
         return RowParallelDense(features, use_bias=self.use_bias,
                                 weight_quant=self.weight_quant,
+                                lora_rank=self.lora_rank,
+                                lora_alpha=self.lora_alpha,
                                 dtype=self.dtype, name="out")(o)
 
     def _maybe_rope(self, q, k, offset=0):
