@@ -135,8 +135,12 @@ def from_hf_gpt2(hf_model: Any, *, dtype=jnp.bfloat16,
     return model, params
 
 
+_CFG_WINDOW = object()   # sentinel: "take sliding_window from config"
+
+
 def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
-                  attn_impl: str = "flash"
+                  attn_impl: str = "flash",
+                  window: Any = _CFG_WINDOW
                   ) -> Tuple[Any, Dict[str, Any]]:
     """Convert a `transformers.LlamaForCausalLM` into
     `(TransformerLM, params)` — the modern-LLM interop: RoPE, GQA
@@ -175,10 +179,14 @@ def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
             f"unsupported hidden_act {cfg.hidden_act!r} (silu only)")
     if getattr(cfg, "rope_scaling", None):
         raise ValueError("rope_scaling is not supported")
-    if getattr(cfg, "attention_bias", False) or getattr(
-            cfg, "mlp_bias", False):
-        raise ValueError(
-            "attention_bias/mlp_bias checkpoints are not supported")
+    if getattr(cfg, "mlp_bias", False):
+        raise ValueError("mlp_bias checkpoints are not supported")
+    # Qwen2-style qkv biases are supported (bias on q/k/v, none on
+    # o_proj); detect from the weights rather than config-flag names,
+    # which differ across the family (attention_bias vs qkv_bias).
+    qkv_bias = tr.layers[0].self_attn.q_proj.bias is not None
+    if tr.layers[0].self_attn.o_proj.bias is not None:
+        raise ValueError("o_proj bias is not supported")
     head_dim = getattr(cfg, "head_dim", None) or d // H
     if head_dim != d // H:
         raise ValueError(
@@ -188,8 +196,11 @@ def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
     tied = bool(getattr(cfg, "tie_word_embeddings", False))
     arch_kw = dict(LLAMA_ARCH_KW, tied_head=tied)
     # Mistral = the LLaMA mapping + sliding-window attention; the
-    # band semantics match ours exactly (keep i-j < window).
-    window = getattr(cfg, "sliding_window", None)
+    # band semantics match ours exactly (keep i-j < window). Callers
+    # may override (Qwen2 passes window=None: its config carries a
+    # sliding_window value even when use_sliding_window is False).
+    if window is _CFG_WINDOW:
+        window = getattr(cfg, "sliding_window", None)
     model = TransformerLM(
         vocab_size=cfg.vocab_size, num_layers=cfg.num_hidden_layers,
         num_heads=H, head_dim=head_dim, num_kv_heads=Hkv,
@@ -198,6 +209,7 @@ def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
         window=window,
         mlp_hidden=cfg.intermediate_size,
         ln_eps=float(cfg.rms_norm_eps), dtype=dtype,
+        attn_bias=qkv_bias, attn_out_bias=False,
         attn_impl=attn_impl, **arch_kw)
 
     params: Dict[str, Any] = {
@@ -211,10 +223,15 @@ def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
         qkv = np.concatenate(
             [_t(sa.q_proj.weight).T, _t(sa.k_proj.weight).T,
              _t(sa.v_proj.weight).T], axis=1)
+        attn_tree = {"qkv": {"kernel": qkv},
+                     "out": {"kernel": _t(sa.o_proj.weight).T}}
+        if qkv_bias:
+            attn_tree["qkv"]["bias"] = np.concatenate(
+                [_t(sa.q_proj.bias), _t(sa.k_proj.bias),
+                 _t(sa.v_proj.bias)])
         params[f"block_{i}"] = {
             "ln_attn": {"scale": _t(layer.input_layernorm.weight)},
-            "attn": {"qkv": {"kernel": qkv},
-                     "out": {"kernel": _t(sa.o_proj.weight).T}},
+            "attn": attn_tree,
             "ln_mlp": {
                 "scale": _t(layer.post_attention_layernorm.weight)},
             "mlp": {
@@ -339,6 +356,12 @@ def to_hf_llama(model: Any, params: Dict[str, Any], hf_model: Any) -> Any:
         mismatches.append(
             f"sliding_window {getattr(cfg, 'sliding_window', None)} "
             f"!= window {model.window}")
+    tree_has_bias = "bias" in params["block_0"]["attn"]["qkv"]
+    shell_has_bias = tr.layers[0].self_attn.q_proj.bias is not None
+    if tree_has_bias != shell_has_bias:
+        mismatches.append(
+            f"qkv bias: tree {tree_has_bias} != shell "
+            f"{shell_has_bias}")
     if mismatches:
         raise ValueError(
             "target shell does not match the source model/tree — a "
@@ -360,6 +383,13 @@ def to_hf_llama(model: Any, params: Dict[str, Any], hf_model: Any) -> Any:
                 _lin(qkv[:, d:d + kvd].T))
             layer.self_attn.v_proj.weight.copy_(
                 _lin(qkv[:, d + kvd:].T))
+            if tree_has_bias:
+                qb = np.asarray(b["attn"]["qkv"]["bias"])
+                layer.self_attn.q_proj.bias.copy_(_lin(qb[:d]))
+                layer.self_attn.k_proj.bias.copy_(
+                    _lin(qb[d:d + kvd]))
+                layer.self_attn.v_proj.bias.copy_(
+                    _lin(qb[d + kvd:]))
             layer.self_attn.o_proj.weight.copy_(
                 _lin(np.asarray(b["attn"]["out"]["kernel"]).T))
             layer.post_attention_layernorm.weight.copy_(
@@ -371,3 +401,23 @@ def to_hf_llama(model: Any, params: Dict[str, Any], hf_model: Any) -> Any:
             layer.mlp.down_proj.weight.copy_(
                 _lin(np.asarray(b["mlp"]["down"]["kernel"]).T))
     return hf_model
+
+
+def from_hf_qwen2(hf_model: Any, *, dtype=jnp.bfloat16,
+                  attn_impl: str = "flash"
+                  ) -> Tuple[Any, Dict[str, Any]]:
+    """Convert a `transformers.Qwen2ForCausalLM`: the LLaMA-family
+    mapping plus qkv-only projection biases (`attn_bias=True,
+    attn_out_bias=False` — detected from the weights). Sliding-window
+    configs (`use_sliding_window=True`, which Qwen2 applies only to
+    the upper layers via `max_window_layers`) are rejected: our
+    `window` is uniform across layers."""
+    cfg = hf_model.config
+    if getattr(cfg, "use_sliding_window", False):
+        raise ValueError(
+            "use_sliding_window=True is per-layer (max_window_layers) "
+            "in Qwen2 and is not supported")
+    # Qwen2Config carries a sliding_window value even when unused —
+    # override rather than mutate the caller's config.
+    return from_hf_llama(hf_model, dtype=dtype, attn_impl=attn_impl,
+                         window=None)

@@ -195,6 +195,7 @@ class TransformerBlock(nn.Module):
     flash_block_q: int = 128             # Pallas flash tile sizes
     flash_block_k: int = 128
     attn_bias: bool = False              # GPT-2-family checkpoints
+    attn_out_bias: Optional[bool] = None  # None = follow attn_bias
     ln_eps: float = 1e-6
     norm: str = "layernorm"              # "layernorm" | "rmsnorm"
     mlp_impl: str = "gelu"               # "gelu" | "swiglu" (LLaMA)
@@ -238,7 +239,7 @@ class TransformerBlock(nn.Module):
             chunked_prefill=self.chunked_prefill,
             weight_quant=self.weight_quant,
             kv_quant=self.kv_quant,
-            use_bias=self.attn_bias,
+            use_bias=self.attn_bias, out_bias=self.attn_out_bias,
             lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
             name="attn")(h, mask)
         x = x + h
@@ -310,6 +311,7 @@ class TransformerLM(nn.Module):
     flash_block_q: int = 128   # Pallas flash tile sizes (bench-sweepable)
     flash_block_k: int = 128
     attn_bias: bool = False    # attention projection biases (GPT-2)
+    attn_out_bias: Optional[bool] = None  # Qwen2: qkv bias, no out bias
     ln_eps: float = 1e-6       # LayerNorm epsilon (GPT-2: 1e-5)
     norm: str = "layernorm"    # "layernorm" | "rmsnorm" (LLaMA)
     mlp_impl: str = "gelu"     # "gelu" | "swiglu" (LLaMA)
@@ -379,7 +381,9 @@ class TransformerLM(nn.Module):
                 kv_quant=self.kv_quant,
                 flash_block_q=self.flash_block_q,
                 flash_block_k=self.flash_block_k,
-                attn_bias=self.attn_bias, ln_eps=self.ln_eps,
+                attn_bias=self.attn_bias,
+                attn_out_bias=self.attn_out_bias,
+                ln_eps=self.ln_eps,
                 norm=self.norm, mlp_impl=self.mlp_impl,
                 mlp_hidden=self.mlp_hidden,
                 lora_rank=self.lora_rank,
@@ -426,6 +430,7 @@ class TransformerBlockStack(nn.Module):
     dtype: Optional[Dtype] = jnp.bfloat16
     attn_impl: str = "blockwise"
     attn_bias: bool = False
+    attn_out_bias: Optional[bool] = None
     ln_eps: float = 1e-6
     norm: str = "layernorm"
     mlp_impl: str = "gelu"
@@ -443,7 +448,9 @@ class TransformerBlockStack(nn.Module):
                 window=self.window,
                 mlp_ratio=self.mlp_ratio, dtype=self.dtype,
                 attn_impl=self.attn_impl,
-                attn_bias=self.attn_bias, ln_eps=self.ln_eps,
+                attn_bias=self.attn_bias,
+                attn_out_bias=self.attn_out_bias,
+                ln_eps=self.ln_eps,
                 norm=self.norm, mlp_impl=self.mlp_impl,
                 mlp_hidden=self.mlp_hidden,
                 lora_rank=self.lora_rank,

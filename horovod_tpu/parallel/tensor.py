@@ -413,6 +413,9 @@ class ParallelSelfAttention(nn.Module):
     # Projections carry no bias by default (LLaMA-style); GPT-2-family
     # checkpoints (compat.hf) need them.
     use_bias: bool = False
+    # Qwen2-style split: bias on the qkv projection but not on the
+    # output projection. None = follow use_bias (GPT-2: both).
+    out_bias: Optional[bool] = None
     lora_rank: int = 0
     lora_alpha: Optional[float] = None
 
@@ -465,7 +468,8 @@ class ParallelSelfAttention(nn.Module):
         else:
             o = constrain(o, AXIS_DATA, *([None] * (o.ndim - 3)),
                           AXIS_SEQ, AXIS_MODEL)
-        return RowParallelDense(features, use_bias=self.use_bias,
+        ob = self.use_bias if self.out_bias is None else self.out_bias
+        return RowParallelDense(features, use_bias=ob,
                                 weight_quant=self.weight_quant,
                                 lora_rank=self.lora_rank,
                                 lora_alpha=self.lora_alpha,

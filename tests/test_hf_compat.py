@@ -202,7 +202,9 @@ def test_llama_rejects_unsupported():
     from horovod_tpu.compat import from_hf_llama
     with pytest.raises(ValueError, match="hidden_act"):
         from_hf_llama(_tiny_llama(hidden_act="gelu"))
-    with pytest.raises(ValueError, match="attention_bias"):
+    # attention_bias=True in LlamaConfig biases o_proj too — qkv-only
+    # biases (Qwen2) are supported, o_proj bias is not.
+    with pytest.raises(ValueError, match="o_proj bias"):
         from_hf_llama(_tiny_llama(attention_bias=True))
 
 
@@ -310,3 +312,72 @@ def test_export_rejects_mismatched_shell_and_handles_bf16():
     with torch.no_grad():
         out = hf(torch.from_numpy(toks)).logits
     assert torch.isfinite(out).all()
+
+
+def _tiny_qwen2(seed=0, **over):
+    cfg = dict(hidden_size=32, intermediate_size=88,
+               num_hidden_layers=2, num_attention_heads=4,
+               num_key_value_heads=2, max_position_embeddings=64,
+               vocab_size=97, attention_dropout=0.0,
+               use_sliding_window=False)
+    cfg.update(over)
+    torch.manual_seed(seed)
+    m = transformers.Qwen2ForCausalLM(transformers.Qwen2Config(**cfg))
+    return m.eval()
+
+
+def test_qwen2_logits_and_decode_match_torch():
+    """qkv-only biases (attn_bias=True, attn_out_bias=False): logits
+    parity and token-exact greedy decode vs the torch Qwen2."""
+    from horovod_tpu.compat import from_hf_qwen2
+    from horovod_tpu.models.transformer import generate
+    hf = _tiny_qwen2(seed=31)
+    toks = np.random.RandomState(31).randint(0, 97, (2, 12))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(toks)).logits.numpy()
+    model, params = from_hf_qwen2(hf, dtype=jnp.float32,
+                                  attn_impl="blockwise")
+    assert model.attn_bias and model.attn_out_bias is False
+    assert model.window is None
+    assert "bias" in params["block_0"]["attn"]["qkv"]
+    assert "bias" not in params["block_0"]["attn"]["out"]
+    got = np.asarray(model.apply({"params": params},
+                                 jnp.asarray(toks)), np.float32)
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+
+    prompt = np.random.RandomState(32).randint(0, 97, (2, 5))
+    with torch.no_grad():
+        gen = hf.generate(torch.from_numpy(prompt), max_new_tokens=7,
+                          do_sample=False, pad_token_id=0).numpy()
+    ours = np.asarray(generate(model, params, prompt, steps=7))
+    np.testing.assert_array_equal(ours, gen)
+
+
+def test_qwen2_rejects_sliding_window():
+    from horovod_tpu.compat import from_hf_qwen2
+    hf = _tiny_qwen2(seed=33, use_sliding_window=True,
+                     sliding_window=8, max_window_layers=1)
+    with pytest.raises(ValueError, match="use_sliding_window"):
+        from_hf_qwen2(hf)
+
+
+def test_qwen2_roundtrip_export_with_biases():
+    """Qwen2 tree (qkv biases) -> to_hf_llama -> logits match; a
+    biasless shell is rejected instead of silently keeping stale
+    biases."""
+    from horovod_tpu.compat import from_hf_qwen2, to_hf_llama
+    hf = _tiny_qwen2(seed=34)
+    model, params = from_hf_qwen2(hf, dtype=jnp.float32,
+                                  attn_impl="blockwise")
+    toks = np.random.RandomState(34).randint(0, 97, (1, 9))
+    ours = np.asarray(model.apply({"params": params},
+                                  jnp.asarray(toks)), np.float32)
+    out_hf = to_hf_llama(model, params, _tiny_qwen2(seed=35))
+    with torch.no_grad():
+        theirs = out_hf(torch.from_numpy(toks)).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=3e-4, atol=3e-4)
+    with pytest.raises(ValueError, match="qkv bias"):
+        to_hf_llama(model, params, _tiny_llama(
+            seed=36, vocab_size=97, hidden_size=32,
+            intermediate_size=88, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2))
