@@ -15,6 +15,7 @@ from horovod_tpu.models.inception import InceptionV3
 from horovod_tpu.models.word2vec import Word2Vec
 from horovod_tpu.models.lora import (lora_label_fn, lora_mask,
                                      merge_lora)
+from horovod_tpu.models.speculative import generate_speculative
 from horovod_tpu.models.bert import (BertBase, BertLarge, BertMLM,
                                      make_mlm_batch, make_mlm_train_step,
                                      mlm_loss)
@@ -32,6 +33,7 @@ __all__ = [
     "BertBase", "BertLarge", "BertMLM", "make_mlm_batch",
     "make_mlm_train_step", "mlm_loss",
     "lora_label_fn", "lora_mask", "merge_lora",
+    "generate_speculative",
     "TransformerLM", "generate", "init_lm_state", "lm_fsdp_specs",
     "make_lm_eval_step", "make_lm_train_step",
 ]

@@ -1,0 +1,87 @@
+"""Speculative decoding (`models/speculative.py`).
+
+THE oracle: greedy acceptance makes the output exactly the target
+model's own greedy decode, for ANY draft — so every test compares
+token-for-token against `models.generate`.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models import TransformerLM, generate_speculative
+from horovod_tpu.models.transformer import generate
+from horovod_tpu.parallel.tensor import unbox
+
+
+def lm(seed, layers=2, heads=2, **kw):
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("pos_emb", "rope")
+    model = TransformerLM(vocab_size=64, num_layers=layers,
+                         num_heads=heads, head_dim=8, max_len=64,
+                         attn_impl="blockwise", **kw)
+    params = unbox(model.init(
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1, 8), jnp.int32))["params"])
+    return model, params
+
+
+PROMPT = np.asarray([[3, 1, 4, 1, 5]], np.int32)
+
+
+@pytest.mark.parametrize("k", [1, 3, 4])
+def test_matches_target_greedy_with_independent_draft(k):
+    """A draft the target disagrees with often: output still EXACTLY
+    the target's greedy decode (rejections exercised)."""
+    tgt_m, tgt_p = lm(0)
+    drf_m, drf_p = lm(99, layers=1)
+    want = np.asarray(generate(tgt_m, tgt_p, PROMPT, steps=12))
+    got, stats = generate_speculative(
+        drf_m, drf_p, tgt_m, tgt_p, PROMPT, steps=12, k=k,
+        return_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["tokens"] == 12 and stats["rounds"] >= 1
+
+
+def test_draft_equals_target_accepts_everything():
+    """draft == target: every comparable proposal matches, so rounds
+    produce k tokens each and acceptance is maximal."""
+    tgt_m, tgt_p = lm(1)
+    got, stats = generate_speculative(
+        tgt_m, tgt_p, tgt_m, tgt_p, PROMPT, steps=12, k=4,
+        return_stats=True)
+    want = np.asarray(generate(tgt_m, tgt_p, PROMPT, steps=12))
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # draft == target: full acceptance, k+1 tokens per round — 11
+    # post-prefill tokens at k=4 → rounds 3 (5+5+min), all proposals
+    # accepted.
+    assert stats["rounds"] == 3
+    assert stats["draft_accepted"] == stats["rounds"] * 4 or (
+        stats["draft_accepted"] >= 8)
+
+
+def test_learned_positions_roundtrip():
+    """pos_index rewind: learned-position models stay exact too."""
+    tgt_m, tgt_p = lm(2, pos_emb="learned")
+    drf_m, drf_p = lm(98, layers=1, pos_emb="learned")
+    want = np.asarray(generate(tgt_m, tgt_p, PROMPT, steps=10))
+    got = generate_speculative(drf_m, drf_p, tgt_m, tgt_p,
+                               PROMPT, steps=10, k=3)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_rejects_unsupported():
+    tgt_m, tgt_p = lm(3)
+    drf_m, drf_p = lm(97, layers=1)
+    with pytest.raises(ValueError, match="batch-1"):
+        generate_speculative(drf_m, drf_p, tgt_m, tgt_p,
+                             np.zeros((2, 4), np.int32), steps=4)
+    win_m, win_p = lm(4, window=8)
+    with pytest.raises(ValueError, match="rolling-cache"):
+        generate_speculative(drf_m, drf_p, win_m, win_p, PROMPT,
+                             steps=4)
+    with pytest.raises(ValueError, match="max_len"):
+        generate_speculative(drf_m, drf_p, tgt_m, tgt_p, PROMPT,
+                             steps=1000)
