@@ -13,8 +13,8 @@ from horovod_tpu.models.resnet import ResNet, ResNet50, ResNet101, ResNet152
 from horovod_tpu.models.vgg import VGG16
 from horovod_tpu.models.inception import InceptionV3
 from horovod_tpu.models.word2vec import Word2Vec
-from horovod_tpu.models.lora import (lora_label_fn, lora_mask,
-                                     merge_lora)
+from horovod_tpu.models.lora import (graft_base, lora_label_fn,
+                                     lora_mask, merge_lora)
 from horovod_tpu.models.speculative import generate_speculative
 from horovod_tpu.models.bert import (BertBase, BertLarge, BertMLM,
                                      make_mlm_batch, make_mlm_train_step,
@@ -32,7 +32,7 @@ __all__ = [
     "ViT_B16", "ViT_S16", "make_cnn_train_step",
     "BertBase", "BertLarge", "BertMLM", "make_mlm_batch",
     "make_mlm_train_step", "mlm_loss",
-    "lora_label_fn", "lora_mask", "merge_lora",
+    "graft_base", "lora_label_fn", "lora_mask", "merge_lora",
     "generate_speculative",
     "TransformerLM", "generate", "init_lm_state", "lm_fsdp_specs",
     "make_lm_eval_step", "make_lm_train_step",

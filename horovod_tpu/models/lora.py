@@ -56,6 +56,25 @@ def lora_mask(params: Any) -> Any:
         lambda path, _: _is_lora_path(path), params)
 
 
+def graft_base(pretrained: Any, lora_params: Any) -> Any:
+    """Overlay a pretrained base tree under freshly-initialized
+    adapters: every non-adapter leaf comes from ``pretrained``, the
+    `LORA_LEAVES` keep their fresh (zero-B, exact-no-op) init — the
+    standard start of a LoRA fine-tune (`examples/jax_lora_finetune`).
+    Trees must share structure apart from the adapter leaves."""
+    def walk(pre, tree):
+        if not isinstance(tree, dict):
+            return pre
+        out = {}
+        for key, val in tree.items():
+            if key in LORA_LEAVES:
+                out[key] = val
+            else:
+                out[key] = walk(pre[key], val)
+        return out
+    return walk(pretrained, lora_params)
+
+
 def merge_lora(params: Any, *, model: Any = None,
                rank: Optional[int] = None,
                alpha: Optional[float] = None) -> Any:

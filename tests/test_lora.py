@@ -154,3 +154,27 @@ def test_lora_tp_sharded_training_matches_replicated_forward():
             sharded, ts)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_graft_base_overlays_everything_but_adapters():
+    from horovod_tpu.models import graft_base
+    toks = jnp.zeros((1, 8), jnp.int32)
+    base = unbox(small_lm().init(jax.random.PRNGKey(0),
+                                 toks)["params"])
+    fresh = unbox(small_lm(lora_rank=2).init(jax.random.PRNGKey(9),
+                                             toks)["params"])
+    grafted = graft_base(base, fresh)
+
+    def check(path, g):
+        keys = [getattr(k, "key", None) for k in path]
+        node = base
+        if any(k in ("lora_a", "lora_b") for k in keys):
+            return  # fresh adapters kept
+        for k in keys:
+            node = node[k]
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(node))
+    jax.tree_util.tree_map_with_path(check, grafted)
+    # fresh adapter B is zeros: grafted model == base model exactly
+    out_g = small_lm(lora_rank=2).apply({"params": grafted}, toks)
+    out_b = small_lm().apply({"params": base}, toks)
+    np.testing.assert_array_equal(np.asarray(out_g), np.asarray(out_b))

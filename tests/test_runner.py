@@ -142,6 +142,17 @@ def test_generate_example_int8_serving():
     assert "generated:" in res.stdout
 
 
+def test_lora_finetune_example():
+    """Pretrain -> LoRA-adapt -> merge -> serve, under the launcher:
+    the parameter-efficient-tuning workflow end to end."""
+    res = _run(["-np", "1", "--", sys.executable,
+                "examples/jax_lora_finetune.py",
+                "--steps", "12", "--lora-steps", "10"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "lora loss" in res.stdout
+    assert "generated:" in res.stdout
+
+
 def test_checkpoint_resume_across_launches(tmp_path):
     """The §5.4 contract under the launcher: run 1 saves on rank 0
     only; run 2 discovers the newest step, restores, broadcasts, and
