@@ -85,3 +85,18 @@ def test_rejects_unsupported():
     with pytest.raises(ValueError, match="max_len"):
         generate_speculative(drf_m, drf_p, tgt_m, tgt_p, PROMPT,
                              steps=1000)
+
+
+def test_composes_with_int8_weights_and_gqa():
+    """The full serving stack in one path: int8-weight GQA target +
+    small draft, speculative output EXACTLY the int8 target's own
+    greedy decode."""
+    from horovod_tpu.ops.quantization import quantize_lm_params
+    tgt_m, tgt_p = lm(5, heads=4, num_kv_heads=2)
+    drf_m, drf_p = lm(96, layers=1)
+    q_m = tgt_m.clone(weight_quant="int8")
+    q_p = quantize_lm_params(tgt_p)
+    want = np.asarray(generate(q_m, q_p, PROMPT, steps=10))
+    got = generate_speculative(drf_m, drf_p, q_m, q_p, PROMPT,
+                               steps=10, k=3)
+    np.testing.assert_array_equal(np.asarray(got), want)
