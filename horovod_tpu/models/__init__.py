@@ -17,6 +17,7 @@ from horovod_tpu.models.lora import (graft_base, lora_label_fn,
                                      lora_mask, merge_lora)
 from horovod_tpu.models.speculative import generate_speculative
 from horovod_tpu.models.bert import (BertBase, BertLarge, BertMLM,
+                                     chunked_mlm_loss,
                                      make_mlm_batch, make_mlm_train_step,
                                      mlm_loss)
 from horovod_tpu.models.vit import VisionTransformer, ViT_B16, ViT_S16
@@ -30,7 +31,8 @@ __all__ = [
     "MnistConvNet", "ResNet", "ResNet50", "ResNet101", "ResNet152",
     "VGG16", "InceptionV3", "Word2Vec", "VisionTransformer",
     "ViT_B16", "ViT_S16", "make_cnn_train_step",
-    "BertBase", "BertLarge", "BertMLM", "make_mlm_batch",
+    "BertBase", "BertLarge", "BertMLM", "chunked_mlm_loss",
+    "make_mlm_batch",
     "make_mlm_train_step", "mlm_loss",
     "graft_base", "lora_label_fn", "lora_mask", "merge_lora",
     "generate_speculative",

@@ -59,7 +59,8 @@ class BertMLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens: jax.Array,
-                 segment_ids: Optional[jax.Array] = None) -> jax.Array:
+                 segment_ids: Optional[jax.Array] = None,
+                 return_hidden: bool = False) -> Any:
         B, S = tokens.shape
         d = self.num_heads * self.head_dim
         embed = self.param(
@@ -88,6 +89,10 @@ class BertMLM(nn.Module):
             x = block(name=f"block_{i}")(x)
             x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_f")(x)
+        if return_hidden:
+            # For the chunked fused head+loss (`chunked_mlm_loss`):
+            # the [B, S, V] logits never materialize.
+            return x, embed
         # Tied MLM head (the BERT transform layer folded away: one
         # matmul against the embedding — vocab sharded over `model`).
         logits = jnp.einsum("bsd,vd->bsv", x, embed.astype(self.dtype))
@@ -127,9 +132,29 @@ def mlm_loss(logits: jax.Array, targets: jax.Array,
     return (ce * w).sum() / jnp.maximum(w.sum(), 1.0)
 
 
+def chunked_mlm_loss(hidden: jax.Array, embed: jax.Array,
+                     targets: jax.Array, is_target: jax.Array, *,
+                     chunk: int = 512) -> jax.Array:
+    """Masked CE fused with the MLM head, scanned over sequence chunks
+    so the [B, S, V] logits never materialize — the MLM analogue of
+    `transformer.chunked_lm_loss`, sharing its `chunked_weighted_ce`
+    core (`jax.checkpoint` recomputes each chunk's logits in the
+    backward; 1 GiB of bf16 logits at B32·S512·V32k drops to
+    1/(S/chunk)). Composes with dp (batch must divide the ``data``
+    axis — a ragged batch can trip an XLA partitioner CHECK inside
+    the scan, same as the LM loss); with sequence parallelism keep
+    the plain `mlm_loss`."""
+    from horovod_tpu.models.transformer import chunked_weighted_ce
+
+    w = is_target.astype(jnp.float32)
+    total = chunked_weighted_ce(hidden, embed, targets, w, chunk=chunk)
+    return total / jnp.maximum(w.sum(), 1.0)
+
+
 def make_mlm_train_step(model: BertMLM, tx, mesh, *,
                         mask_id: Optional[int] = None,
-                        mask_rate: float = 0.15):
+                        mask_rate: float = 0.15,
+                        loss_chunk: Optional[int] = None):
     """Jitted MLM pretraining step over the mesh: corrupt -> forward ->
     masked CE -> grads (GSPMD inserts the DP psum / TP collectives from
     the shardings, exactly as in `make_lm_train_step`).
@@ -138,6 +163,8 @@ def make_mlm_train_step(model: BertMLM, tx, mesh, *,
     corpora; a real tokenizer should pass its dedicated [MASK] id so
     genuine occurrences of the last token are not conflated with
     masked positions. ``mask_rate`` is the paper's 15 % by default.
+    ``loss_chunk``: compute the masked CE via `chunked_mlm_loss`
+    (the [B, S, V] logits never materialize).
     """
     from horovod_tpu.parallel.mesh import use
     from horovod_tpu.parallel.tensor import unbox
@@ -149,6 +176,11 @@ def make_mlm_train_step(model: BertMLM, tx, mesh, *,
             corrupted, sel = make_mlm_batch(
                 rng, tokens, vocab_size=model.vocab_size,
                 mask_id=mid, mask_rate=mask_rate)
+            if loss_chunk:
+                hidden, embed = model.apply(
+                    {"params": p}, corrupted, return_hidden=True)
+                return chunked_mlm_loss(hidden, embed, tokens, sel,
+                                        chunk=loss_chunk)
             logits = model.apply({"params": p}, corrupted)
             return mlm_loss(logits, tokens, sel)
 

@@ -489,19 +489,33 @@ def chunked_lm_loss(hidden: jax.Array, embed: jax.Array,
     divide the ``data`` axis (the standard SPMD input contract — a
     ragged batch can trip an XLA partitioner CHECK inside the scan).
     """
-    B, S, D = hidden.shape
-    h = hidden[:, :-1]
-    y = tokens[:, 1:]
+    B, S, _ = hidden.shape
     P = S - 1
-    nc = -(-P // chunk)
-    pad = nc * chunk - P
-    h = jnp.pad(h, ((0, 0), (0, pad), (0, 0)))
-    y = jnp.pad(y, ((0, 0), (0, pad)))
-    mask = jnp.pad(jnp.ones((B, P), jnp.float32), ((0, 0), (0, pad)))
+    total = chunked_weighted_ce(
+        hidden[:, :-1], embed, tokens[:, 1:],
+        jnp.ones((B, P), jnp.float32), chunk=chunk)
+    return total / (B * P)
+
+
+def chunked_weighted_ce(hidden: jax.Array, head: jax.Array,
+                        targets: jax.Array, weights: jax.Array, *,
+                        chunk: int) -> jax.Array:
+    """SUM of `weights * CE(hidden @ head.T, targets)` computed in
+    sequence chunks under `jax.checkpoint` — the shared fused-head CE
+    core of `chunked_lm_loss` (causal shift + uniform weights) and
+    `bert.chunked_mlm_loss` (masked-position weights): the [B, S, V]
+    logits never materialize, each chunk's are recomputed in the
+    backward. Padding rows carry weight 0, so ragged S is exact."""
+    B, S, D = hidden.shape
+    nc = -(-S // chunk)
+    pad = nc * chunk - S
+    h = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+    y = jnp.pad(targets, ((0, 0), (0, pad)))
+    wts = jnp.pad(weights, ((0, 0), (0, pad)))
     h = h.reshape(B, nc, chunk, D).transpose(1, 0, 2, 3)
     y = y.reshape(B, nc, chunk).transpose(1, 0, 2)
-    mask = mask.reshape(B, nc, chunk).transpose(1, 0, 2)
-    w = embed.astype(hidden.dtype)
+    wts = wts.reshape(B, nc, chunk).transpose(1, 0, 2)
+    w = head.astype(hidden.dtype)
 
     @jax.checkpoint
     def tick(total, xs):
@@ -510,8 +524,8 @@ def chunked_lm_loss(hidden: jax.Array, embed: jax.Array,
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, yc)
         return total + (ce * mc).sum(), None
 
-    total, _ = lax.scan(tick, jnp.float32(0.0), (h, y, mask))
-    return total / (B * P)
+    total, _ = lax.scan(tick, jnp.float32(0.0), (h, y, wts))
+    return total
 
 
 def make_lm_train_step(model: TransformerLM,

@@ -391,3 +391,60 @@ def test_bert_tensor_parallel_matches_replicated(hvd):
         out = jax.jit(lambda p, t: m.apply({"params": p}, t))(params, ts)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=3e-5)
+
+
+@pytest.mark.parametrize("chunk", [5, 16, 64])
+def test_chunked_mlm_loss_matches_plain(hvd, chunk):
+    """Fused-head masked CE == plain mlm_loss — value and grads —
+    including ragged chunking (S=16 with chunk 5) and chunk > S."""
+    from horovod_tpu.models import (BertMLM, chunked_mlm_loss,
+                                    make_mlm_batch, mlm_loss)
+    from horovod_tpu.parallel.tensor import unbox
+    model = BertMLM(vocab_size=48, num_layers=1, num_heads=2,
+                    head_dim=8, max_len=16, dtype=jnp.float32)
+    toks = jnp.asarray(np.random.RandomState(7).randint(0, 48, (4, 16)))
+    params = unbox(model.init(jax.random.PRNGKey(7), toks)["params"])
+    corrupted, sel = make_mlm_batch(jax.random.PRNGKey(8), toks,
+                                    vocab_size=48, mask_id=47)
+
+    def plain(p):
+        return mlm_loss(model.apply({"params": p}, corrupted),
+                        toks, sel)
+
+    def chunked(p):
+        hidden, embed = model.apply({"params": p}, corrupted,
+                                    return_hidden=True)
+        return chunked_mlm_loss(hidden, embed, toks, sel, chunk=chunk)
+
+    la, ga = jax.value_and_grad(plain)(params)
+    lb, gb = jax.value_and_grad(chunked)(params)
+    np.testing.assert_allclose(float(la), float(lb), rtol=1e-6)
+    jax.tree.map(lambda x, y: np.testing.assert_allclose(
+        np.asarray(x), np.asarray(y), rtol=2e-5, atol=2e-5), ga, gb)
+
+
+def test_mlm_train_step_loss_chunk(hvd):
+    """make_mlm_train_step(loss_chunk=...) trains identically to the
+    plain path given the same rng stream."""
+    import optax
+    from horovod_tpu.models import BertMLM, make_mlm_train_step
+    from horovod_tpu.parallel.mesh import make_mesh, shard_batch
+    from horovod_tpu.parallel.tensor import shard_params, unbox
+    model = BertMLM(vocab_size=32, num_layers=1, num_heads=2,
+                    head_dim=8, max_len=16, dtype=jnp.float32)
+    toks = np.stack([(np.arange(16) + s) % 30
+                     for s in range(8)]).astype(np.int32)
+    mesh = make_mesh(data=8)
+    results = []
+    for chunk in (None, 8):
+        tx = optax.adam(5e-3)
+        variables = model.init(jax.random.PRNGKey(0), jnp.asarray(toks))
+        params = shard_params(mesh, variables)["params"]
+        opt = tx.init(unbox(variables["params"]))
+        step = make_mlm_train_step(model, tx, mesh, loss_chunk=chunk)
+        ts = shard_batch(mesh, toks)
+        for i in range(5):
+            params, opt, loss = step(params, opt, ts,
+                                     jax.random.PRNGKey(50 + i))
+        results.append(float(loss))
+    np.testing.assert_allclose(results[0], results[1], rtol=2e-5)
