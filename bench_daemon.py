@@ -86,8 +86,12 @@ def _is_json(ln):
 def run_config(cfg):
     """Run one bench.py invocation; return (ok, record)."""
     args = list(cfg.get("args", []))
+    # The daemon owns the probe loop, so bench.py itself fast-fails:
+    # --probe-budget 0 keeps the fixed two-attempt wait (a mid-suite
+    # tunnel drop must surface as backend_unavailable quickly, not
+    # burn the window re-probing inside every config).
     cmd = [sys.executable, os.path.join(REPO, "bench.py"),
-           "--init-attempts", "2"]
+           "--init-attempts", "2", "--probe-budget", "0"]
     if "--deadline" not in args:
         # bench.py's silent-hang watchdog must fire BEFORE our own
         # subprocess kill or it can never salvage a final line; leave
@@ -160,7 +164,7 @@ def main():
     args = ap.parse_args()
 
     state = {"provenance": {
-        "source": "builder-session opportunistic daemon (round 4)",
+        "source": "builder-session opportunistic daemon (round 5)",
         "started_at": datetime.datetime.now(
             datetime.timezone.utc).isoformat(timespec="seconds"),
         "probes": 0, "windows": 0,

@@ -8,6 +8,23 @@ cd "$(dirname "$0")"
 
 JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
+# Compat matrix (the reference sweeps {py27/34/36} x {TF 1.1/1.4/
+# nightly} x {OpenMPI,MPICH} in .travis.yml; this image pins ONE real
+# generation — TF 2.21 / Keras 3 — so the other Keras generations'
+# optimizer surfaces are driven explicitly by stub optimizers of each
+# generation's API). One leg per interception path
+# (horovod/keras/__init__.py): Keras-3 apply_gradients via the real
+# optimizer (test_fit_decreases_loss), Keras-2 get_gradients and
+# TF2-legacy _compute_gradients via the generation stubs. The tests
+# run in the full suite above; this collect-only step is the named
+# guard that each generation leg still exists (a rename/removal fails
+# CI here even if the suite still passes).
+JAX_PLATFORMS=cpu python -m pytest -q --collect-only \
+    "tests/test_tf_compat.py::TestKeras::test_fit_decreases_loss" \
+    "tests/test_tf_compat.py::TestCompatRegressions::test_keras2_get_gradients_path_averages" \
+    "tests/test_tf_compat.py::TestCompatRegressions::test_tf2_legacy_compute_gradients_path_averages" \
+    > /dev/null
+
 python -m horovod_tpu.runner -np 2 --platform cpu -- \
     python examples/jax_mnist.py --steps 20
 

@@ -24,7 +24,7 @@ from horovod_tpu.models.vit import VisionTransformer, ViT_B16, ViT_S16
 from horovod_tpu.models.train import make_cnn_train_step
 from horovod_tpu.models.transformer import (
     TransformerLM, generate, init_lm_state, lm_fsdp_specs,
-    make_lm_eval_step, make_lm_train_step,
+    make_lm_eval_step, make_lm_train_step, serving_params,
 )
 
 __all__ = [
@@ -37,5 +37,5 @@ __all__ = [
     "graft_base", "lora_label_fn", "lora_mask", "merge_lora",
     "generate_speculative",
     "TransformerLM", "generate", "init_lm_state", "lm_fsdp_specs",
-    "make_lm_eval_step", "make_lm_train_step",
+    "make_lm_eval_step", "make_lm_train_step", "serving_params",
 ]

@@ -76,6 +76,7 @@ class ConvBN(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     train: bool = False
     s2d: bool = False   # stem-conv-only: see _S2DStemConv
+    bn_sample: int = 1  # >1: sampled BN statistics (models.resnet)
 
     @nn.compact
     def __call__(self, x):
@@ -90,8 +91,16 @@ class ConvBN(nn.Module):
             x = nn.Conv(self.features, self.kernel, self.strides,
                         padding=self.padding, use_bias=False,
                         dtype=self.dtype, name="Conv_0")(x)
-        x = nn.BatchNorm(use_running_average=not self.train,
-                         momentum=0.9, epsilon=1e-3, dtype=self.dtype)(x)
+        if self.bn_sample > 1:
+            from horovod_tpu.models.resnet import SampledBatchNorm
+            x = SampledBatchNorm(use_running_average=not self.train,
+                                 momentum=0.9, epsilon=1e-3,
+                                 dtype=self.dtype,
+                                 sample=self.bn_sample)(x)
+        else:
+            x = nn.BatchNorm(use_running_average=not self.train,
+                             momentum=0.9, epsilon=1e-3,
+                             dtype=self.dtype)(x)
         return nn.relu(x)
 
 
@@ -100,10 +109,12 @@ class InceptionV3(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     # MXU-friendly stem conv0 (same params, same outputs): _S2DStemConv
     s2d_stem: bool = False
+    bn_sample: int = 1  # >1: sampled BN statistics (models.resnet)
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
-        conv = partial(ConvBN, dtype=self.dtype, train=train)
+        conv = partial(ConvBN, dtype=self.dtype, train=train,
+                       bn_sample=self.bn_sample)
         x = x.astype(self.dtype)
         # Stem: 299x299x3 -> 35x35x192
         x = conv(32, (3, 3), (2, 2), padding="VALID",

@@ -105,6 +105,72 @@ class SpaceToDepthStem(nn.Module):
             N, 2 * P, 2 * Q, F)
 
 
+class SampledBatchNorm(nn.Module):
+    """BatchNorm whose train-time statistics come from a 1/``sample``
+    slice of the batch (ghost-batch-style sampled statistics).
+
+    Why: the r4 device profile measured BatchNorm statistics at 37.8 %
+    of the ResNet-101 step (docs/mfu.md) — every feature map is
+    re-read for the fwd mean/var and again for the bwd channel sums,
+    and a reduction cannot fuse into the producing conv's epilogue
+    under XLA. Computing statistics over ``batch[: B/sample]`` cuts
+    that reduction traffic by ``sample`` in BOTH directions (autodiff
+    pulls only the sampled rows through the stat grads) while the
+    normalization itself — elementwise, fused into neighboring ops —
+    still covers the full batch.
+
+    ``sample=1`` is exact BatchNorm (oracle-tested against
+    `nn.BatchNorm`); ``sample>1`` estimates the same statistics from
+    fewer rows — the ghost-batch-normalization family (Hoffer et al.
+    2017), here used for bandwidth rather than regularization. Eval
+    (``use_running_average=True``) semantics are unchanged. The
+    variable collections mirror `nn.BatchNorm` (params scale/bias,
+    batch_stats mean/var); ``axis_name`` syncs sampled stats
+    cross-replica exactly like `nn.BatchNorm` does (pmean of mean and
+    mean-of-squares).
+    """
+
+    use_running_average: bool
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Optional[jnp.dtype] = None
+    axis_name: Optional[str] = None
+    sample: int = 4
+    scale_init: Callable = nn.initializers.ones
+
+    @nn.compact
+    def __call__(self, x):
+        C = x.shape[-1]
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((C,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((C,), jnp.float32))
+        scale = self.param("scale", self.scale_init, (C,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (C,),
+                          jnp.float32)
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            n = max(1, x.shape[0] // max(1, self.sample))
+            xs = lax.slice_in_dim(x, 0, n, axis=0)
+            xs = xs.astype(jnp.float32)
+            axes = tuple(range(xs.ndim - 1))
+            mean = xs.mean(axes)
+            mean2 = (xs * xs).mean(axes)
+            if self.axis_name is not None:
+                mean = lax.pmean(mean, self.axis_name)
+                mean2 = lax.pmean(mean2, self.axis_name)
+            var = jnp.maximum(mean2 - mean * mean, 0.0)
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = m * ra_mean.value + (1 - m) * mean
+                ra_var.value = m * ra_var.value + (1 - m) * var
+        inv = lax.rsqrt(var + self.epsilon) * scale
+        y = (x.astype(jnp.float32) - mean) * inv + bias
+        return y.astype(self.dtype or x.dtype)
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: Tuple[int, int]
@@ -144,15 +210,28 @@ class ResNet(nn.Module):
     # outputs, 16x larger stem contraction dim. Off by default so the
     # benchmark measures plain vs s2d explicitly (bench.py --stem).
     s2d_stem: bool = False
+    # >1: train-time BN statistics from batch[: B/bn_sample]
+    # (SampledBatchNorm) — attacks the measured 37.8 %-of-step BN stat
+    # traffic (docs/mfu.md). 1 = exact nn.BatchNorm. The choice is a
+    # model-config constant (not train-flag-dependent) so train and
+    # eval share one variable tree.
+    bn_sample: int = 1
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         conv = partial(nn.Conv, use_bias=False, padding="SAME",
                        dtype=self.dtype)
         bn_axis = self.axis_name if (self.sync_bn and train) else None
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                       axis_name=bn_axis)
+        if self.bn_sample > 1:
+            norm = partial(SampledBatchNorm,
+                           use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5,
+                           dtype=self.dtype, axis_name=bn_axis,
+                           sample=self.bn_sample)
+        else:
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                           axis_name=bn_axis)
 
         x = x.astype(self.dtype)
         if self.s2d_stem:

@@ -189,6 +189,10 @@ class TransformerBlock(nn.Module):
     moe_capacity_factor: float = 1.25
     decode: bool = False
     chunked_prefill: bool = False   # see ParallelSelfAttention
+    # Linear-cache decode reads the filled prefix in slices this big
+    # (see ParallelSelfAttention.decode_prefix_block); 0/None = the
+    # cache-wide-mask path.
+    decode_prefix_block: Optional[int] = 256
     causal: bool = True     # False = bidirectional (encoder / ViT)
     weight_quant: Optional[str] = None   # None | "int8" (block matmuls)
     kv_quant: Optional[str] = None       # None | "int8" (decode cache)
@@ -237,6 +241,7 @@ class TransformerBlock(nn.Module):
             rope_theta=self.rope_theta, window=self.window,
             dtype=self.dtype, attn_fn=attn_fn, decode=self.decode,
             chunked_prefill=self.chunked_prefill,
+            decode_prefix_block=self.decode_prefix_block,
             weight_quant=self.weight_quant,
             kv_quant=self.kv_quant,
             use_bias=self.attn_bias, out_bias=self.attn_out_bias,
@@ -301,6 +306,10 @@ class TransformerLM(nn.Module):
     # mask) instead of the one-pass empty-cache prefill; see
     # ParallelSelfAttention.chunked_prefill.
     chunked_prefill: bool = False
+    # Linear-cache decode attention touches only the filled prefix, in
+    # slices this big (ParallelSelfAttention.decode_prefix_block);
+    # 0/None = cache-wide-mask path.
+    decode_prefix_block: Optional[int] = 256
     # "int8": block matmul kernels stored int8 + per-channel scales
     # (weight-only, inference; `ops.quantization.quantize_lm_params`).
     # Embedding/head and LayerNorms stay full precision.
@@ -377,6 +386,7 @@ class TransformerLM(nn.Module):
                 moe_capacity_factor=self.moe_capacity_factor,
                 decode=self.decode,
                 chunked_prefill=self.chunked_prefill,
+                decode_prefix_block=self.decode_prefix_block,
                 weight_quant=self.weight_quant,
                 kv_quant=self.kv_quant,
                 flash_block_q=self.flash_block_q,
@@ -840,6 +850,28 @@ def _generate_scan(dec_model, params, cache, prompt, rng, steps,
     (_, _, _), outs = lax.scan(
         tick, (cache, tok0, rng), None, length=steps - 1)
     return jnp.concatenate([tok0[:, None], outs.T], axis=1)  # [B, steps]
+
+
+def serving_params(params, dtype=jnp.bfloat16):
+    """Cast the big (ndim >= 2) float params to the serving dtype.
+
+    Params are STORED f32 (training master weights); the modules cast
+    to the compute dtype at every use. Under the decode scan that cast
+    sits inside the loop, so unless XLA hoists it the chip re-reads
+    the f32 bytes every tick — double the weight HBM traffic decode is
+    bound by. Pre-casting pins the win host-side: matrices and the
+    embedding land bf16 (each use site's `astype` becomes a no-op — at
+    rope archs the tokens are bit-identical, oracle-tested), while 1-D
+    params (LayerNorm/RMSNorm scales, biases) stay f32 for their
+    higher-precision epilogues. int8-quantized trees
+    (`quantize_lm_params`) already store int8 + f32 scales; the scales
+    are 1-D so this is a safe no-op on top.
+    """
+    def cast(p):
+        if p.ndim >= 2 and jnp.issubdtype(p.dtype, jnp.floating):
+            return p.astype(dtype)
+        return p
+    return jax.tree.map(cast, params)
 
 
 def lm_param_specs(model: TransformerLM, rng, sample_tokens):

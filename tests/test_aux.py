@@ -251,3 +251,42 @@ def test_bench_deadline_watchdog_paths():
     d = json.loads(r.stdout.strip().splitlines()[-1])
     assert d["value"] == 0.0 and "watchdog" in d["error"]
     assert r.returncode == 1
+
+
+def test_bench_probe_budget_and_heartbeat(monkeypatch):
+    """Budget-driven backend wait (VERDICT r4 next-#1): with budget_s
+    set, probing continues past the fixed attempt count until the
+    wall-clock budget is spent, and the heartbeat callback fires so a
+    still-probing diagnostic stays parseable; without it, the legacy
+    fixed-attempts behavior is unchanged."""
+    import os
+    import subprocess
+    import sys
+    import time
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo)
+    try:
+        import bench
+    finally:
+        sys.path.remove(repo)
+
+    def fake_run(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout"))
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+
+    beats = []
+    t0 = time.time()
+    ok, err, probes, waited = bench.wait_for_backend(
+        attempts=1, probe_timeout_s=5, backoff_s=0.05,
+        budget_s=3.0, heartbeat=lambda e, t: beats.append((e, t)),
+        heartbeat_every_s=0.2)
+    assert not ok and "hung" in err
+    assert probes > 1          # budget overrode the 1-attempt cap
+    assert waited >= 2.0       # patience spanned the budget
+    assert time.time() - t0 < 20
+    assert beats               # still-probing heartbeats fired
+
+    ok, err, probes, _ = bench.wait_for_backend(
+        attempts=3, probe_timeout_s=5, backoff_s=0.0)
+    assert not ok and probes == 3  # legacy mode: fixed attempts

@@ -221,6 +221,68 @@ class TestCompatRegressions:
         opt.apply_gradients([(tf.constant([0.1, 0.1]), v)])
         assert calls == [1]          # normal path averages again
 
+    def test_keras2_get_gradients_path_averages(self, hvd_keras):
+        """Compat-matrix leg: the Keras-2 generation surface. The
+        installed Keras (generation 3) never calls get_gradients, so
+        this drives the interception path with a stub optimizer
+        exposing that generation's API — wrap, average there, one-shot
+        flag set so apply_gradients doesn't re-average (the matrix
+        intent of the reference's .travis.yml TF 1.1/1.4/nightly
+        sweep, pinned per-generation here)."""
+        import horovod.keras as hk
+
+        class Keras2SGD:
+            def __init__(self, lr=0.1):
+                self.lr = lr
+
+            def get_config(self):
+                return {"lr": self.lr}
+
+            @classmethod
+            def from_config(cls, cfg):
+                return cls(**cfg)
+
+            def get_gradients(self, loss, params):
+                return [tf.constant([2.0, 4.0]), None]
+
+            def apply_gradients(self, grads_and_vars, *a, **k):
+                self.applied = [g for g, _ in grads_and_vars]
+
+        opt = hk.DistributedOptimizer(Keras2SGD(lr=0.5))
+        assert opt.__class__.__name__ == "Keras2SGD"
+        assert opt.lr == 0.5                      # config round-trip
+        grads = opt.get_gradients(None, None)
+        # Replicated input across ranks: average == the value itself.
+        np.testing.assert_allclose(np.asarray(grads[0]), [2.0, 4.0])
+        assert grads[1] is None                   # None passes through
+        assert opt._hvd_already_averaged is True
+        opt.apply_gradients([(tf.constant([1.0, 1.0]), "v")])
+        assert opt._hvd_already_averaged is False  # flag consumed
+
+    def test_tf2_legacy_compute_gradients_path_averages(
+            self, hvd_keras):
+        """Compat-matrix leg: the TF2 legacy-optimizer tape surface
+        (_compute_gradients), driven by a stub of that generation."""
+        import horovod.keras as hk
+
+        class LegacyTapeOpt:
+            def get_config(self):
+                return {}
+
+            @classmethod
+            def from_config(cls, cfg):
+                return cls()
+
+            def _compute_gradients(self, loss, var_list,
+                                   grad_loss=None, tape=None):
+                return [(tf.constant([3.0, 3.0]), "v0"), (None, "v1")]
+
+        opt = hk.DistributedOptimizer(LegacyTapeOpt())
+        gv = opt._compute_gradients(None, None)
+        np.testing.assert_allclose(np.asarray(gv[0][0]), 3.0)
+        assert gv[1][0] is None
+        assert opt._hvd_already_averaged is True
+
     def test_warmup_lr_clamped_without_steps(self, hvd_keras):
         """Unknown steps-per-epoch must not push the LR past
         initial_lr * size."""
