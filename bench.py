@@ -384,6 +384,7 @@ def run_decode(args, devices, n_chips, log):
         head_dim=args.head_dim,
         max_len=args.seq, dtype=jnp.bfloat16,
         decode_prefix_block=args.decode_prefix_block or None,
+        decode_prefix_impl=args.decode_prefix_impl,
         attn_impl=args.attn_impl, **_lm_arch_kwargs(args))
     B, P, steps = args.batch, 32, args.decode_steps
     params = unbox(model.init(
@@ -429,6 +430,17 @@ def run_decode(args, devices, n_chips, log):
     kv_itemsize = 1 if args.kv_quant == "int8" else 2
     cache_bytes = (2 * B * slots * Hkv * args.head_dim * kv_itemsize
                    * args.layers)
+    # EFFECTIVE attention path — mirror _decode_attention's dispatch
+    # so the artifact never labels a silent fallback as the requested
+    # engine (a pallas-vs-lax A/B must not compare lax to itself).
+    if args.window is not None:
+        eff_impl = "rolling_window"
+    elif not (blk and args.seq % min(blk, args.seq) == 0):
+        eff_impl = "cache_wide"
+    elif args.decode_prefix_impl == "pallas" and args.kv_quant:
+        eff_impl = "lax"       # kernel is bf16/f32-only
+    else:
+        eff_impl = args.decode_prefix_impl
     prompt = np.random.RandomState(0).randint(0, 32768, (B, P))
     log(f"decode: {n_params / 1e6:.1f}M params, B={B}, prompt={P}, "
         f"steps={steps}, quant={args.weight_quant or 'none'}, "
@@ -452,6 +464,7 @@ def run_decode(args, devices, n_chips, log):
             "ms_per_tick": dt / steps * 1e3,
             "hbm_bytes_per_tick": weight_bytes + cache_bytes,
             "decode_prefix_block": blk or None,
+            "decode_prefix_impl": eff_impl,
             "serve_cast": args.serve_cast,
             "weight_quant": args.weight_quant}
 
@@ -655,9 +668,12 @@ def main():
     ap.add_argument("--attn-impl", default="flash",
                     choices=["dot", "blockwise", "flash", "ring",
                              "ring_flash", "ulysses", "ulysses_flash"])
-    ap.add_argument("--loss-chunk", type=int, default=None,
+    ap.add_argument("--loss-chunk", type=int, default=512,
                     help="transformer: fused head+loss scanned over "
-                         "seq chunks (no [B,S,V] logits)")
+                         "seq chunks — avoids materializing the "
+                         "[B,S,V] logits (2.1 GB bf16 at B16/S2048/"
+                         "V32k, the LM's largest activation); 0 = "
+                         "plain full-logits loss (A/B control)")
     ap.add_argument("--decode", action="store_true",
                     help="transformer: benchmark KV-cache inference "
                          "(generate) instead of training")
@@ -667,6 +683,11 @@ def main():
                          "slices this big instead of masking against "
                          "all max_len slots (0 = cache-wide path; the "
                          "r4 10ms/tick suspect A/B)")
+    ap.add_argument("--decode-prefix-impl", default="lax",
+                    choices=["lax", "pallas"],
+                    help="prefix-attention engine: lax fori_loop "
+                         "(oracle) or the fused Pallas flash-decode "
+                         "kernel (no per-block loop overhead)")
     ap.add_argument("--no-serve-cast", dest="serve_cast",
                     action="store_false", default=True,
                     help="keep decode params stored-f32 (double the "
@@ -1091,6 +1112,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
                 / (HBM_GBPS[device_kind] * 1e9) * 1e3, 3)
             if device_kind in HBM_GBPS else None,
             "decode_prefix_block": r["decode_prefix_block"],
+            "decode_prefix_impl": r["decode_prefix_impl"],
             "serve_cast": r["serve_cast"],
             "decode_steps": args.decode_steps,
             "weight_quant": args.weight_quant,

@@ -193,6 +193,7 @@ class TransformerBlock(nn.Module):
     # (see ParallelSelfAttention.decode_prefix_block); 0/None = the
     # cache-wide-mask path.
     decode_prefix_block: Optional[int] = 256
+    decode_prefix_impl: str = "lax"   # "lax" | "pallas" (flash-decode)
     causal: bool = True     # False = bidirectional (encoder / ViT)
     weight_quant: Optional[str] = None   # None | "int8" (block matmuls)
     kv_quant: Optional[str] = None       # None | "int8" (decode cache)
@@ -242,6 +243,7 @@ class TransformerBlock(nn.Module):
             dtype=self.dtype, attn_fn=attn_fn, decode=self.decode,
             chunked_prefill=self.chunked_prefill,
             decode_prefix_block=self.decode_prefix_block,
+            decode_prefix_impl=self.decode_prefix_impl,
             weight_quant=self.weight_quant,
             kv_quant=self.kv_quant,
             use_bias=self.attn_bias, out_bias=self.attn_out_bias,
@@ -310,6 +312,7 @@ class TransformerLM(nn.Module):
     # slices this big (ParallelSelfAttention.decode_prefix_block);
     # 0/None = cache-wide-mask path.
     decode_prefix_block: Optional[int] = 256
+    decode_prefix_impl: str = "lax"   # "lax" | "pallas" (flash-decode)
     # "int8": block matmul kernels stored int8 + per-channel scales
     # (weight-only, inference; `ops.quantization.quantize_lm_params`).
     # Embedding/head and LayerNorms stay full precision.
@@ -387,6 +390,7 @@ class TransformerLM(nn.Module):
                 decode=self.decode,
                 chunked_prefill=self.chunked_prefill,
                 decode_prefix_block=self.decode_prefix_block,
+                decode_prefix_impl=self.decode_prefix_impl,
                 weight_quant=self.weight_quant,
                 kv_quant=self.kv_quant,
                 flash_block_q=self.flash_block_q,

@@ -892,3 +892,158 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # kv head h//group instead of reading a materialized repeat
 # (`parallel.tensor.ParallelSelfAttention` checks this marker).
 flash_attention.native_gqa = True
+
+
+# ---------------------------------------------------------------------------
+# Flash-decode: single-tick attention against the KV cache.
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(s_ref, q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *,
+                   scale: float, block_k: int, hkv: int, grp: int):
+    """One (batch, k-block) grid cell of the decode tick.
+
+    The cache is consumed IN ITS STORED LAYOUT [B, W, Hkv, D] — a
+    head-major transpose would itself read the whole cache, the exact
+    traffic this kernel exists to avoid. Per kv-head 2D dots (grp q
+    rows each) + one concatenated online-softmax update over the full
+    [H, block] score matrix keep every op a plain Mosaic-lowerable
+    2D primitive (the r4 lesson: interpret mode accepts shapes real
+    Mosaic rejects — stick to [8k, 128m]-safe blocks).
+
+    Scratch persists across the k-block sweep (innermost axis):
+      acc_ref [H, D] f32, m_ref/l_ref [H, 128] f32 (lane-replicated).
+    Scalar prefetch `s_ref`: [0] = number of VALID k-blocks for this
+    tick, [1] = filled prefix length. Blocks past s_ref[0] are skipped
+    (and the index_map clamps them onto the last valid block, whose
+    re-fetch the pipeline elides) — per-tick HBM traffic follows the
+    generated length, not the cache allocation.
+    """
+    j = pl.program_id(1)
+    nblk = s_ref[0]
+    length = s_ref[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    def _block():
+        q = q_ref[0].astype(jnp.float32) * scale        # [H, D]
+        kb = k_ref[0]                                   # [bk, Hkv, D]
+        vb = v_ref[0]
+        parts = []
+        for h in range(hkv):
+            qh = q[h * grp:(h + 1) * grp, :]
+            kh = kb[:, h, :].astype(jnp.float32)        # [bk, D]
+            parts.append(jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))    # [grp, bk]
+        logits = parts[0] if hkv == 1 else jnp.concatenate(parts, 0)
+        pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1)
+        logits = jnp.where(pos < length, logits, NEG_INF)
+
+        m_prev = m_ref[...]                             # [H, 128]
+        l_prev = l_ref[...]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        shift = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(logits - shift[:, :1])              # [H, bk]
+        corr = jnp.where(m_prev == NEG_INF, 0.0,
+                         jnp.exp(m_prev - shift))
+        l_ref[...] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv_parts = []
+        for h in range(hkv):
+            ph = p[h * grp:(h + 1) * grp, :]
+            vh = vb[:, h, :].astype(jnp.float32)
+            pv_parts.append(jax.lax.dot_general(
+                ph, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))    # [grp, D]
+        pv = pv_parts[0] if hkv == 1 else jnp.concatenate(pv_parts, 0)
+        acc_ref[...] = acc_ref[...] * corr[:, :1] + pv
+        m_ref[...] = m_new
+
+    pl.when(j < nblk)(_block)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finalize():
+        l = l_ref[...][:, :1]
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def flash_decode_attention(q: jax.Array, k_cache: jax.Array,
+                           v_cache: jax.Array, length: jax.Array, *,
+                           block_k: int = 512,
+                           interpret: Optional[bool] = None
+                           ) -> jax.Array:
+    """One decode tick of attention against the filled cache prefix.
+
+    q [B, 1, H, D]; k_cache/v_cache [B, W, Hkv, D] (the linear decode
+    cache, already containing the current token at position
+    ``length - 1``); ``length`` traced int32 — the filled prefix
+    length. Returns [B, 1, H, D] at q.dtype.
+
+    One fused kernel per (batch, k-block): only the
+    ceil(length/block_k) leading cache blocks are DMA'd (scalar-
+    prefetched block count; clamped index_map + pipeline elision make
+    the tail free), GQA consumed natively at Hkv width, online softmax
+    in f32 VMEM scratch. The lax.fori_loop equivalent lives in
+    `ParallelSelfAttention._prefix_attention` (`decode_prefix_impl=
+    "lax"`, the default + oracle); this kernel removes that loop's
+    per-iteration overhead. bf16/f32 caches only (int8 KV uses the lax
+    path's per-block dequant).
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    B, W, Hkv, D = k_cache.shape
+    if q.ndim != 4 or q.shape[1] != 1:
+        raise ValueError(f"flash_decode_attention wants q [B,1,H,D], "
+                         f"got {q.shape}")
+    H = q.shape[2]
+    if H % Hkv:
+        raise ValueError(f"H={H} not divisible by Hkv={Hkv}")
+    grp = H // Hkv
+    bk = min(block_k, W)
+    if W % bk:
+        raise ValueError(
+            f"block_k={bk} must divide cache length {W}")
+    nk = W // bk
+    length = jnp.asarray(length, jnp.int32)
+    scalars = jnp.stack([(length + bk - 1) // bk, length])
+
+    q3 = q[:, 0]                                        # [B, H, D]
+    kernel = functools.partial(_decode_kernel, scale=D ** -0.5,
+                               block_k=bk, hkv=Hkv, grp=grp)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, nk),
+        in_specs=[
+            # index_map args: (*grid_indices, *scalar_prefetch_refs) —
+            # the scalar ref comes LAST (jax pallas TPU convention).
+            pl.BlockSpec((1, H, D), lambda b, j, s: (b, 0, 0)),
+            pl.BlockSpec((1, bk, Hkv, D),
+                         lambda b, j, s: (b, jnp.minimum(j, s[0] - 1),
+                                          0, 0)),
+            pl.BlockSpec((1, bk, Hkv, D),
+                         lambda b, j, s: (b, jnp.minimum(j, s[0] - 1),
+                                          0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, j, s: (b, 0, 0)),
+        scratch_shapes=[
+            _scratch((H, D), jnp.float32),
+            _scratch((H, 128), jnp.float32),
+            _scratch((H, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(scalars, q3, k_cache, v_cache)
+    return out[:, None]

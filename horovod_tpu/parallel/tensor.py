@@ -418,6 +418,12 @@ class ParallelSelfAttention(nn.Module):
     # = the cache-wide-mask path (also the fallback when the block
     # doesn't divide the cache length).
     decode_prefix_block: Optional[int] = 256
+    # "lax" (default): the fori_loop prefix attention — composes with
+    # everything (int8 KV, S>1 chunks, any batch rank) and is the
+    # oracle. "pallas": ops.flash_attention.flash_decode_attention —
+    # one fused kernel per tick (no per-block loop overhead); S=1,
+    # un-quantized cache, [B,S,H,D] only, falls back to lax otherwise.
+    decode_prefix_impl: str = "lax"
     # Projections carry no bias by default (LLaMA-style); GPT-2-family
     # checkpoints (compat.hf) need them.
     use_bias: bool = False
@@ -630,8 +636,18 @@ class ParallelSelfAttention(nn.Module):
         (per-block `_repeat_kv`), int8 KV (per-block dequant), and TP
         (all ops are shard-local over the head axis).
         """
+        if self.decode_prefix_impl not in ("lax", "pallas"):
+            raise ValueError(
+                f"decode_prefix_impl must be lax|pallas, got "
+                f"{self.decode_prefix_impl!r}")
         W = cached_k.value.shape[-3]
         blk = min(self.decode_prefix_block, W)
+        if (self.decode_prefix_impl == "pallas" and scale_k is None
+                and q.ndim == 4 and S == 1):
+            from horovod_tpu.ops.flash_attention import (
+                flash_decode_attention)
+            return flash_decode_attention(
+                q, cached_k.value, cached_v.value, i + S, block_k=blk)
         H = self.num_heads
         D = self.head_dim
         lead = q.shape[:-3]

@@ -443,6 +443,47 @@ class TestGenerate:
             np.asarray(out2, np.float32),
             np.asarray(ref[:, 6:], np.float32), atol=2e-4)
 
+    def test_flash_decode_kernel_matches_lax_prefix(self, hvd):
+        """decode_prefix_impl="pallas" (the fused flash-decode
+        kernel, interpret mode on CPU): greedy tokens match the lax
+        fori_loop prefix path exactly, MHA and GQA."""
+        prompt = _tokens(B=2, S=5, seed=60)[:, :5]
+        for kw in ({}, {"num_kv_heads": 2, "pos_emb": "rope"}):
+            base = _tiny_model("blockwise", decode_prefix_block=8,
+                               **kw)
+            params = unbox(base.init(
+                jax.random.PRNGKey(61),
+                jnp.zeros((2, 16), jnp.int32))["params"])
+            ref = generate(base, params, prompt, steps=16)
+            out = generate(base.clone(decode_prefix_impl="pallas"),
+                           params, prompt, steps=16)
+            np.testing.assert_array_equal(np.asarray(out),
+                                          np.asarray(ref))
+
+    def test_flash_decode_int8_kv_falls_back_to_lax(self, hvd):
+        """A quantized cache routes the pallas impl onto the lax
+        per-block-dequant path (the kernel is bf16/f32-only) —
+        token-exact vs the explicit lax impl."""
+        prompt = _tokens(B=2, S=5, seed=62)[:, :5]
+        base = _tiny_model("blockwise", kv_quant="int8",
+                           decode_prefix_block=8)
+        params = unbox(base.init(
+            jax.random.PRNGKey(63),
+            jnp.zeros((2, 16), jnp.int32))["params"])
+        ref = generate(base, params, prompt, steps=12)
+        out = generate(base.clone(decode_prefix_impl="pallas"),
+                       params, prompt, steps=12)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_decode_prefix_impl_validated(self, hvd):
+        base = _tiny_model("blockwise",
+                           decode_prefix_impl="cuda")
+        with pytest.raises(ValueError, match="lax\\|pallas"):
+            generate(base, unbox(base.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 16), jnp.int32))["params"]),
+                jnp.asarray([[1, 2]]), steps=2)
+
     def test_prefix_block_not_dividing_cache_falls_back(self, hvd):
         """A block size that doesn't divide max_len silently uses the
         cache-wide path (a clamped dynamic_slice would re-read
