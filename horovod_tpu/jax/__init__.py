@@ -95,7 +95,9 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
                          axis_name: Optional[str] = None,
                          fusion_threshold: Optional[int] = None,
                          reduce_dtype: Optional[Any] = None,
-                         backward_passes_per_step: int = 1
+                         backward_passes_per_step: int = 1,
+                         compression: Optional[str] = None,
+                         compression_rank: int = 4
                          ) -> optax.GradientTransformation:
     """Wrap an optax transformation with gradient allreduce.
 
@@ -109,15 +111,51 @@ def DistributedOptimizer(optimizer: optax.GradientTransformation,
     accumulated mean — the bandwidth contract the name promises. The
     returned transformation is marked distributed either way, so
     `make_train_step` never adds a second allreduce on top.
-    """
-    def init_fn(params):
-        return optimizer.init(params)
 
-    def update_fn(updates, opt_state, params=None, **extra):
-        updates = allreduce_gradients(
-            updates, axis_name=axis_name, average=average,
+    ``compression``: "fp16" = the reference's wire-dtype compression
+    (`horovod/tensorflow/__init__.py:119-124` Compression.fp16 —
+    sugar for ``reduce_dtype="float16"``); "powersgd" = rank-r
+    factorized allreduce with error feedback
+    (`ops.compression.powersgd_allreduce`, ``compression_rank``) —
+    matrix gradients ship r·(n+m) floats instead of n·m.
+    """
+    if compression not in (None, "fp16", "powersgd"):
+        raise ValueError(
+            f"compression must be None|'fp16'|'powersgd', "
+            f"got {compression!r}")
+    if compression == "fp16" and reduce_dtype is None:
+        reduce_dtype = jnp.float16
+
+    if compression == "powersgd":
+        if not average:
+            raise ValueError(
+                "compression='powersgd' averages by construction "
+                "(the factor allreduces are means); average=False is "
+                "not supported")
+        from horovod_tpu.ops.compression import powersgd_allreduce
+        compressor = powersgd_allreduce(
+            rank=compression_rank, axis_name=axis_name,
             threshold=fusion_threshold, reduce_dtype=reduce_dtype)
-        return optimizer.update(updates, opt_state, params, **extra)
+
+        def init_fn(params):
+            return (compressor.init(params), optimizer.init(params))
+
+        def update_fn(updates, opt_state, params=None, **extra):
+            c_state, in_state = opt_state
+            updates, c_state = compressor.update(updates, c_state,
+                                                 params)
+            updates, in_state = optimizer.update(updates, in_state,
+                                                 params, **extra)
+            return updates, (c_state, in_state)
+    else:
+        def init_fn(params):
+            return optimizer.init(params)
+
+        def update_fn(updates, opt_state, params=None, **extra):
+            updates = allreduce_gradients(
+                updates, axis_name=axis_name, average=average,
+                threshold=fusion_threshold, reduce_dtype=reduce_dtype)
+            return optimizer.update(updates, opt_state, params, **extra)
 
     inner = _DistributedTransformation(init_fn, update_fn)
     if backward_passes_per_step > 1:

@@ -1,0 +1,182 @@
+"""Gradient compression for the data-parallel allreduce.
+
+Parity surface: the reference ships wire-dtype compression —
+`hvd.DistributedOptimizer(compression=hvd.Compression.fp16)`
+(`horovod/tensorflow/__init__.py:119-124, 152-158`: compress before the
+allreduce, decompress after). Here the same knob is the reduce dtype of
+the fused bucket path (`reduce_dtype=` / `HOROVOD_ALLREDUCE_DTYPE`,
+`ops/fusion.py`), and `DistributedOptimizer(compression="fp16")` maps
+onto it.
+
+Beyond the reference: **PowerSGD** (Vogels et al., NeurIPS 2019) —
+rank-r factorized gradient allreduce with error feedback, the standard
+answer when the interconnect (the reference's own bandwidth-bound
+VGG-16 case, `README.md:32`) rather than compute bounds scaling. Per
+matrix-shaped gradient M [n, m] (leading dims folded), with a
+persistent right factor Q [m, r]:
+
+    M  = grad + error            (error feedback)
+    P  = M @ Q        -> allreduce-mean            (r·n floats)
+    P̂  = orthonormalize(P)       (thin QR)
+    Q' = Mᵀ @ P̂       -> allreduce-mean            (r·m floats)
+    approx = P̂ @ Q'ᵀ  ≈ rank-r( mean(M) )
+    error  = M - approx          (carried to the next step)
+
+Bytes on the wire drop from n·m to r·(n+m) per matrix; both
+allreduces ride the SAME fused-bucket machinery as uncompressed
+gradients (`allreduce_gradients`), so fusion thresholds, wire dtype,
+and the SPMD/eager dispatch all apply unchanged. Non-matrix leaves
+(1-D biases/norms), `IndexedSlices`, and matrices too small to win
+(r·(n+m)·2 > n·m) go through the exact allreduce.
+
+TPU notes: the per-leaf matmuls are shard-local MXU work; the QR is
+[n, r] with r tiny (lax.linalg.qr, f32). All compression math runs in
+f32 regardless of the gradient dtype (error feedback in low precision
+destroys the convergence guarantee), outputs cast back.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+__all__ = ["powersgd_allreduce", "PowerSGDState"]
+
+
+class PowerSGDState(NamedTuple):
+    """Per-leaf factor/error-feedback state, parallel to the flattened
+    gradient leaves (None = leaf uses the exact allreduce path)."""
+    qs: Any
+    errs: Any
+    # jax.random key the Qs were drawn from — kept so a state can be
+    # re-initialized deterministically after a checkpoint restore.
+    key: Any
+
+
+def _matrix_view(p: jax.Array) -> jax.Array:
+    """Fold leading dims: [d0, ..., dk, m] -> [n, m]."""
+    return p.reshape(-1, p.shape[-1])
+
+
+def _compressible(p: Any, rank: int) -> bool:
+    from horovod_tpu.ops.sparse import IndexedSlices
+    if isinstance(p, IndexedSlices) or getattr(p, "ndim", 0) < 2:
+        return False
+    if not jnp.issubdtype(p.dtype, jnp.floating):
+        return False
+    n = int(p.size) // int(p.shape[-1])
+    m = int(p.shape[-1])
+    # Compress only where the factorized payload wins by >= 2x.
+    return rank * (n + m) * 2 <= n * m
+
+
+def _orthonormalize(p: jax.Array) -> jax.Array:
+    """Thin-QR orthonormal basis of P's columns (Vogels et al. use
+    Gram-Schmidt; QR spans the same subspace and is one fused op)."""
+    q, _ = jnp.linalg.qr(p)
+    return q
+
+
+def powersgd_allreduce(rank: int = 4, *,
+                       axis_name: Optional[str] = None,
+                       threshold: Optional[int] = None,
+                       reduce_dtype: Optional[Any] = None,
+                       seed: int = 17) -> optax.GradientTransformation:
+    """Rank-``rank`` PowerSGD compress-allreduce as an optax transform.
+
+    Chain it before an optimizer (or use
+    ``hvd.DistributedOptimizer(tx, compression="powersgd")``): its
+    `update` replaces each eligible gradient with the rank-r
+    approximation of the cross-replica MEAN gradient and keeps the
+    residual as error feedback; ineligible leaves are exact-allreduced.
+    Outside any SPMD context (world size 1) the collectives are
+    no-ops and the transform degrades to local rank-r projection +
+    error feedback — same-step output != input, but the CUMULATIVE
+    applied update converges to the true sum (the error-feedback
+    contract, pinned by tests).
+    """
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+
+    def init_fn(params):
+        leaves = jax.tree.leaves(params)
+        key = jax.random.PRNGKey(seed)
+        qs, errs = [], []
+        for idx, p in enumerate(leaves):
+            if _compressible(p, rank):
+                m2 = _matrix_view(p)
+                qs.append(jax.random.normal(
+                    jax.random.fold_in(key, idx),
+                    (m2.shape[1], rank), jnp.float32))
+                errs.append(jnp.zeros(m2.shape, jnp.float32))
+            else:
+                qs.append(None)
+                errs.append(None)
+        return PowerSGDState(qs=tuple(qs), errs=tuple(errs), key=key)
+
+    def update_fn(updates, state, params=None):
+        del params
+        from horovod_tpu.jax import allreduce_gradients
+        from horovod_tpu.ops.sparse import IndexedSlices
+        leaves, treedef = jax.tree.flatten(
+            updates, is_leaf=lambda x: isinstance(x, IndexedSlices))
+        if len(leaves) != len(state.qs):
+            raise ValueError(
+                f"PowerSGD state holds {len(state.qs)} leaves but the "
+                f"gradient tree has {len(leaves)} — init with the same "
+                f"param tree the gradients come from")
+
+        # Eligibility re-checked on the GRADIENT leaf, not just the
+        # init-time param: a sparse IndexedSlices gradient (embedding
+        # layers — models/word2vec.py emits them) or any shape/dtype
+        # surprise at a compressible slot takes the exact path (its
+        # error feedback stays frozen), never _matrix_view.
+        def _still_ok(i):
+            leaf = leaves[i]
+            return (state.qs[i] is not None
+                    and not isinstance(leaf, IndexedSlices)
+                    and getattr(leaf, "ndim", 0) >= 2
+                    and leaf.shape[-1] == state.qs[i].shape[0])
+
+        comp = [i for i in range(len(leaves)) if _still_ok(i)]
+        exact = [i for i in range(len(leaves)) if i not in set(comp)]
+
+        # Exact path first (1-D, sparse, too-small): one fused pass.
+        reduced = list(leaves)
+        if exact:
+            ex = allreduce_gradients(
+                [leaves[i] for i in exact], axis_name=axis_name,
+                average=True, threshold=threshold,
+                reduce_dtype=reduce_dtype)
+            for i, r in zip(exact, ex):
+                reduced[i] = r
+
+        new_qs = list(state.qs)
+        new_errs = list(state.errs)
+        if comp:
+            ms = [_matrix_view(leaves[i]).astype(jnp.float32)
+                  + state.errs[i] for i in comp]
+            ps = [m @ state.qs[i] for m, i in zip(ms, comp)]
+            ps = allreduce_gradients(
+                ps, axis_name=axis_name, average=True,
+                threshold=threshold, reduce_dtype=reduce_dtype)
+            phats = [_orthonormalize(p) for p in ps]
+            qs = [m.T @ ph for m, ph in zip(ms, phats)]
+            qs = allreduce_gradients(
+                qs, axis_name=axis_name, average=True,
+                threshold=threshold, reduce_dtype=reduce_dtype)
+            for m, ph, q, i in zip(ms, phats, qs, comp):
+                approx = ph @ q.T
+                new_errs[i] = m - approx
+                new_qs[i] = q
+                reduced[i] = approx.reshape(
+                    leaves[i].shape).astype(leaves[i].dtype)
+
+        return (jax.tree.unflatten(treedef, reduced),
+                PowerSGDState(qs=tuple(new_qs), errs=tuple(new_errs),
+                              key=state.key))
+
+    return optax.GradientTransformation(init_fn, update_fn)

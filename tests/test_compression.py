@@ -1,0 +1,217 @@
+"""Gradient-compression tests (ops/compression.py).
+
+Reference surface: `hvd.Compression.fp16`
+(`/root/reference/horovod/tensorflow/__init__.py:119-124`) — wire-dtype
+compression, mapped here onto the fused-bucket reduce dtype. Beyond-ref:
+rank-r PowerSGD (Vogels et al. 2019) with error feedback.
+
+Oracle style: exact-reconstruction at full rank, the error-feedback
+telescoping contract (cumulative applied ≈ cumulative true gradient),
+and cross-replica mean semantics inside shard_map on the 8-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.ops.compression import (PowerSGDState, _compressible,
+                                         powersgd_allreduce)
+
+
+def _grads(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": jnp.asarray(rng.randn(48, 32), jnp.float32),   # compressed
+        "b": jnp.asarray(rng.randn(32), jnp.float32),       # exact (1-D)
+        "tiny": jnp.asarray(rng.randn(3, 2), jnp.float32),  # exact (small)
+    }
+
+
+def test_compressible_rule():
+    assert _compressible(jnp.zeros((48, 32)), 4)
+    assert not _compressible(jnp.zeros((32,)), 4)        # 1-D
+    assert not _compressible(jnp.zeros((3, 2)), 4)       # no win
+    assert not _compressible(jnp.zeros((8, 8), jnp.int32), 1)
+
+
+def test_low_rank_gradient_reconstructs_exactly(hvd):
+    """rank(M) <= r: P = M Q spans col(M), so the projection
+    P̂ P̂ᵀ M returns M itself in ONE step — the subspace-capture
+    property PowerSGD's convergence rests on. (A full-rank r never
+    passes the payload-win rule by construction: r(n+m)·2 <= nm fails
+    at r = min(n, m) — so exactness is tested where the premise holds,
+    on a low-rank gradient.)"""
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(48, 2) @ rng.randn(2, 32), jnp.float32)
+    g = {"w": w, "b": jnp.asarray(rng.randn(32), jnp.float32)}
+    tx = powersgd_allreduce(rank=4)
+    state = tx.init(g)
+    out, state = tx.update(g, state)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.asarray(w),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(out["b"]),
+                               np.asarray(g["b"]), atol=0)
+    errs = [e for e in state.errs if e is not None]
+    assert len(errs) == 1
+    assert float(jnp.abs(errs[0]).max()) < 1e-2
+
+
+def test_error_feedback_telescopes(hvd):
+    """The PowerSGD contract: per-step output is lossy, but the SUM of
+    applied updates over k identical-gradient steps approaches the
+    true cumulative gradient — sum(approx) = k·g − err_k, so the
+    relative error decays like |err_k|/(k|g|) once the error-feedback
+    iteration stabilizes. Checked two ways: the error is vanishing for
+    an (almost) low-rank gradient, and DECAYS with k even for a dense
+    flat-spectrum one (the worst case)."""
+    rng = np.random.RandomState(1)
+    low = rng.randn(48, 2) @ rng.randn(2, 32) + 0.01 * rng.randn(48, 32)
+    g = {"w": jnp.asarray(low, jnp.float32)}
+    tx = powersgd_allreduce(rank=4)
+
+    def rel_after(k, grads):
+        state = tx.init(grads)
+        applied = jnp.zeros_like(grads["w"])
+        for _ in range(k):
+            out, state = tx.update(grads, state)
+            applied = applied + out["w"]
+        true = np.asarray(grads["w"]) * k
+        return (np.linalg.norm(np.asarray(applied) - true)
+                / np.linalg.norm(true))
+
+    assert rel_after(20, g) < 0.02, rel_after(20, g)
+
+    dense = {"w": jnp.asarray(rng.randn(48, 32), jnp.float32)}
+    r15, r60 = rel_after(15, dense), rel_after(60, dense)
+    assert r60 < r15 / 2, (r15, r60)   # 1/k telescoping decay
+
+
+def test_orthonormal_basis_and_state_shapes(hvd):
+    g = _grads(seed=2)
+    tx = powersgd_allreduce(rank=3)
+    state = tx.init(g)
+    assert isinstance(state, PowerSGDState)
+    qs = [q for q in state.qs if q is not None]
+    assert len(qs) == 1 and qs[0].shape == (32, 3)
+    out, state2 = tx.update(g, state)
+    # Q evolves (power iteration), error feedback is nonzero at rank 2.
+    assert not np.allclose(np.asarray(state2.qs[-1]),
+                           np.asarray([q for q in state.qs
+                                       if q is not None][0]))
+    assert jax.tree.structure(out) == jax.tree.structure(g)
+
+
+def test_sparse_gradient_at_compressible_slot_goes_exact(hvd):
+    """An IndexedSlices gradient arriving where init saw a dense
+    compressible param (embedding layers: dense [V, D] param, sparse
+    grads) must take the exact path, not crash in _matrix_view."""
+    from horovod_tpu.ops.sparse import IndexedSlices
+    params = {"emb": jnp.zeros((64, 32), jnp.float32)}
+    tx = powersgd_allreduce(rank=4)
+    state = tx.init(params)
+    assert state.qs[0] is not None     # init marked it compressible
+    sparse = IndexedSlices(jnp.ones((2, 32)), jnp.array([1, 3]),
+                           dense_shape=(64, 32))
+    out, state2 = tx.update({"emb": sparse}, state)
+    assert isinstance(out["emb"], IndexedSlices)
+    # Frozen, not dropped: the slot's factor state survives for steps
+    # where the gradient IS dense.
+    assert state2.qs[0] is not None
+
+
+def test_leaf_count_mismatch_raises(hvd):
+    g = _grads()
+    tx = powersgd_allreduce(rank=2)
+    state = tx.init(g)
+    with pytest.raises(ValueError, match="leaves"):
+        tx.update({"w": g["w"]}, state)
+
+
+def test_cross_replica_mean_semantics(hvd):
+    """Inside shard_map, full-rank PowerSGD reproduces the exact MEAN
+    gradient on every replica (the DistributedOptimizer contract), even
+    though each replica contributed a different gradient."""
+    mesh = hvd.mesh()
+    n = hvd.size()
+    rng = np.random.RandomState(3)
+    per_rank = np.stack([rng.randn(24, 16).astype(np.float32)
+                         for _ in range(n)])
+    tx = powersgd_allreduce(rank=16, axis_name="data")
+    state = tx.init({"w": jnp.zeros((24, 16), jnp.float32)})
+
+    def kernel(g):
+        out, _ = tx.update({"w": g[0]}, state)
+        return out["w"]
+
+    fn = jax.jit(jax.shard_map(kernel, mesh=mesh,
+                               in_specs=P("data"), out_specs=P()))
+    out = fn(jnp.asarray(per_rank))
+    np.testing.assert_allclose(np.asarray(out), per_rank.mean(0),
+                               atol=1e-3)
+
+
+def test_distributed_optimizer_powersgd_trains(hvd):
+    """DistributedOptimizer(compression='powersgd') end to end: the
+    SPMD train step converges on the linear problem, through the
+    shared fused-bucket collectives, without a second allreduce."""
+    n = hvd.size()
+    rng = np.random.RandomState(4)
+    w_true = rng.randn(32, 16).astype(np.float32)
+    x = rng.randn(n * 8, 32).astype(np.float32)
+    y = x @ w_true
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        return jnp.mean((xb @ params["w"] - yb) ** 2)
+
+    # [32, 16] passes the payload-win rule at rank 4 (4*48*2 < 512),
+    # so the compressed path actually runs in the SPMD step.
+    tx = hvd.DistributedOptimizer(optax.adam(0.1),
+                                  compression="powersgd",
+                                  compression_rank=4)
+    params = {"w": jnp.zeros((32, 16), jnp.float32)}
+    opt_state = tx.init(params)
+    step = hvd.make_train_step(loss_fn, tx)
+    losses = []
+    for _ in range(80):
+        params, opt_state, loss = step(params, opt_state, (x, y))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_fp16_compression_sugar(hvd):
+    """compression='fp16' == the reference's Compression.fp16: the
+    wire dtype is float16, the applied update is the (quantized) mean."""
+    mesh = hvd.mesh()
+    n = hvd.size()
+    dtx = hvd.DistributedOptimizer(optax.sgd(1.0), compression="fp16")
+    grads = np.stack([np.full((4,), float(r + 1), np.float32)
+                      for r in range(n)])
+    params = jnp.zeros((4,))
+    state = dtx.init(params)
+
+    def kernel(g, p):
+        updates, _ = dtx.update(g[0], state, p)
+        return optax.apply_updates(p, updates)
+
+    fn = jax.jit(jax.shard_map(kernel, mesh=mesh,
+                               in_specs=(P("data"), P()),
+                               out_specs=P()))
+    out = fn(jnp.asarray(grads), params)
+    expected = -np.mean(np.arange(1, n + 1))
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full((4,), expected), rtol=1e-3)
+
+
+def test_powersgd_average_false_rejected(hvd):
+    with pytest.raises(ValueError, match="average"):
+        hvd.DistributedOptimizer(optax.sgd(0.1),
+                                 compression="powersgd", average=False)
+
+
+def test_unknown_compression_rejected(hvd):
+    with pytest.raises(ValueError, match="compression"):
+        hvd.DistributedOptimizer(optax.sgd(0.1), compression="topk")
