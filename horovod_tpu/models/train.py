@@ -43,6 +43,20 @@ def make_cnn_train_step(model, tx: optax.GradientTransformation,
     st = _state.check_initialized()
     mesh = mesh or st.mesh
     axis = axis_name or st.axis_name
+    # An hvd.DistributedOptimizer performs its own gradient allreduce
+    # (possibly compressed — PowerSGD must see RAW local grads, and a
+    # second mean would also waste a bucket pass); the step factory
+    # only reduces for plain optax transforms. The factory's own wire
+    # knobs would then be silently dead — refuse instead of letting a
+    # caller believe their reduce_dtype took effect.
+    from horovod_tpu.jax import _DistributedTransformation
+    tx_distributed = isinstance(tx, _DistributedTransformation)
+    if tx_distributed and (fusion_threshold is not None
+                           or reduce_dtype is not None):
+        raise ValueError(
+            "tx is an hvd.DistributedOptimizer, which owns the "
+            "gradient allreduce — pass fusion_threshold/reduce_dtype "
+            "to DistributedOptimizer(...) instead of the step factory")
 
     def loss_fn(params, batch_stats, images, labels, rng):
         def fwd(p, imgs):
@@ -63,9 +77,10 @@ def make_cnn_train_step(model, tx: optax.GradientTransformation,
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state["params"], state["batch_stats"],
                                    images, labels, rng)
-        grads = fused_allreduce_tree(
-            grads, axis_name=axis, average=True,
-            threshold=fusion_threshold, reduce_dtype=reduce_dtype)
+        if not tx_distributed:
+            grads = fused_allreduce_tree(
+                grads, axis_name=axis, average=True,
+                threshold=fusion_threshold, reduce_dtype=reduce_dtype)
         loss = lax.pmean(loss, axis)
         new_stats = jax.tree.map(lambda x: lax.pmean(x, axis), new_stats)
         updates, new_opt = tx.update(grads, state["opt_state"],

@@ -643,7 +643,10 @@ class ParallelSelfAttention(nn.Module):
         W = cached_k.value.shape[-3]
         blk = min(self.decode_prefix_block, W)
         if (self.decode_prefix_impl == "pallas" and scale_k is None
-                and q.ndim == 4 and S == 1):
+                and q.ndim == 4 and S == 1 and _mesh_is_trivial()):
+            # Trivial-mesh only: a bare pallas_call is opaque to the
+            # GSPMD partitioner, so sharded (TP) decode keeps the lax
+            # path, whose ops partition over the head axis naturally.
             from horovod_tpu.ops.flash_attention import (
                 flash_decode_attention)
             return flash_decode_attention(
@@ -788,6 +791,15 @@ class ParallelSelfAttention(nn.Module):
         self._cache_write(cached_k, cached_v, scale_k, scale_v,
                           index, k, v, i, S, W)
         return out
+
+
+def _mesh_is_trivial() -> bool:
+    """True when no ambient mesh (or an all-size-1 one) is installed —
+    the condition under which a bare pallas_call needs no GSPMD
+    partitioning rule."""
+    mesh = jax.sharding.get_abstract_mesh()
+    return (mesh is None or mesh.empty
+            or all(s == 1 for s in mesh.shape.values()))
 
 
 def _kv_quantize(t: jax.Array):

@@ -97,18 +97,10 @@ def _covered(window: Tuple[float, float],
     return total
 
 
-def analyze_overlap(trace: Dict[str, Any],
-                    device_hint: str = "") -> Optional[Dict[str, Any]]:
-    """Measured α from a loaded Chrome trace.
-
-    Returns None when no device timeline is present (e.g. a CPU-only
-    capture — the CPU backend emits host events only). `device_hint`
-    optionally narrows which process_name counts as the device (by
-    substring); by default anything naming a TPU / device / accelerator
-    that is not the host.
-    """
-    events = (trace if isinstance(trace, list)
-              else trace.get("traceEvents", []))
+def _device_pids(events, device_hint: str = ""):
+    """pids whose process_name marks a device timeline (TPU /
+    accelerator, not host) — the one TPU/host classification heuristic,
+    shared by the overlap and breakdown analyses."""
     proc_names: Dict[Any, str] = {}
     for e in events:
         if e.get("ph") == "M" and e.get("name") == "process_name":
@@ -122,7 +114,22 @@ def analyze_overlap(trace: Dict[str, Any],
             return False
         return any(k in low for k in ("tpu", "device", "accelerator"))
 
-    device_pids = {pid for pid, n in proc_names.items() if is_device(n)}
+    return {pid for pid, n in proc_names.items() if is_device(n)}
+
+
+def analyze_overlap(trace: Dict[str, Any],
+                    device_hint: str = "") -> Optional[Dict[str, Any]]:
+    """Measured α from a loaded Chrome trace.
+
+    Returns None when no device timeline is present (e.g. a CPU-only
+    capture — the CPU backend emits host events only). `device_hint`
+    optionally narrows which process_name counts as the device (by
+    substring); by default anything naming a TPU / device / accelerator
+    that is not the host.
+    """
+    events = (trace if isinstance(trace, list)
+              else trace.get("traceEvents", []))
+    device_pids = _device_pids(events, device_hint)
     if not device_pids:
         return None
 
@@ -198,14 +205,89 @@ def analyze_overlap(trace: Dict[str, Any],
     }
 
 
+def analyze_op_breakdown(trace: Dict[str, Any],
+                         device_hint: str = "",
+                         top_k: int = 10) -> Optional[Dict[str, Any]]:
+    """Where the device step time goes, by HLO op category.
+
+    The r4 ResNet diagnosis (BN statistics = 37.8 % of the step,
+    docs/mfu.md) was assembled by hand from a trace; this automates it
+    so every `bench.py --profile` capture carries its own cost ranking
+    in the artifact (VERDICT r4 next-#5: the profiled configs must
+    yield named top costs, not just a number).
+
+    Category = the event's `hlo_category` arg when the profiler
+    provides it, else the op-name prefix with trailing `.N` indices
+    stripped ("fusion.123" → "fusion"). Returns total device-op time,
+    per-category shares, and the top individual ops.
+    """
+    events = (trace if isinstance(trace, list)
+              else trace.get("traceEvents", []))
+    device_pids = _device_pids(events, device_hint)
+    if not device_pids:
+        return None
+
+    # A real capture's device pid carries SEVERAL lanes — per-op
+    # "XLA Ops" plus aggregate "XLA Modules"/"Steps" rows whose events
+    # span whole steps. Summing every lane double-counts and crowns
+    # the module event the top "category", so when thread_name
+    # metadata identifies an op lane, only those tids count; traces
+    # without lane names (synthetic tests) keep all tids.
+    thread_names: Dict[Any, str] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            thread_names[(e.get("pid"), e.get("tid"))] = (
+                (e.get("args") or {}).get("name", ""))
+    op_tids = {k for k, n in thread_names.items()
+               if k[0] in device_pids and "xla ops" in n.lower()}
+
+    from collections import defaultdict
+    cat_us: Dict[str, float] = defaultdict(float)
+    op_us: Dict[str, float] = defaultdict(float)
+    total = 0.0
+    for e in events:
+        if (e.get("ph") != "X" or e.get("pid") not in device_pids
+                or e.get("dur") is None):
+            continue
+        if op_tids and (e.get("pid"), e.get("tid")) not in op_tids:
+            continue
+        name = e.get("name", "")
+        dur = float(e["dur"])
+        cat = (e.get("args") or {}).get("hlo_category")
+        if not cat:
+            cat = re.sub(r"[.\d]+$", "", name) or name
+        cat_us[cat] += dur
+        op_us[name] += dur
+        total += dur
+    if total <= 0:
+        return None
+    cats = sorted(cat_us.items(), key=lambda kv: -kv[1])
+    ops = sorted(op_us.items(), key=lambda kv: -kv[1])
+    return {
+        "t_total_us": round(total, 3),
+        "categories": [
+            {"category": c, "us": round(v, 3),
+             "share": round(v / total, 4)}
+            for c, v in cats[:top_k]],
+        "top_ops": [
+            {"name": n, "us": round(v, 3),
+             "share": round(v / total, 4)}
+            for n, v in ops[:top_k]],
+    }
+
+
 def analyze_profile_dir(profile_dir: str,
                         min_mtime: Optional[float] = None
                         ) -> Optional[Dict[str, Any]]:
     """Convenience: load the newest trace under `profile_dir` (written
-    at or after `min_mtime`, when given) and analyze; None when there
+    at or after `min_mtime`, when given) and analyze — overlap α plus
+    the per-category op breakdown (`op_breakdown` key); None when there
     is no (fresh enough) trace or no device timeline."""
     try:
         trace = load_trace(profile_dir, min_mtime=min_mtime)
     except (FileNotFoundError, OSError, ValueError):
         return None
-    return analyze_overlap(trace)
+    out = analyze_overlap(trace)
+    if out is not None:
+        out["op_breakdown"] = analyze_op_breakdown(trace)
+    return out

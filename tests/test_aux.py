@@ -141,6 +141,57 @@ def test_overlap_alpha_from_trace(hvd, tmp_path):
     assert r3["alpha"] == 1.0
 
 
+def test_op_breakdown_from_trace(hvd, tmp_path):
+    """Per-category device-time breakdown (VERDICT r4 next-#5: every
+    profiled capture must carry its own cost ranking): hlo_category
+    args win, name-prefix fallback strips trailing indices, shares sum
+    over device events only."""
+    from horovod_tpu.utils.profile_analysis import analyze_profile_dir
+
+    def ev(pid, name, ts, dur, cat=None):
+        e = {"ph": "X", "pid": pid, "tid": 1, "name": name,
+             "ts": ts, "dur": dur}
+        if cat:
+            e["args"] = {"hlo_category": cat}
+        return e
+
+    meta = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+    ]
+    meta = meta + [
+        {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Modules"}},
+    ]
+    events = meta + [
+        ev(1, "fusion.1", 0, 60, cat="convolution fusion"),
+        ev(1, "fusion.2", 60, 20, cat="convolution fusion"),
+        ev(1, "fusion.7", 80, 15, cat="loop fusion"),
+        ev(1, "copy.3", 95, 5),              # no category: prefix
+        # Aggregate module lane spanning the whole step: must NOT be
+        # summed into the per-op breakdown (it would double-count and
+        # crown itself the top category).
+        dict(ev(1, "jit_train_step", 0, 100), tid=2),
+        ev(9, "host-junk", 0, 500),          # host pid excluded
+    ]
+    r = analyze_profile_dir(_chrome_trace(events, tmp_path))
+    b = r["op_breakdown"]
+    assert b["t_total_us"] == 100.0
+    cats = {c["category"]: c for c in b["categories"]}
+    assert cats["convolution fusion"]["us"] == 80.0
+    assert cats["convolution fusion"]["share"] == 0.8
+    assert cats["loop fusion"]["share"] == 0.15
+    assert cats["copy"]["us"] == 5.0         # "copy.3" -> "copy"
+    assert "jit_train_step" not in cats      # module lane excluded
+    top = {o["name"]: o["us"] for o in b["top_ops"]}
+    assert top["fusion.1"] == 60.0
+    assert "jit_train_step" not in top
+
+
 def test_mc_negotiation_stall_names_missing_ranks(hvd, capsys,
                                                   monkeypatch):
     """Coordinator stall sweep parity (VERDICT r3 next-#5 /

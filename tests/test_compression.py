@@ -131,16 +131,22 @@ def test_leaf_count_mismatch_raises(hvd):
 
 
 def test_cross_replica_mean_semantics(hvd):
-    """Inside shard_map, full-rank PowerSGD reproduces the exact MEAN
-    gradient on every replica (the DistributedOptimizer contract), even
-    though each replica contributed a different gradient."""
+    """Inside shard_map, the FACTORIZED path reproduces the exact MEAN
+    gradient on every replica when the per-rank gradients share a
+    low-rank column space (rank(mean) <= r, the subspace-capture
+    premise) — each replica contributes a DIFFERENT gradient, so a
+    sign/averaging bug in either factor allreduce would show."""
     mesh = hvd.mesh()
     n = hvd.size()
     rng = np.random.RandomState(3)
-    per_rank = np.stack([rng.randn(24, 16).astype(np.float32)
-                         for _ in range(n)])
-    tx = powersgd_allreduce(rank=16, axis_name="data")
-    state = tx.init({"w": jnp.zeros((24, 16), jnp.float32)})
+    U = rng.randn(64, 2).astype(np.float32)
+    V = rng.randn(2, 32).astype(np.float32)
+    # Distinct per-rank coefficients on a shared rank-2 basis.
+    per_rank = np.stack([U @ np.diag(rng.randn(2)) @ V
+                         for _ in range(n)]).astype(np.float32)
+    tx = powersgd_allreduce(rank=4, axis_name="data")
+    state = tx.init({"w": jnp.zeros((64, 32), jnp.float32)})
+    assert state.qs[0] is not None   # the compressed path IS active
 
     def kernel(g):
         out, _ = tx.update({"w": g[0]}, state)
@@ -180,6 +186,40 @@ def test_distributed_optimizer_powersgd_trains(hvd):
         params, opt_state, loss = step(params, opt_state, (x, y))
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.1, (losses[0], losses[-1])
+
+
+def test_cnn_train_step_distributed_tx_single_reduce(hvd):
+    """make_cnn_train_step with an hvd.DistributedOptimizer skips the
+    factory's own allreduce (the optimizer reduces): plain-mean
+    DistributedOptimizer therefore matches the plain-optax step
+    EXACTLY, and the compressed path sees raw local grads."""
+    import optax
+    from horovod_tpu import models
+    from horovod_tpu.models import make_cnn_train_step
+    from horovod_tpu.models.train import init_cnn_state
+    rng = np.random.RandomState(5)
+    n = hvd.size()
+    x = jnp.asarray(rng.randn(n * 2, 16, 16, 3), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 10, (n * 2,)))
+    model = models.ResNet(stage_sizes=[1], num_classes=10, width=8,
+                          dtype=jnp.float32)
+    key = jax.random.PRNGKey(0)
+
+    plain = optax.sgd(0.1)
+    st_a = init_cnn_state(model, plain, key, x)
+    step_a = make_cnn_train_step(model, plain)
+    st_a, loss_a = step_a(st_a, (x, y), key)
+
+    dtx = hvd.DistributedOptimizer(optax.sgd(0.1))
+    st_b = init_cnn_state(model, dtx, key, x)
+    step_b = make_cnn_train_step(model, dtx)
+    st_b, loss_b = step_b(st_b, (x, y), key)
+
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    for pa, pb in zip(jax.tree.leaves(st_a["params"]),
+                      jax.tree.leaves(st_b["params"])):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=1e-5, atol=1e-7)
 
 
 def test_fp16_compression_sugar(hvd):

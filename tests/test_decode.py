@@ -92,6 +92,28 @@ class TestGenerate:
                            mesh=mesh)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
+    def test_tp_decode_pallas_impl_falls_back_to_lax(self, hvd):
+        """decode_prefix_impl='pallas' under a dp×tp mesh: a bare
+        pallas_call has no GSPMD partitioning rule, so sharded decode
+        silently keeps the lax prefix path — tokens still match the
+        single-device oracle."""
+        model = _tiny_model(decode_prefix_impl="pallas",
+                            decode_prefix_block=8)
+        prompt = jnp.asarray(
+            np.random.RandomState(70).randint(0, 64, (2, 4)))
+        variables = model.init(jax.random.PRNGKey(71),
+                               jnp.zeros((2, 16), jnp.int32))
+        ref = _oracle_greedy(model, unbox(variables["params"]), prompt,
+                             steps=6)
+        mesh = make_mesh(data=2, model=4)
+        with use(mesh):
+            params = shard_params(mesh, variables["params"])
+            prompt_sh = jax.device_put(
+                prompt, NamedSharding(mesh, P("data", None)))
+            out = generate(model, params, prompt_sh, steps=6,
+                           mesh=mesh)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
     def test_batch_one_decode_on_data_mesh(self, hvd):
         """B=1 decode under an ambient data=4 mesh: the batch dim can't
         shard over ``data``, so `constrain` must replicate it instead of
