@@ -28,6 +28,11 @@ JAX_PLATFORMS=cpu python -m pytest -q --collect-only \
 python -m horovod_tpu.runner -np 2 --platform cpu -- \
     python examples/jax_mnist.py --steps 20
 
+# Compressed-allreduce leg: DistributedOptimizer(compression=powersgd)
+# composed with the CNN step factory (single reduce), multi-process.
+python -m horovod_tpu.runner -np 2 --platform cpu -- \
+    python examples/jax_mnist.py --steps 20 --compression powersgd
+
 python -m horovod_tpu.runner -np 2 --platform cpu -- \
     python examples/jax_mnist_advanced.py --epochs 1
 

@@ -39,6 +39,12 @@ def main():
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--batch-per-rank", type=int, default=32)
     ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--compression", default=None,
+                    choices=["fp16", "powersgd"],
+                    help="gradient compression on the allreduce "
+                         "(docs/tensor-fusion.md): fp16 wire dtype "
+                         "(the reference's Compression.fp16) or "
+                         "rank-4 PowerSGD with error feedback")
     args = ap.parse_args()
 
     # Horovod step 1: initialize the library.
@@ -48,6 +54,11 @@ def main():
     # Horovod step 4: scale the learning rate by the number of workers
     # (reference examples/tensorflow_mnist.py:69-73).
     tx = optax.sgd(args.lr * hvd.size(), momentum=0.9)
+    if args.compression:
+        # The DistributedOptimizer then owns the (single, possibly
+        # compressed) allreduce; the train-step factory detects it and
+        # skips its own.
+        tx = hvd.DistributedOptimizer(tx, compression=args.compression)
 
     rng = jax.random.PRNGKey(42)
     state = init_cnn_state(model, tx, rng, jnp.zeros((1, 28, 28, 1)))

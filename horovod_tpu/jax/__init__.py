@@ -361,6 +361,15 @@ def make_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
     mesh = mesh or st.mesh
     axis = axis_name or st.axis_name
     already_distributed = isinstance(tx, _DistributedTransformation)
+    if already_distributed and (fusion_threshold is not None
+                                or reduce_dtype is not None):
+        # Same contract as make_cnn_train_step: the DistributedOptimizer
+        # owns the allreduce, so the factory's wire knobs would be
+        # silently dead — refuse instead.
+        raise ValueError(
+            "tx is an hvd.DistributedOptimizer, which owns the "
+            "gradient allreduce — pass fusion_threshold/reduce_dtype "
+            "to DistributedOptimizer(...) instead of the step factory")
 
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)

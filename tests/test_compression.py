@@ -246,6 +246,22 @@ def test_fp16_compression_sugar(hvd):
                                np.full((4,), expected), rtol=1e-3)
 
 
+def test_step_factories_reject_dead_wire_knobs(hvd):
+    """Both step factories refuse fusion_threshold/reduce_dtype when
+    tx is a DistributedOptimizer (which owns the allreduce) — the
+    knobs would otherwise be silently dead."""
+    import optax
+    from horovod_tpu import models
+    from horovod_tpu.models import make_cnn_train_step
+    dtx = hvd.DistributedOptimizer(optax.sgd(0.1))
+    with pytest.raises(ValueError, match="owns the gradient"):
+        hvd.make_train_step(lambda p, b: 0.0, dtx,
+                            reduce_dtype=jnp.bfloat16)
+    model = models.ResNet(stage_sizes=[1], num_classes=10, width=8)
+    with pytest.raises(ValueError, match="owns the gradient"):
+        make_cnn_train_step(model, dtx, fusion_threshold=1 << 20)
+
+
 def test_powersgd_average_false_rejected(hvd):
     with pytest.raises(ValueError, match="average"):
         hvd.DistributedOptimizer(optax.sgd(0.1),
