@@ -721,7 +721,9 @@ def lm_fsdp_specs(model: TransformerLM, rng, sample_tokens, mesh, *,
 def generate(model: TransformerLM, params, prompt, steps: int, *,
              mesh=None, temperature: float = 0.0, rng=None,
              top_k: Optional[int] = None,
-             top_p: Optional[float] = None) -> jax.Array:
+             top_p: Optional[float] = None,
+             eos_id: Optional[int] = None,
+             pad_id: int = 0) -> jax.Array:
     """Autoregressive generation with a KV cache.
 
     The reference's inference story is a docs recipe for stripping
@@ -737,6 +739,13 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
     optionally truncated to the ``top_k`` highest-probability tokens
     and/or the ``top_p`` nucleus (smallest set with cumulative
     probability >= top_p).
+
+    ``eos_id``: per-sequence stop token — once a sequence emits it,
+    every later position is ``pad_id`` (the output stays a fixed
+    [B, P + steps] rectangle; finished sequences simply stop changing,
+    the standard batched-serving contract). The cache still advances
+    for finished rows (same compiled program either way), so this is a
+    semantic knob, not a compute saver.
     The prompt is prefilled in ONE forward pass (the decode-mode
     attention masks S>1 blocks causally against the cached prefix), so
     only the generated tokens pay the per-tick latency.
@@ -755,6 +764,16 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
         raise ValueError(
             f"top_k must be in [1, vocab_size={model.vocab_size}], "
             f"got {top_k}")
+    if eos_id is not None and not 0 <= eos_id < model.vocab_size:
+        raise ValueError(
+            f"eos_id must be in [0, vocab_size={model.vocab_size}), "
+            f"got {eos_id}")
+    if eos_id is not None and not 0 <= pad_id < model.vocab_size:
+        # Pad tokens are fed back as inputs for finished rows; an
+        # out-of-vocab id would gather-clamp silently.
+        raise ValueError(
+            f"pad_id must be in [0, vocab_size={model.vocab_size}), "
+            f"got {pad_id}")
     unbounded = model.pos_emb == "rope" and model.window is not None
     if not unbounded and P + steps - 1 > model.max_len:
         # dynamic_update_slice would clamp writes past the cache end —
@@ -779,7 +798,10 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
 
     args = (dec_model, params, cache, prompt, rng, steps,
             float(temperature), top_k,
-            None if top_p is None else float(top_p))
+            None if top_p is None else float(top_p),
+            None if eos_id is None else jnp.asarray(eos_id,
+                                                    prompt.dtype),
+            jnp.asarray(pad_id, prompt.dtype))
     if mesh is not None:
         with use(mesh):
             gen = _generate_scan(*args, greedy=temperature <= 0)
@@ -792,16 +814,18 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
                    static_argnames=("dec_model", "steps", "greedy",
                                     "top_k"))
 def _generate_scan(dec_model, params, cache, prompt, rng, steps,
-                   temperature, top_k=None, top_p=None, *, greedy=False):
+                   temperature, top_k=None, top_p=None, eos=None,
+                   pad=None, *, greedy=False):
     """The compiled prefill+decode loop — module-level so the jit cache
     persists across `generate` calls (flax Modules hash by their
     dataclass fields, so same model config ⇒ cache hit).
 
-    ``temperature`` and ``top_p`` are traced operands, so changing
-    their values reuses the compiled program; what recompiles is the
-    static ``greedy`` flag (temperature <= 0 — selects the argmax
-    branch), ``top_k`` (a shape operand of `lax.top_k`), and toggling
-    ``top_p`` between None and a float (the arg pytree changes)."""
+    ``temperature``, ``top_p``, ``eos``, and ``pad`` are traced
+    operands, so changing their values reuses the compiled program;
+    what recompiles is the static ``greedy`` flag (temperature <= 0 —
+    selects the argmax branch), ``top_k`` (a shape operand of
+    `lax.top_k`), and toggling ``top_p`` or ``eos`` between None and
+    a value (the arg pytree changes)."""
 
     def last_logits(cache, toks):
         """Apply one decode call and project ONLY the last position
@@ -843,16 +867,24 @@ def _generate_scan(dec_model, params, cache, prompt, rng, steps,
     rng, r0 = jax.random.split(rng)
     logits, cache = last_logits(cache, prompt)
     tok0 = pick(logits, r0)
+    # Per-sequence stop: the eos token itself is emitted, every later
+    # position is pad (fixed-rectangle output; the cache still ticks
+    # for finished rows — one compiled program either way).
+    done0 = (tok0 == eos if eos is not None
+             else jnp.zeros(tok0.shape, bool))
 
     def tick(carry, _):
-        cache, tok, r = carry
+        cache, tok, r, done = carry
         r, r_tick = jax.random.split(r)
         logits, cache = last_logits(cache, tok[:, None])
         nxt = pick(logits, r_tick)
-        return (cache, nxt, r), nxt
+        if eos is not None:
+            nxt = jnp.where(done, pad, nxt)
+            done = done | (nxt == eos)
+        return (cache, nxt, r, done), nxt
 
-    (_, _, _), outs = lax.scan(
-        tick, (cache, tok0, rng), None, length=steps - 1)
+    (_, _, _, _), outs = lax.scan(
+        tick, (cache, tok0, rng, done0), None, length=steps - 1)
     return jnp.concatenate([tok0[:, None], outs.T], axis=1)  # [B, steps]
 
 

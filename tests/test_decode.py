@@ -395,6 +395,47 @@ class TestGenerate:
             np.asarray(out2, np.float32),
             np.asarray(ref[:, 6:], np.float32), atol=2e-4)
 
+    def test_eos_stops_sequence_and_pads(self, hvd):
+        """eos_id: each row emits tokens identically to the no-eos run
+        up to and including its first eos, then pad_id fills the rest
+        of the fixed rectangle; rows that never emit eos are unchanged
+        (the batched-serving stop contract)."""
+        model = _tiny_model()
+        prompt = _tokens(B=4, S=4, seed=80)[:, :4]
+        params = unbox(model.init(
+            jax.random.PRNGKey(81),
+            jnp.zeros((4, 16), jnp.int32))["params"])
+        steps, P = 12, 4
+        base = np.asarray(generate(model, params, prompt, steps=steps))
+        gen = base[:, P:]
+        # Choose an eos that actually occurs mid-stream in some row.
+        eos = int(gen[0, steps // 2])
+        out = np.asarray(generate(model, params, prompt, steps=steps,
+                                  eos_id=eos, pad_id=63))
+        np.testing.assert_array_equal(out[:, :P], base[:, :P])
+        for b in range(4):
+            row, ref = out[b, P:], gen[b]
+            hits = np.where(ref == eos)[0]
+            if hits.size == 0:
+                np.testing.assert_array_equal(row, ref)
+            else:
+                k = hits[0]
+                np.testing.assert_array_equal(row[:k + 1], ref[:k + 1])
+                np.testing.assert_array_equal(
+                    row[k + 1:], np.full(steps - k - 1, 63))
+
+    def test_eos_validation(self, hvd):
+        model = _tiny_model()
+        params = unbox(model.init(
+            jax.random.PRNGKey(0),
+            jnp.zeros((1, 16), jnp.int32))["params"])
+        with pytest.raises(ValueError, match="eos_id"):
+            generate(model, params, jnp.asarray([[1, 2]]), steps=2,
+                     eos_id=64)
+        with pytest.raises(ValueError, match="pad_id"):
+            generate(model, params, jnp.asarray([[1, 2]]), steps=2,
+                     eos_id=3, pad_id=64)
+
     def test_prefix_attention_matches_cache_wide(self, hvd):
         """Linear-cache prefix-block decode (`decode_prefix_block`):
         multi-block online-softmax accumulation over only the filled
