@@ -23,8 +23,9 @@ from horovod_tpu.models.bert import (BertBase, BertLarge, BertMLM,
 from horovod_tpu.models.vit import VisionTransformer, ViT_B16, ViT_S16
 from horovod_tpu.models.train import make_cnn_train_step
 from horovod_tpu.models.transformer import (
-    TransformerLM, generate, init_lm_state, lm_fsdp_specs,
-    make_lm_eval_step, make_lm_train_step, serving_params,
+    TransformerLM, generate, generate_bucketed, init_lm_state,
+    lm_fsdp_specs, make_lm_eval_step, make_lm_train_step,
+    serving_params,
 )
 
 __all__ = [
@@ -36,6 +37,7 @@ __all__ = [
     "make_mlm_train_step", "mlm_loss",
     "graft_base", "lora_label_fn", "lora_mask", "merge_lora",
     "generate_speculative",
-    "TransformerLM", "generate", "init_lm_state", "lm_fsdp_specs",
-    "make_lm_eval_step", "make_lm_train_step", "serving_params",
+    "TransformerLM", "generate", "generate_bucketed", "init_lm_state",
+    "lm_fsdp_specs", "make_lm_eval_step", "make_lm_train_step",
+    "serving_params",
 ]

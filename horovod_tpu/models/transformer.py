@@ -888,6 +888,44 @@ def _generate_scan(dec_model, params, cache, prompt, rng, steps,
     return jnp.concatenate([tok0[:, None], outs.T], axis=1)  # [B, steps]
 
 
+def generate_bucketed(model: TransformerLM, params, prompts,
+                      steps: int, **kw):
+    """Mixed-length batched serving via length bucketing.
+
+    `generate` shares one prompt length P per call (the KV cache keeps
+    a single scalar fill index — docs/inference.md's batched-serving
+    contract). This helper makes the documented workaround an API:
+    ``prompts`` is a LIST of 1-D int token arrays; same-length prompts
+    are grouped into one shared-P `generate` call each, and results
+    come back in input order as a list of 1-D [P_i + steps] arrays.
+    All `generate` kwargs pass through (eos_id/pad_id compose). One
+    compile per distinct (length, batch-size) pair — the standard
+    serving-bucket trade.
+    """
+    arrs = [jnp.asarray(p) for p in prompts]
+    by_len: dict = {}
+    for idx, p in enumerate(arrs):
+        if p.ndim != 1:
+            raise ValueError(
+                f"generate_bucketed wants 1-D prompts, got shape "
+                f"{p.shape}; for an already-rectangular batch call "
+                f"generate directly")
+        by_len.setdefault(p.shape[0], []).append(idx)
+    out: list = [None] * len(arrs)
+    for n, idxs in by_len.items():
+        bkw = kw
+        if kw.get("rng") is not None:
+            # Independent sample streams per bucket: the same key fed
+            # to every call would replay identical Gumbel noise.
+            bkw = dict(kw, rng=jax.random.fold_in(kw["rng"], n))
+        res = generate(model, params,
+                       jnp.stack([arrs[i] for i in idxs]), steps,
+                       **bkw)
+        for row, i in enumerate(idxs):
+            out[i] = res[row]
+    return out
+
+
 def serving_params(params, dtype=jnp.bfloat16):
     """Cast the big (ndim >= 2) float params to the serving dtype.
 
