@@ -218,6 +218,20 @@ def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
     }
     if not tied:
         params["lm_head"] = _t(hf_model.lm_head.weight)
+    params.update(_llama_family_blocks(tr, qkv_bias=qkv_bias))
+    return model, params
+
+
+def _llama_family_blocks(tr: Any, *, qkv_bias: bool = False,
+                         fold_norm=None) -> Dict[str, Any]:
+    """The per-layer weight map every LLaMA-lattice converter shares
+    (llama / mistral / qwen2 / gemma): q|k|v concat at kv-head width,
+    o_proj, gate/up/down, pre/post RMSNorm scales. ``fold_norm`` maps
+    a torch norm weight to our scale array (default `_t`; Gemma folds
+    its (1 + w) parameterization here). One site, so a layout change
+    cannot be mirrored into one family member and missed in another."""
+    fold = fold_norm or _t
+    params: Dict[str, Any] = {}
     for i, layer in enumerate(tr.layers):
         sa, mlp = layer.self_attn, layer.mlp
         qkv = np.concatenate(
@@ -230,17 +244,17 @@ def from_hf_llama(hf_model: Any, *, dtype=jnp.bfloat16,
                 [_t(sa.q_proj.bias), _t(sa.k_proj.bias),
                  _t(sa.v_proj.bias)])
         params[f"block_{i}"] = {
-            "ln_attn": {"scale": _t(layer.input_layernorm.weight)},
+            "ln_attn": {"scale": fold(layer.input_layernorm.weight)},
             "attn": attn_tree,
             "ln_mlp": {
-                "scale": _t(layer.post_attention_layernorm.weight)},
+                "scale": fold(layer.post_attention_layernorm.weight)},
             "mlp": {
                 "gate": {"kernel": _t(mlp.gate_proj.weight).T},
                 "up": {"kernel": _t(mlp.up_proj.weight).T},
                 "down": {"kernel": _t(mlp.down_proj.weight).T},
             },
         }
-    return model, params
+    return params
 
 
 def from_hf_mistral(hf_model: Any, *, dtype=jnp.bfloat16,
@@ -421,3 +435,83 @@ def from_hf_qwen2(hf_model: Any, *, dtype=jnp.bfloat16,
     # override rather than mutate the caller's config.
     return from_hf_llama(hf_model, dtype=dtype, attn_impl=attn_impl,
                          window=None)
+
+
+def from_hf_gemma(hf_model: Any, *, dtype=jnp.bfloat16,
+                  attn_impl: str = "flash"
+                  ) -> Tuple[Any, Dict[str, Any]]:
+    """Convert a `transformers.GemmaForCausalLM` (Gemma-1) into
+    `(TransformerLM, params)`.
+
+    The LLaMA lattice (RoPE, GQA, RMSNorm, gated MLP — `from_hf_llama`
+    docstring has the weight map) plus Gemma's three twists, each
+    mapped onto an existing knob:
+
+      * GeGLU MLP (tanh-gelu gate, `gelu_pytorch_tanh`)
+                                    -> ``mlp_impl="geglu"``
+      * input embeddings scaled by sqrt(hidden_size)
+                                    -> ``embed_scale`` (the tied head
+                                       reads the UNSCALED table, both
+                                       here and in torch)
+      * RMSNorm multiplies by (1 + weight)
+                                    -> scales folded at conversion
+                                       (stored as 1 + w; module
+                                       unchanged)
+
+    Gemma-2's logit soft-capping / alternating local-global attention
+    is a different architecture (`Gemma2ForCausalLM`) and is rejected
+    by construction (this converter reads Gemma-1 module names only).
+    """
+    from horovod_tpu.models.transformer import TransformerLM
+
+    tr = getattr(hf_model, "model", hf_model)
+    cfg = hf_model.config
+    d = cfg.hidden_size
+    H = cfg.num_attention_heads
+    Hkv = getattr(cfg, "num_key_value_heads", H) or H
+    # transformers' GemmaMLP builds act_fn from ``hidden_act``
+    # (verified against 4.57: ACT2FN[config.hidden_act]); some configs
+    # ALSO carry ``hidden_activation``. Both, when present, must be
+    # the tanh approximation — checking only the unused field would
+    # silently accept a checkpoint torch runs with exact erf-gelu.
+    acts = {name: a for name in ("hidden_act", "hidden_activation")
+            if (a := getattr(cfg, name, None)) is not None}
+    bad = {n: a for n, a in acts.items() if a != "gelu_pytorch_tanh"}
+    if bad or not acts:
+        raise ValueError(
+            f"unsupported activation {bad or acts} "
+            f"(gelu_pytorch_tanh only — exact-gelu checkpoints would "
+            f"silently drift)")
+    head_dim = getattr(cfg, "head_dim", None) or d // H
+    if head_dim != d // H:
+        raise ValueError(
+            f"head_dim={head_dim} != hidden_size/heads={d // H} "
+            f"(Gemma-7B's widened heads need an out-projection shape "
+            f"our attention block does not carry)")
+    if not bool(getattr(cfg, "tie_word_embeddings", True)):
+        raise ValueError("Gemma ties the LM head; untied is not a "
+                         "Gemma-1 checkpoint")
+    sa0 = tr.layers[0].self_attn
+    if sa0.q_proj.bias is not None or sa0.o_proj.bias is not None:
+        raise ValueError("attention biases are not Gemma-1")
+
+    model = TransformerLM(
+        vocab_size=cfg.vocab_size, num_layers=cfg.num_hidden_layers,
+        num_heads=H, head_dim=head_dim, num_kv_heads=Hkv,
+        max_len=cfg.max_position_embeddings,
+        pos_emb="rope", rope_theta=float(cfg.rope_theta),
+        mlp_hidden=cfg.intermediate_size,
+        norm="rmsnorm", mlp_impl="geglu", tied_head=True,
+        embed_scale=float(d) ** 0.5,
+        ln_eps=float(cfg.rms_norm_eps), dtype=dtype,
+        attn_impl=attn_impl)
+
+    def fold_gemma(w):
+        return _t(w) + 1.0     # Gemma: x_norm * (1 + w)
+
+    params: Dict[str, Any] = {
+        "embed": _t(tr.embed_tokens.weight),
+        "ln_f": {"scale": fold_gemma(tr.norm.weight)},
+    }
+    params.update(_llama_family_blocks(tr, fold_norm=fold_gemma))
+    return model, params

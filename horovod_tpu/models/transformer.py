@@ -259,8 +259,13 @@ class TransformerBlock(nn.Module):
                          dtype=self.dtype, name="moe")(h)
         else:
             hidden = self.mlp_hidden or self.mlp_ratio * d
-            if self.mlp_impl == "swiglu":
+            if self.mlp_impl in ("swiglu", "geglu"):
+                # Same gated two-projection block; geglu (Gemma) gates
+                # with tanh-gelu instead of silu.
                 h = ParallelSwiGLU(hidden=hidden, out=d,
+                                   activation=("gelu_tanh"
+                                               if self.mlp_impl
+                                               == "geglu" else "silu"),
                                    weight_quant=self.weight_quant,
                                    lora_rank=self.lora_rank,
                                    lora_alpha=self.lora_alpha,
@@ -273,7 +278,7 @@ class TransformerBlock(nn.Module):
                                 dtype=self.dtype, name="mlp")(h)
             else:
                 raise ValueError(
-                    f"mlp_impl must be gelu|swiglu, got "
+                    f"mlp_impl must be gelu|swiglu|geglu, got "
                     f"{self.mlp_impl!r}")
         return x + h
 
@@ -331,6 +336,10 @@ class TransformerLM(nn.Module):
     # False: a separate vocab-sharded lm_head param instead of reusing
     # the embedding (LLaMA-family default).
     tied_head: bool = True
+    # Input embeddings multiplied by this after lookup (Gemma:
+    # sqrt(hidden_size)); the tied LM head reads the UNSCALED table,
+    # matching that family's convention. None = 1.
+    embed_scale: Optional[float] = None
     # LoRA (Hu et al. 2021): rank-r adapters on every block Dense;
     # train with `models.lora.lora_label_fn` masking the base frozen,
     # merge for serving with `models.lora.merge_lora`.
@@ -351,12 +360,14 @@ class TransformerLM(nn.Module):
             nn.with_partitioning(nn.initializers.normal(0.02),
                                  (AXIS_MODEL, None)),
             (self.vocab_size, d), jnp.float32)
-        if self.pos_emb == "rope":
+        x = jnp.take(embed, tokens, axis=0)
+        if self.embed_scale is not None:
+            x = x * jnp.asarray(self.embed_scale, x.dtype)
+        if self.pos_emb != "rope":
             # Rotary positions live inside the attention (applied to
-            # q/k at absolute positions); no learned table, no
-            # position state outside the per-block KV cache index.
-            x = jnp.take(embed, tokens, axis=0)
-        else:
+            # q/k at absolute positions — no learned table, no
+            # position state outside the per-block KV cache index);
+            # learned positions add a table slice here.
             pos = self.param("pos", nn.initializers.normal(0.02),
                              (self.max_len, d), jnp.float32)
             if self.decode:
@@ -369,7 +380,7 @@ class TransformerLM(nn.Module):
                     idx.value = idx.value + S
             else:
                 p = pos[:S]
-            x = jnp.take(embed, tokens, axis=0) + p
+            x = x + p
         x = x.astype(self.dtype)
         x = constrain(x, AXIS_DATA, AXIS_SEQ, None)
 

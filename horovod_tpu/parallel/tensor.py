@@ -19,6 +19,7 @@ wants the collectives visible (`column_parallel_matmul` /
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -344,6 +345,9 @@ class ParallelSwiGLU(nn.Module):
     hidden: int
     out: int
     dtype: Optional[Dtype] = None
+    # "silu" (LLaMA SwiGLU) | "gelu_tanh" (Gemma GeGLU — the
+    # gelu_pytorch_tanh approximation, matching torch exactly).
+    activation: str = "silu"
     weight_quant: Optional[str] = None
     lora_rank: int = 0
     lora_alpha: Optional[float] = None
@@ -354,10 +358,18 @@ class ParallelSwiGLU(nn.Module):
                   weight_quant=self.weight_quant,
                   lora_rank=self.lora_rank,
                   lora_alpha=self.lora_alpha)
+        if self.activation == "silu":
+            act = nn.silu
+        elif self.activation == "gelu_tanh":
+            act = functools.partial(nn.gelu, approximate=True)
+        else:
+            raise ValueError(
+                f"activation must be silu|gelu_tanh, got "
+                f"{self.activation!r}")
         g = ColumnParallelDense(self.hidden, name="gate", **kw)(x)
         u = ColumnParallelDense(self.hidden, name="up", **kw)(x)
         return RowParallelDense(self.out, name="down",
-                                **kw)(nn.silu(g) * u)
+                                **kw)(act(g) * u)
 
 
 class ParallelSelfAttention(nn.Module):
