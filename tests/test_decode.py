@@ -454,6 +454,29 @@ class TestGenerate:
             generate_bucketed(model, params,
                               [jnp.zeros((2, 3), jnp.int32)], steps=2)
 
+    def test_serving_params_cast_rules(self, hvd):
+        """serving_params: ndim>=2 float params cast to bf16; 1-D
+        (norm scales/biases) stay f32; int8 leaves untouched; and at
+        a rope/bf16 model the cast is token-exact (each use site's
+        astype becomes a no-op)."""
+        from horovod_tpu.models.transformer import serving_params
+        tree = {"k": jnp.ones((4, 4), jnp.float32),
+                "s": jnp.ones((4,), jnp.float32),
+                "q": jnp.ones((2, 2), jnp.int8)}
+        out = serving_params(tree)
+        assert out["k"].dtype == jnp.bfloat16
+        assert out["s"].dtype == jnp.float32
+        assert out["q"].dtype == jnp.int8
+
+        model = _tiny_model(pos_emb="rope").clone(dtype=jnp.bfloat16)
+        prompt = _tokens(B=2, S=5, seed=95)[:, :5]
+        params = unbox(model.init(
+            jax.random.PRNGKey(96),
+            jnp.zeros((2, 16), jnp.int32))["params"])
+        a = generate(model, params, prompt, steps=8)
+        b = generate(model, serving_params(params), prompt, steps=8)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_eos_validation(self, hvd):
         model = _tiny_model()
         params = unbox(model.init(
