@@ -1,13 +1,15 @@
 """Checkpoint interop: load external pretrained weights into the
 TPU-native model zoo and export them back
 (`compat.hf.from_hf_gpt2` / `from_hf_llama` / `from_hf_mistral` /
-`from_hf_qwen2` / `from_hf_gemma`; `to_hf_gpt2` / `to_hf_llama`)."""
+`from_hf_qwen2` / `from_hf_gemma`; `to_hf_gpt2` / `to_hf_llama` /
+`to_hf_gemma`)."""
 
 from horovod_tpu.compat.hf import (from_hf_gemma, from_hf_gpt2,
                                    from_hf_llama,
                                    from_hf_mistral, from_hf_qwen2,
-                                   to_hf_gpt2, to_hf_llama)
+                                   to_hf_gemma, to_hf_gpt2,
+                                   to_hf_llama)
 
 __all__ = ["from_hf_gemma", "from_hf_gpt2", "from_hf_llama",
            "from_hf_mistral",
-           "from_hf_qwen2", "to_hf_gpt2", "to_hf_llama"]
+           "from_hf_qwen2", "to_hf_gemma", "to_hf_gpt2", "to_hf_llama"]

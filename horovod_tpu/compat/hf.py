@@ -469,19 +469,7 @@ def from_hf_gemma(hf_model: Any, *, dtype=jnp.bfloat16,
     d = cfg.hidden_size
     H = cfg.num_attention_heads
     Hkv = getattr(cfg, "num_key_value_heads", H) or H
-    # transformers' GemmaMLP builds act_fn from ``hidden_act``
-    # (verified against 4.57: ACT2FN[config.hidden_act]); some configs
-    # ALSO carry ``hidden_activation``. Both, when present, must be
-    # the tanh approximation — checking only the unused field would
-    # silently accept a checkpoint torch runs with exact erf-gelu.
-    acts = {name: a for name in ("hidden_act", "hidden_activation")
-            if (a := getattr(cfg, name, None)) is not None}
-    bad = {n: a for n, a in acts.items() if a != "gelu_pytorch_tanh"}
-    if bad or not acts:
-        raise ValueError(
-            f"unsupported activation {bad or acts} "
-            f"(gelu_pytorch_tanh only — exact-gelu checkpoints would "
-            f"silently drift)")
+    _gemma_act_check(cfg)
     head_dim = getattr(cfg, "head_dim", None) or d // H
     if head_dim != d // H:
         raise ValueError(
@@ -515,3 +503,65 @@ def from_hf_gemma(hf_model: Any, *, dtype=jnp.bfloat16,
     }
     params.update(_llama_family_blocks(tr, fold_norm=fold_gemma))
     return model, params
+
+
+def _gemma_act_check(cfg: Any) -> None:
+    """transformers' GemmaMLP builds act_fn from ``hidden_act``
+    (verified against 4.57: ACT2FN[config.hidden_act]); some configs
+    ALSO carry ``hidden_activation``. Both, when present, must be the
+    tanh approximation — checking only the unused field would silently
+    accept a checkpoint torch runs with exact erf-gelu. One site for
+    import AND export, so the two can't disagree on which checkpoints
+    are valid."""
+    acts = {name: a for name in ("hidden_act", "hidden_activation")
+            if (a := getattr(cfg, name, None)) is not None}
+    bad = {n: a for n, a in acts.items() if a != "gelu_pytorch_tanh"}
+    if bad or not acts:
+        raise ValueError(
+            f"unsupported activation {bad or acts} "
+            f"(gelu_pytorch_tanh only — exact-gelu checkpoints would "
+            f"silently drift)")
+
+
+def to_hf_gemma(model: Any, params: Dict[str, Any],
+                hf_model: Any) -> Any:
+    """Write a Gemma-layout tree back into a
+    `transformers.GemmaForCausalLM` — inverse of `from_hf_gemma`:
+    the (1 + w) RMSNorm fold is inverted (w = scale - 1) and the
+    layout write then delegates to `to_hf_llama` (a Gemma shell
+    carries the same LLaMA-family module names), so the weight map
+    stays single-sourced in `_llama_family_blocks`' inverse."""
+    if model.mlp_impl != "geglu" or model.embed_scale is None:
+        raise ValueError(
+            "to_hf_gemma wants a from_hf_gemma-shaped model "
+            f"(mlp_impl='geglu' + embed_scale; got "
+            f"{model.mlp_impl!r}, {model.embed_scale!r})")
+    d = model.num_heads * model.head_dim
+    if abs(float(model.embed_scale) - d ** 0.5) > 1e-6 * d ** 0.5:
+        # torch's GemmaModel hardcodes normalizer = sqrt(hidden); any
+        # other trained-in scale would export silently-different math.
+        raise ValueError(
+            f"embed_scale={model.embed_scale} != sqrt(hidden)="
+            f"{d ** 0.5:.6f} — not exportable as a Gemma checkpoint")
+    cfg = hf_model.config
+    if getattr(cfg, "model_type", None) != "gemma":
+        # A LLaMA-family shell has the same module NAMES but x*w
+        # RMSNorm and no embedding normalizer — the unfolded scales
+        # would load cleanly and run a different model.
+        raise ValueError(
+            f"target shell model_type={getattr(cfg, 'model_type', None)!r} "
+            f"is not 'gemma'")
+    _gemma_act_check(cfg)
+
+    def unfold(scale):
+        return np.asarray(scale, np.float32) - 1.0
+
+    out = dict(params)
+    out["ln_f"] = {"scale": unfold(params["ln_f"]["scale"])}
+    for k, v in params.items():
+        if k.startswith("block_"):
+            b = dict(v)
+            b["ln_attn"] = {"scale": unfold(v["ln_attn"]["scale"])}
+            b["ln_mlp"] = {"scale": unfold(v["ln_mlp"]["scale"])}
+            out[k] = b
+    return to_hf_llama(model, out, hf_model)

@@ -440,3 +440,45 @@ def test_gemma_rejects_non_gemma1_shapes():
     hf = _tiny_gemma(seed=45, hidden_activation="gelu")
     with pytest.raises(ValueError, match="gelu_pytorch_tanh"):
         from_hf_gemma(hf)
+
+
+def test_gemma_roundtrip_export():
+    """from_hf_gemma -> to_hf_gemma into a FRESH shell: the exported
+    torch model's logits match the original (the (1+w) fold inverts
+    exactly); a wrong-activation shell is refused."""
+    from horovod_tpu.compat import from_hf_gemma, to_hf_gemma
+    hf = _tiny_gemma(seed=51)
+    model, params = from_hf_gemma(hf, dtype=jnp.float32,
+                                  attn_impl="blockwise")
+    shell = _tiny_gemma(seed=52)          # different random weights
+    out = to_hf_gemma(model, params, shell)
+    toks = np.random.RandomState(53).randint(0, 97, (2, 9))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(toks)).logits.numpy()
+        got = out(torch.from_numpy(toks)).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    with pytest.raises(ValueError, match="gelu_pytorch_tanh"):
+        to_hf_gemma(model, params, _tiny_gemma(seed=54,
+                                               hidden_act="gelu"))
+    # A llama-shaped (non-geglu) model is not a Gemma tree.
+    from horovod_tpu.compat import from_hf_llama
+    lm, lp = from_hf_llama(_tiny_llama(seed=55), dtype=jnp.float32,
+                           attn_impl="blockwise")
+    with pytest.raises(ValueError, match="geglu"):
+        to_hf_gemma(lm, lp, _tiny_gemma(seed=56))
+    # A non-Gemma shell (same module names, x*w RMSNorm, no embedding
+    # normalizer) must be refused even with a matching activation.
+    llama_shell = _tiny_llama(seed=57, vocab_size=97, hidden_size=32,
+                              intermediate_size=64,
+                              num_hidden_layers=2,
+                              num_attention_heads=4,
+                              num_key_value_heads=2,
+                              hidden_act="gelu_pytorch_tanh",
+                              tie_word_embeddings=True)
+    with pytest.raises(ValueError, match="model_type"):
+        to_hf_gemma(model, params, llama_shell)
+    # A model whose embed_scale isn't sqrt(hidden) is not a Gemma.
+    with pytest.raises(ValueError, match="embed_scale"):
+        to_hf_gemma(model.clone(embed_scale=1.0), params,
+                    _tiny_gemma(seed=58))
