@@ -371,42 +371,12 @@ def run_decode(args, devices, n_chips, log):
     device only (serving is per-replica), so the result is per-chip by
     construction regardless of world size."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
-    from horovod_tpu.models.transformer import TransformerLM, generate
-    from horovod_tpu.parallel.tensor import unbox
+    from horovod_tpu.models.transformer import generate
 
-    model = TransformerLM(
-        vocab_size=32768, num_layers=args.layers,
-        num_heads=args.heads, num_kv_heads=args.kv_heads,
-        pos_emb=args.pos_emb, window=args.window,
-        head_dim=args.head_dim,
-        max_len=args.seq, dtype=jnp.bfloat16,
-        decode_prefix_block=args.decode_prefix_block or None,
-        decode_prefix_impl=args.decode_prefix_impl,
-        attn_impl=args.attn_impl, **_lm_arch_kwargs(args))
+    model, params = _build_decode_lm(args)
     B, P, steps = args.batch, 32, args.decode_steps
-    params = unbox(model.init(
-        jax.random.PRNGKey(0),
-        jnp.zeros((B, 64), jnp.int32))["params"])
-    if args.serve_cast:
-        # Serve at the compute dtype: the stored-f32 master weights
-        # would otherwise be re-read (or re-converted) inside every
-        # decode tick — docs/inference.md roofline term #1.
-        from horovod_tpu.models.transformer import serving_params
-        params = serving_params(params, jnp.bfloat16)
-    if args.weight_quant:
-        # Weight-only int8 serving path: block kernels stored int8,
-        # dequantized in VMEM inside the decode scan (half the weight
-        # HBM traffic per tick).
-        from horovod_tpu.ops.quantization import quantize_lm_params
-        model = model.clone(weight_quant=args.weight_quant)
-        params = quantize_lm_params(params)
-    if args.kv_quant:
-        # int8 KV cache: 2x context per byte of cache HBM, half the
-        # per-tick cache read traffic.
-        model = model.clone(kv_quant=args.kv_quant)
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree.leaves(params))
     # Analytic per-tick HBM roofline (docs/inference.md): every
@@ -467,6 +437,137 @@ def run_decode(args, devices, n_chips, log):
             "decode_prefix_impl": eff_impl,
             "serve_cast": args.serve_cast,
             "weight_quant": args.weight_quant}
+
+
+def _build_decode_lm(args):
+    """(model, params) for the inference benches — ONE construction
+    site so `--decode` and `--serving` cannot drift: arch preset,
+    prefix-block knobs, `--no-serve-cast`, weight-only int8, and the
+    int8 KV cache all compose here."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.models.transformer import TransformerLM
+    from horovod_tpu.parallel.tensor import unbox
+
+    model = TransformerLM(
+        vocab_size=32768, num_layers=args.layers,
+        num_heads=args.heads, num_kv_heads=args.kv_heads,
+        pos_emb=args.pos_emb, window=args.window,
+        head_dim=args.head_dim,
+        max_len=args.seq, dtype=jnp.bfloat16,
+        decode_prefix_block=args.decode_prefix_block or None,
+        decode_prefix_impl=args.decode_prefix_impl,
+        attn_impl=args.attn_impl, **_lm_arch_kwargs(args))
+    params = unbox(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 64), jnp.int32))["params"])
+    if args.serve_cast:
+        # Serve at the compute dtype: the stored-f32 master weights
+        # would otherwise be re-read (or re-converted) inside every
+        # decode tick — docs/inference.md roofline term #1.
+        from horovod_tpu.models.transformer import serving_params
+        params = serving_params(params, jnp.bfloat16)
+    if args.weight_quant:
+        # Weight-only int8 serving path: block kernels stored int8,
+        # dequantized in VMEM inside the decode scan (half the weight
+        # HBM traffic per tick).
+        from horovod_tpu.ops.quantization import quantize_lm_params
+        model = model.clone(weight_quant=args.weight_quant)
+        params = quantize_lm_params(params)
+    if args.kv_quant:
+        # int8 KV cache: 2x context per byte of cache HBM, half the
+        # per-tick cache read traffic.
+        model = model.clone(kv_quant=args.kv_quant)
+    return model, params
+
+
+def run_serving(args, devices, n_chips, log):
+    """Serving-engine throughput/latency under open-loop load: Poisson
+    arrivals against `horovod_tpu.serving.ServingEngine` at each
+    --arrival-rates point, reporting tokens/s plus TTFT/TPOT p50/p95 —
+    the continuous-batching counterpart of the closed-loop `--decode`
+    number (which measures the decode kernel with the batch always
+    full; this measures how close admission + scheduling get to that
+    ceiling when requests arrive asynchronously)."""
+    import jax
+    import numpy as np
+
+    from horovod_tpu.serving import ServingEngine
+
+    model, params = _build_decode_lm(args)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(params))
+    S = args.serving_slots
+    steps = args.decode_steps
+    n_req = args.serving_requests
+    # Prompt lengths sample [4, max_prompt); the engine enforces
+    # P + steps - 1 <= max_len, so max_prompt may never exceed
+    # seq - steps + 1 (a floor here would reintroduce mid-run submit
+    # ValueErrors after a passing warmup).
+    max_prompt = min(64, args.seq - steps + 1)
+    if max_prompt < 5:
+        raise ValueError(
+            f"--seq {args.seq} leaves no prompt room at "
+            f"--decode-steps {steps} (need seq >= steps + 4); raise "
+            f"--seq or lower --decode-steps")
+    rates = [float(r) for r in args.arrival_rates.split(",")]
+    log(f"serving: {n_params / 1e6:.1f}M params, slots={S}, "
+        f"max_new={steps}, {n_req} req/rate at rates={rates} req/s")
+
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 32768, (int(rs.randint(4, max_prompt)),))
+               for _ in range(n_req)]
+
+    # Warmup engine: pays every compile outside the timed windows —
+    # the vmapped tick once, plus one prefill per power-of-two chunk
+    # size any sampled prompt length can decompose into (otherwise the
+    # first rate point's TTFT tail measures XLA, not the scheduler).
+    t0 = time.time()
+    with ServingEngine(model, params, num_slots=S,
+                       max_queue=2 * n_req) as eng:
+        warm = [eng.submit(np.zeros((1 << j,), np.int32),
+                           min(4, steps))
+                for j in range((max_prompt - 1).bit_length())]
+        for h in warm:
+            h.result()
+    log(f"serving warmup (compiles) in {time.time() - t0:.1f}s")
+
+    per_rate = {}
+    best_tok_s = 0.0
+    for rate in rates:
+        gaps = np.random.RandomState(7).exponential(1.0 / rate,
+                                                    size=n_req)
+        eng = ServingEngine(model, params, num_slots=S,
+                            max_queue=2 * n_req)
+        t0 = time.time()
+        handles = []
+        for i, p in enumerate(prompts):
+            handles.append(eng.submit(p, steps))
+            if i < n_req - 1:
+                time.sleep(float(gaps[i]))
+        results = [h.result() for h in handles]
+        eng.shutdown()
+        dt = time.time() - t0
+        snap = eng.metrics_snapshot()
+        out_tokens = sum(len(r.tokens) for r in results)
+        tok_s = out_tokens / dt
+        best_tok_s = max(best_tok_s, tok_s)
+        per_rate[str(rate)] = {
+            "tok_s": round(tok_s, 2),
+            "ttft_ms_p50": snap["ttft_ms"]["p50"],
+            "ttft_ms_p95": snap["ttft_ms"]["p95"],
+            "tpot_ms_p50": snap["tpot_ms"]["p50"],
+            "tpot_ms_p95": snap["tpot_ms"]["p95"],
+            "queue_wait_ms_p95": snap["queue_wait_ms"]["p95"],
+            "completed": snap["completed"],
+        }
+        log(f"serving rate={rate}/s: {tok_s:.1f} tok/s, "
+            f"ttft p50/p95 = {snap['ttft_ms']['p50']}/"
+            f"{snap['ttft_ms']['p95']} ms, tpot p50/p95 = "
+            f"{snap['tpot_ms']['p50']}/{snap['tpot_ms']['p95']} ms")
+    return {"tok_s_chip": best_tok_s, "n_params": n_params,
+            "num_slots": S, "max_new_tokens": steps,
+            "requests_per_rate": n_req, "rates": per_rate}
 
 
 def run_bert(args, devices, n_chips, log):
@@ -677,6 +778,18 @@ def main():
     ap.add_argument("--decode", action="store_true",
                     help="transformer: benchmark KV-cache inference "
                          "(generate) instead of training")
+    ap.add_argument("--serving", action="store_true",
+                    help="transformer: benchmark the continuous-"
+                         "batching ServingEngine under open-loop "
+                         "Poisson arrivals (tokens/s + TTFT/TPOT "
+                         "p50/p95 per --arrival-rates point)")
+    ap.add_argument("--serving-slots", type=int, default=8,
+                    help="serving: decode-slot pool width S")
+    ap.add_argument("--serving-requests", type=int, default=24,
+                    help="serving: requests submitted per rate point")
+    ap.add_argument("--arrival-rates", default="2,6,12",
+                    metavar="R0,R1,...",
+                    help="serving: open-loop arrival rates (req/s)")
     ap.add_argument("--decode-steps", type=int, default=256)
     ap.add_argument("--decode-prefix-block", type=int, default=256,
                     help="decode reads the filled cache prefix in "
@@ -741,10 +854,16 @@ def main():
              f"--arch {args.arch} applies to --model transformer only")
     if args.pos_emb is None:
         args.pos_emb = "rope" if args.arch == "llama" else "learned"
+    if args.serving and not is_lm:
+        fail(f"{args.model}_images_per_sec_per_chip",
+             "images/sec/chip", "bad_arguments",
+             "--serving applies to --model transformer only")
     if is_bert:
         metric, unit = "bert_tokens_per_sec_per_chip", "tokens/sec/chip"
     else:
-        metric = (("transformer_decode_tokens_per_sec_per_chip"
+        metric = (("transformer_serving_tokens_per_sec_per_chip"
+                   if args.serving
+                   else "transformer_decode_tokens_per_sec_per_chip"
                    if args.decode
                    else "transformer_tokens_per_sec_per_chip")
                   if is_lm else f"{args.model}_images_per_sec_per_chip")
@@ -1090,6 +1209,26 @@ def _bench_body(args, devices, n_chips, metric, unit,
                 r["tok_s_chip"] * r["flops_per_tok"] / peak, 4)
             if peak else None,
             "overlap_measured": _measured_overlap(args),
+        })
+        emit(_BEST_RESULT)
+        return
+    if is_lm and args.serving:
+        r = run_serving(args, devices, n_chips, log)
+        _set_best({
+            "metric": metric,
+            "value": round(r["tok_s_chip"], 1),
+            "unit": unit,
+            "vs_baseline": None,  # reference has no serving path
+            "platform": platform,
+            "device_kind": device_kind,
+            "chips": 1,  # the engine runs on the default device
+            "num_slots": r["num_slots"],
+            "max_new_tokens": r["max_new_tokens"],
+            "requests_per_rate": r["requests_per_rate"],
+            "seq": args.seq,
+            "params_m": round(r["n_params"] / 1e6, 1),
+            "rates": r["rates"],
+            "arch": args.arch,
         })
         emit(_BEST_RESULT)
         return

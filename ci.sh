@@ -25,6 +25,11 @@ JAX_PLATFORMS=cpu python -m pytest -q --collect-only \
     "tests/test_tf_compat.py::TestCompatRegressions::test_tf2_legacy_compute_gradients_path_averages" \
     > /dev/null
 
+# Serving-engine smoke: 4 concurrent requests through the continuous-
+# batching engine on CPU; asserts completion AND token-exactness vs
+# sequential generate (the engine's oracle contract).
+JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4
+
 python -m horovod_tpu.runner -np 2 --platform cpu -- \
     python examples/jax_mnist.py --steps 20
 

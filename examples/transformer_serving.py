@@ -1,0 +1,69 @@
+"""Continuous-batching serving engine — submit / stream / shed demo.
+
+The serving counterpart of `transformer_generate.py`: instead of one
+batched `generate` call, concurrent requests go through
+`horovod_tpu.serving.ServingEngine` — a bounded admission queue in
+front of a slot-pool KV cache scheduled at token granularity — and the
+engine reports TTFT/TPOT/tokens-per-second at the end.
+
+Doubles as the CI smoke (ci.sh): submits --requests concurrent
+mixed-length prompts on CPU, asserts every one completes AND matches
+sequential `generate` token for token, then prints the metrics
+snapshot.
+
+Run:  python examples/transformer_serving.py --requests 4
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.models.transformer import TransformerLM, generate
+from horovod_tpu.parallel.tensor import unbox
+from horovod_tpu.serving import ServingEngine
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=4)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--max-new-tokens", type=int, default=8)
+    args = ap.parse_args()
+
+    model = TransformerLM(vocab_size=128, num_layers=2, num_heads=4,
+                          head_dim=16, max_len=64, dtype=jnp.float32)
+    params = unbox(model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))["params"])
+
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, 128, (int(rs.randint(2, 12)),))
+               for _ in range(args.requests)]
+
+    with ServingEngine(model, params, num_slots=args.slots,
+                       max_queue=2 * args.requests) as eng:
+        handles = [eng.submit(p, args.max_new_tokens)
+                   for p in prompts]
+        results = [h.result(timeout=600) for h in handles]
+
+    assert all(r.finish_reason == "length" for r in results), results
+    for p, r in zip(prompts, results):
+        ref = np.asarray(generate(model, params, jnp.asarray(p)[None],
+                                  args.max_new_tokens))[0]
+        np.testing.assert_array_equal(r.full_sequence, ref)
+    snap = eng.metrics_snapshot()
+    print(json.dumps(snap, indent=1))
+    assert snap["completed"] == args.requests
+    print(f"serving smoke OK: {args.requests} requests, "
+          f"{snap['tokens_out']} tokens, token-exact vs generate")
+
+
+if __name__ == "__main__":
+    main()

@@ -140,7 +140,8 @@ def make_attn_fn(impl: str, *, causal: bool = True,
             # Off-mesh (e.g. model.init, single-device eval) there is no
             # seq axis to ring over; blockwise is the same math locally
             # and attention has no params, so the init trace is identical.
-            mesh = jax.sharding.get_abstract_mesh()
+            from horovod_tpu.parallel.mesh import abstract_mesh
+            mesh = abstract_mesh()
             if mesh is None or mesh.empty:
                 if native_gqa and k.shape[2] != q.shape[2]:
                     # The flash paths take grouped K/V natively; the
@@ -734,7 +735,8 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
              top_k: Optional[int] = None,
              top_p: Optional[float] = None,
              eos_id: Optional[int] = None,
-             pad_id: int = 0) -> jax.Array:
+             pad_id: int = 0,
+             early_stop: bool = False) -> jax.Array:
     """Autoregressive generation with a KV cache.
 
     The reference's inference story is a docs recipe for stripping
@@ -754,9 +756,18 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
     ``eos_id``: per-sequence stop token — once a sequence emits it,
     every later position is ``pad_id`` (the output stays a fixed
     [B, P + steps] rectangle; finished sequences simply stop changing,
-    the standard batched-serving contract). The cache still advances
-    for finished rows (same compiled program either way), so this is a
-    semantic knob, not a compute saver.
+    the standard batched-serving contract). By default the cache still
+    advances for finished rows (same compiled program either way), so
+    eos alone is a semantic knob, not a compute saver.
+
+    ``early_stop`` (requires ``eos_id``): make it a compute saver —
+    the decode loop runs as a `lax.while_loop` that exits as soon as
+    EVERY row has emitted eos, instead of a fixed-length scan. The
+    output keeps the same [B, P + steps] rectangle and the same
+    post-eos padding contract (unvisited positions are ``pad_id``), so
+    tokens are identical to the scan path; only the wall clock
+    shrinks. The win compounds under `generate_bucketed`, where each
+    bucket stops at its own last finisher.
     The prompt is prefilled in ONE forward pass (the decode-mode
     attention masks S>1 blocks causally against the cached prefix), so
     only the generated tokens pay the per-tick latency.
@@ -785,6 +796,9 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
         raise ValueError(
             f"pad_id must be in [0, vocab_size={model.vocab_size}), "
             f"got {pad_id}")
+    if early_stop and eos_id is None:
+        raise ValueError("early_stop requires eos_id (without a stop "
+                         "token there is nothing to stop early on)")
     unbounded = model.pos_emb == "rope" and model.window is not None
     if not unbounded and P + steps - 1 > model.max_len:
         # dynamic_update_slice would clamp writes past the cache end —
@@ -815,18 +829,20 @@ def generate(model: TransformerLM, params, prompt, steps: int, *,
             jnp.asarray(pad_id, prompt.dtype))
     if mesh is not None:
         with use(mesh):
-            gen = _generate_scan(*args, greedy=temperature <= 0)
+            gen = _generate_scan(*args, greedy=temperature <= 0,
+                                 early_stop=early_stop)
     else:
-        gen = _generate_scan(*args, greedy=temperature <= 0)
+        gen = _generate_scan(*args, greedy=temperature <= 0,
+                             early_stop=early_stop)
     return jnp.concatenate([prompt, gen], axis=1)
 
 
 @functools.partial(jax.jit,
                    static_argnames=("dec_model", "steps", "greedy",
-                                    "top_k"))
+                                    "top_k", "early_stop"))
 def _generate_scan(dec_model, params, cache, prompt, rng, steps,
                    temperature, top_k=None, top_p=None, eos=None,
-                   pad=None, *, greedy=False):
+                   pad=None, *, greedy=False, early_stop=False):
     """The compiled prefill+decode loop — module-level so the jit cache
     persists across `generate` calls (flax Modules hash by their
     dataclass fields, so same model config ⇒ cache hit).
@@ -859,17 +875,7 @@ def _generate_scan(dec_model, params, cache, prompt, rng, steps,
             kth = lax.top_k(logits, top_k)[0][..., -1:]
             logits = jnp.where(logits < kth, neg, logits)
         if top_p is not None:
-            # Nucleus: keep the smallest prefix of the sorted
-            # distribution with cumulative probability >= top_p.
-            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
-            csum = jnp.cumsum(probs, axis=-1)
-            keep = csum - probs < top_p      # first token always kept
-            # Threshold = smallest kept logit; mask everything below.
-            thresh = jnp.min(
-                jnp.where(keep, sorted_logits, jnp.inf),
-                axis=-1, keepdims=True)
-            logits = jnp.where(logits < thresh, neg, logits)
+            logits = nucleus_mask(logits, top_p)
         nxt = jax.random.categorical(r, logits)
         return nxt.astype(prompt.dtype)
 
@@ -894,6 +900,31 @@ def _generate_scan(dec_model, params, cache, prompt, rng, steps,
             done = done | (nxt == eos)
         return (cache, nxt, r, done), nxt
 
+    if early_stop:
+        # while_loop twin of the scan below: same tick body writing
+        # into a pad-prefilled [B, steps-1] buffer, but the loop exits
+        # as soon as every row is done — unvisited columns stay pad,
+        # so the output rectangle is identical to the scan path's.
+        B = prompt.shape[0]
+        buf0 = jnp.full((B, steps - 1), pad, prompt.dtype)
+
+        def cond(state):
+            t, carry, _ = state
+            done = carry[3]
+            return (t < steps - 1) & ~done.all()
+
+        def body(state):
+            t, carry, buf = state
+            carry, nxt = tick(carry, None)
+            buf = lax.dynamic_update_slice(
+                buf, nxt[:, None], (jnp.zeros((), t.dtype), t))
+            return t + 1, carry, buf
+
+        _, _, outs = lax.while_loop(
+            cond, body, (jnp.zeros((), jnp.int32),
+                         (cache, tok0, rng, done0), buf0))
+        return jnp.concatenate([tok0[:, None], outs], axis=1)
+
     (_, _, _, _), outs = lax.scan(
         tick, (cache, tok0, rng, done0), None, length=steps - 1)
     return jnp.concatenate([tok0[:, None], outs.T], axis=1)  # [B, steps]
@@ -909,7 +940,10 @@ def generate_bucketed(model: TransformerLM, params, prompts,
     ``prompts`` is a LIST of 1-D int token arrays; same-length prompts
     are grouped into one shared-P `generate` call each, and results
     come back in input order as a list of 1-D [P_i + steps] arrays.
-    All `generate` kwargs pass through (eos_id/pad_id compose). One
+    All `generate` kwargs pass through — eos_id/pad_id keep the same
+    post-eos padding contract per row, and ``early_stop=True`` (with
+    eos_id) stops each bucket's decode loop at that bucket's last
+    finisher instead of always paying all ``steps`` ticks. One
     compile per distinct (length, batch-size) pair — the standard
     serving-bucket trade.
     """
@@ -935,6 +969,147 @@ def generate_bucketed(model: TransformerLM, params, prompts,
         for row, i in enumerate(idxs):
             out[i] = res[row]
     return out
+
+
+# ---------------------------------------------------------------------------
+# Slot-aware decode (the device surface of `horovod_tpu.serving`).
+#
+# `generate` shares ONE scalar `cache_index` across the batch, so every
+# row must be at the same fill level — fine for offline batches, fatal
+# for continuous batching, where each slot of the decode batch holds a
+# different request at a different depth. These primitives generalize
+# the linear cache to a SLOT POOL: every cache leaf gains a leading
+# [num_slots] axis (so the per-layer `cache_index`/`pos_index` scalars
+# become per-slot vectors), prefill appends into one slot's rows via
+# the `chunked_prefill` cache-wide-mask path (correct at any fill), and
+# the decode tick `jax.vmap`s the B=1 decode step over the slot axis —
+# per-slot RoPE offsets, per-slot prefix-attention trip counts, and the
+# per-row `dynamic_update_slice` cache writes all fall out of the vmap.
+# ---------------------------------------------------------------------------
+
+def slot_decode_model(model: TransformerLM) -> TransformerLM:
+    """The decode-mode clone every slot primitive shares. ONE clone
+    config (decode + chunked_prefill) serves both prefill chunks (S>1
+    appends at arbitrary fill) and S=1 ticks, so the flax-module hash —
+    and therefore the jit cache — is shared across all of them."""
+    return model.clone(decode=True, chunked_prefill=True)
+
+
+def init_slot_cache(model: TransformerLM, num_slots: int):
+    """Zero-filled slot-pool cache: each leaf of the B=1 decode cache
+    with a leading [num_slots] axis (K/V [num_slots, 1, max_len, Hkv,
+    D]; the scalar fill indices become [num_slots] vectors)."""
+    dec_model = slot_decode_model(model)
+    shapes = jax.eval_shape(
+        dec_model.init, jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, model.max_len), jnp.int32))
+    return jax.tree.map(
+        lambda s: jnp.zeros((num_slots,) + s.shape, s.dtype),
+        shapes["cache"])
+
+
+@functools.partial(jax.jit, static_argnames=("dec_model",),
+                   donate_argnums=(1,))
+def slot_reset(dec_model, cache, slot):
+    """Zero one slot's rows across every cache leaf (alloc/retire
+    hygiene: fill indices return to 0; stale K/V past the new fill is
+    never attended — the causal masks see positions, not bytes — but
+    zeroing the whole row keeps the slot's state trivially inspectable
+    and stops idle-slot index creep from inflating the shared vmapped
+    tick's prefix-attention trip count)."""
+    del dec_model  # part of the key so all slot fns share a cache line
+    return jax.tree.map(
+        lambda l: l.at[slot].set(jnp.zeros(l.shape[1:], l.dtype)),
+        cache)
+
+
+@functools.partial(jax.jit, static_argnames=("dec_model",),
+                   donate_argnums=(2,))
+def slot_prefill_chunk(dec_model, params, cache, slot, chunk):
+    """Append one [C]-token prompt chunk into slot ``slot``'s cache and
+    return ``(cache, last-position logits [V])``.
+
+    Runs the `chunked_prefill` path (cache-wide mask — correct for ANY
+    current fill), so a prompt of arbitrary length P streams in as its
+    binary decomposition of power-of-two chunks (`prefill_chunks`):
+    at most log2(max_len) DISTINCT compiled programs ever, instead of
+    one compile per prompt length. ``slot`` is a traced operand, so the
+    same program serves every slot."""
+    sub = jax.tree.map(lambda l: l[slot], cache)
+    (hidden, embed), mut = dec_model.apply(
+        {"params": params, "cache": sub}, chunk[None, :],
+        return_hidden=True, mutable=["cache"])
+    logits = jnp.einsum("d,vd->v", hidden[0, -1],
+                        embed.astype(hidden.dtype))
+    cache = jax.tree.map(lambda l, s: l.at[slot].set(s), cache,
+                         mut["cache"])
+    return cache, logits.astype(jnp.float32)
+
+
+def prefill_chunks(length: int) -> list:
+    """Binary decomposition of a prompt length into descending
+    power-of-two chunk sizes (13 -> [8, 4, 1]) — the compile-bounded
+    schedule `slot_prefill_chunk` is fed with."""
+    if length <= 0:
+        raise ValueError(f"prompt length must be positive, got {length}")
+    return [1 << b for b in range(length.bit_length() - 1, -1, -1)
+            if length >> b & 1]
+
+
+def nucleus_mask(logits, top_p):
+    """Top-p (nucleus) truncation: mask (to -max) every logit outside
+    the smallest prefix of the sorted distribution with cumulative
+    probability >= top_p; the first token is always kept. THE one
+    nucleus rule — `generate`'s pick and the serving tick's
+    `sample_token` both call it, so the two paths cannot drift."""
+    neg = jnp.finfo(logits.dtype).min
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    keep = csum - probs < top_p
+    # Threshold = smallest kept logit; mask everything below.
+    thresh = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits < thresh, neg, logits)
+
+
+def sample_token(logits, temperature, top_p, key):
+    """One sampled (or greedy) token from [V] logits, with TRACED
+    temperature/top_p so one compiled program serves every request mix:
+    temperature <= 0 selects argmax, top_p >= 1 disables the nucleus
+    truncation (`nucleus_mask`, shared with `generate`'s pick). The
+    serving tick vmaps this over slots."""
+    greedy = jnp.argmax(logits, axis=-1)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    sampled = jax.random.categorical(
+        key, jnp.where(top_p < 1.0, nucleus_mask(scaled, top_p),
+                       scaled))
+    return jnp.where(temperature <= 0.0, greedy, sampled)
+
+
+@functools.partial(jax.jit, static_argnames=("dec_model",),
+                   donate_argnums=(2,))
+def slot_decode_tick(dec_model, params, cache, toks, temps, top_ps,
+                     rngs):
+    """One continuous-batching decode tick over EVERY slot: vmap of the
+    B=1 decode step over the slot axis. Returns ``(cache, next_toks
+    [num_slots], new_rngs)``. Free slots tick too — decoding garbage
+    and CREEPING their fill index, which the pool's prefill-time
+    `slot_reset` erases before the slot is reused — the
+    fixed-rectangle trade `generate` makes for finished rows, here
+    buying ONE compiled program for every occupancy pattern."""
+
+    def one(sub, tok, temp, top_p, rng):
+        (hidden, embed), mut = dec_model.apply(
+            {"params": params, "cache": sub}, tok[None, None],
+            return_hidden=True, mutable=["cache"])
+        logits = jnp.einsum("d,vd->v", hidden[0, -1],
+                            embed.astype(hidden.dtype))
+        rng, r = jax.random.split(rng)
+        nxt = sample_token(logits.astype(jnp.float32), temp, top_p, r)
+        return mut["cache"], nxt.astype(tok.dtype), rng
+
+    return jax.vmap(one)(cache, toks, temps, top_ps, rngs)
 
 
 def serving_params(params, dtype=jnp.bfloat16):

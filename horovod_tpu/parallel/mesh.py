@@ -127,10 +127,52 @@ def ring_perms(axis_name: str):
 def use(mesh: Mesh):
     """Context manager installing `mesh` as the ambient mesh for
     P(...)-spec sharding constraints (insulates the jax API rename:
-    `jax.set_mesh` ≥0.8, `jax.sharding.use_mesh` before)."""
+    `jax.set_mesh` ≥0.8, `jax.sharding.use_mesh` before, and on 0.4.x
+    the `Mesh` object itself — it is its own context manager there,
+    installing the thread-resources physical mesh)."""
     if hasattr(jax, "set_mesh"):
         return jax.set_mesh(mesh)
-    return jax.sharding.use_mesh(mesh)  # pragma: no cover
+    if hasattr(jax.sharding, "use_mesh"):
+        return jax.sharding.use_mesh(mesh)
+    return mesh
+
+
+def abstract_mesh():
+    """Version-insulated `jax.sharding.get_abstract_mesh()`: the
+    ambient mesh installed by `use()`, or None when off-mesh.
+
+    jax ≥0.5 exposes it directly; on 0.4.x the ambient mesh lives in
+    the thread-resources env (set by the `with mesh:` protocol `use()`
+    falls back to) and its `.abstract_mesh` view carries the same
+    axis_names/shape surface the callers consume.
+    """
+    get = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get is not None:
+        return get()
+    from jax._src import mesh as mesh_lib
+    m = mesh_lib.thread_resources.env.physical_mesh
+    return None if m.empty else m.abstract_mesh
+
+
+def auto_axis_names(mesh) -> set:
+    """The mesh axes GSPMD may still shard over (type Auto) — the only
+    ones a sharding constraint is allowed to mention.
+
+    jax ≥0.5 tags every mesh axis Auto/Manual/Explicit; on 0.4.x there
+    are no per-axis types, but axes bound in the current axis env
+    (i.e. inside an enclosing shard_map region) are exactly the Manual
+    ones, so everything else is Auto.
+    """
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    if axis_type is not None:
+        return {n for n, t in zip(mesh.axis_names, mesh.axis_types)
+                if t == axis_type.Auto}
+    from jax._src import core as _core
+    try:
+        manual = set(_core.get_axis_env().axis_sizes)
+    except Exception:  # pragma: no cover — axis env API drift
+        manual = set()
+    return set(mesh.axis_names) - manual
 
 
 def sharding(mesh: Mesh, *spec) -> NamedSharding:
@@ -156,7 +198,11 @@ def shard_batch(mesh: Mesh, batch,
     (`examples/keras_mnist_advanced.py:113-119` divides steps per epoch by
     `hvd.size()`): here one global batch is laid out across the data axis.
     """
-    sh = sharding(mesh, tuple(axes))
+    # Single-axis: pass the bare name, not a 1-tuple — semantically
+    # identical, but old jax PartitionSpec __eq__ does not normalize
+    # (P(('data',)) != P('data')), and the bare form is what spec
+    # introspection everywhere else compares against.
+    sh = sharding(mesh, axes[0] if len(axes) == 1 else tuple(axes))
     return jax.tree.map(lambda x: _place(x, sh), batch)
 
 
@@ -179,14 +225,13 @@ def constrain(x, *spec):
     (GSPMD cannot shard it — e.g. a batch-1 decode on a data-parallel
     mesh keeps its activations replicated instead of erroring).
     """
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = abstract_mesh()
     if mesh is None or mesh.empty:
         return x
     # Only Auto axes may appear in a sharding constraint; axes already
     # Manual (inside an enclosing shard_map, e.g. the pipeline loop) are
     # out of GSPMD's hands and must be dropped from the spec.
-    names = {n for n, t in zip(mesh.axis_names, mesh.axis_types)
-             if t == jax.sharding.AxisType.Auto}
+    names = auto_axis_names(mesh)
     if not names:
         return x
     sizes = dict(mesh.shape)

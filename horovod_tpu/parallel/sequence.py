@@ -367,7 +367,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 def _ambient_mesh(mesh):
     if mesh is not None:
         return mesh
-    mesh = jax.sharding.get_abstract_mesh()
+    from horovod_tpu.parallel.mesh import abstract_mesh
+    mesh = abstract_mesh()
     if mesh is None or mesh.empty:
         raise ValueError(
             "no mesh: pass mesh= or call under horovod_tpu.parallel.use()")

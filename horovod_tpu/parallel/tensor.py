@@ -809,7 +809,8 @@ def _mesh_is_trivial() -> bool:
     """True when no ambient mesh (or an all-size-1 one) is installed —
     the condition under which a bare pallas_call needs no GSPMD
     partitioning rule."""
-    mesh = jax.sharding.get_abstract_mesh()
+    from horovod_tpu.parallel.mesh import abstract_mesh
+    mesh = abstract_mesh()
     return (mesh is None or mesh.empty
             or all(s == 1 for s in mesh.shape.values()))
 

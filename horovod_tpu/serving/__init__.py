@@ -1,0 +1,37 @@
+"""horovod_tpu.serving — in-process continuous-batching serving engine.
+
+The layer that turns concurrent requests into batched device work:
+
+* `engine.ServingEngine` — thin `submit()`/`shutdown()` API over ONE
+  background dispatch thread (the reference's background-coordinator
+  architecture, pointed at decode scheduling).
+* `scheduler.ContinuousBatchingScheduler` — iteration-level batching:
+  finished sequences retire and queued prompts prefill into freed
+  slots each tick, keeping the decode batch full under load.
+* `slots.SlotPool` — the slot-pool KV cache generalizing the linear
+  cache's scalar fill index to per-slot state.
+* `admission` — bounded queue, deadlines, cancellation, load shedding
+  (degrade by shedding, never by hanging).
+* `metrics` — TTFT/TPOT/tokens-per-second with p50/p95, queue depth,
+  slot occupancy.
+
+See docs/serving.md for the architecture and tuning guide.
+"""
+
+from horovod_tpu.serving.admission import (
+    AdmissionQueue, DeadlineExceededError, EngineClosedError,
+    QueueFullError, SamplingParams, ServingError,
+)
+from horovod_tpu.serving.engine import RequestHandle, ServingEngine
+from horovod_tpu.serving.metrics import EngineMetrics
+from horovod_tpu.serving.scheduler import (
+    CompletedRequest, ContinuousBatchingScheduler,
+)
+from horovod_tpu.serving.slots import SlotPool
+
+__all__ = [
+    "ServingEngine", "RequestHandle", "CompletedRequest",
+    "SamplingParams", "SlotPool", "ContinuousBatchingScheduler",
+    "AdmissionQueue", "EngineMetrics", "ServingError",
+    "QueueFullError", "DeadlineExceededError", "EngineClosedError",
+]

@@ -1,0 +1,220 @@
+"""Admission control: the bounded front door of the serving engine.
+
+Robustness contract (the reference's background-coordinator lesson,
+SURVEY §L2, applied to serving): under overload the engine DEGRADES BY
+SHEDDING, never by hanging — a full queue rejects at `submit` time with
+`QueueFullError` (the caller learns immediately and can retry
+elsewhere), a request whose deadline passes while still queued is
+failed with `DeadlineExceededError` the moment the dispatcher would
+otherwise have started work it can no longer finish in time, and a
+cancelled request is dropped at the next pop. Nothing here blocks the
+submitting thread beyond one mutex.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from concurrent.futures import CancelledError
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+
+class ServingError(RuntimeError):
+    """Base class for serving-engine errors."""
+
+
+class QueueFullError(ServingError):
+    """submit() found the admission queue at capacity — the request was
+    shed immediately (load shedding, the degrade-don't-hang contract)."""
+
+
+class DeadlineExceededError(ServingError, TimeoutError):
+    """The request's deadline passed (in queue or mid-decode).
+
+    ``partial_tokens`` carries whatever the engine had produced by
+    then (empty for queue-expired requests) so a caller can still use
+    a truncated answer.
+    """
+
+    def __init__(self, msg: str, partial_tokens: Optional[list] = None):
+        super().__init__(msg)
+        self.partial_tokens = partial_tokens or []
+
+
+class EngineClosedError(ServingError):
+    """submit() after shutdown, or the request was abandoned by a
+    non-draining shutdown."""
+
+
+@dataclass
+class SamplingParams:
+    """Per-request sampling configuration.
+
+    ``temperature <= 0`` is greedy (argmax); otherwise softmax sampling
+    from a per-request RNG stream seeded by ``seed``, optionally
+    truncated to the ``top_p`` nucleus. (Per-request ``top_k`` would
+    make the tick's compiled shape request-dependent — one program per
+    k — so the continuous-batching tick deliberately offers the traced
+    knobs only; use ``top_p``.)
+    """
+
+    temperature: float = 0.0
+    top_p: Optional[float] = None
+    seed: int = 0
+
+    def validate(self):
+        if self.temperature < 0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+        if self.top_p is not None and not 0 < self.top_p <= 1:
+            raise ValueError(
+                f"top_p must be in (0, 1], got {self.top_p}")
+
+
+@dataclass
+class Request:
+    """One submitted generation request and its lifecycle state.
+
+    Crosses the submit-thread / dispatch-thread boundary: the future
+    and the cancel event are the only write points shared by both
+    sides; everything else is owned by the dispatcher once admitted.
+    """
+
+    id: int
+    prompt: Any                      # np.ndarray [P] int tokens
+    max_new_tokens: int
+    sampling: SamplingParams
+    deadline: Optional[float]        # absolute time.time() or None
+    future: Any                      # concurrent.futures.Future
+    t_submit: float = 0.0
+    t_prefill: float = 0.0           # dispatcher: prefill started
+    t_first: float = 0.0             # dispatcher: first token emitted
+    tokens: List[int] = field(default_factory=list)  # generated so far
+    _cancel: threading.Event = field(default_factory=threading.Event)
+
+    def cancel(self):
+        """Request cancellation. Queued requests are dropped at the
+        next queue pop; running requests retire (and free their slot)
+        at the next decode tick. The future then raises
+        `concurrent.futures.CancelledError`."""
+        self._cancel.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel.is_set()
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else time.time())
+                >= self.deadline)
+
+
+class AdmissionQueue:
+    """Bounded FIFO between `submit()` and the dispatch thread.
+
+    `offer` never blocks (full ⇒ `QueueFullError`); `pop_ready` is the
+    dispatcher's non-blocking take that resolves dead requests
+    (cancelled / deadline-expired) on the way instead of wasting a
+    prefill on them; `wait` parks the idle dispatcher until work (or
+    shutdown) arrives.
+    """
+
+    def __init__(self, max_depth: int):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = max_depth
+        self._q: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._closed = False
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def offer(self, req: Request):
+        with self._lock:
+            if self._closed:
+                raise EngineClosedError(
+                    "engine is shut down; submit rejected")
+            if len(self._q) >= self.max_depth:
+                raise QueueFullError(
+                    f"admission queue full ({self.max_depth} requests "
+                    f"waiting); request {req.id} shed")
+            self._q.append(req)
+        self._event.set()
+
+    @staticmethod
+    def _resolve_dead(req: Request, kind: str, now: float, on_drop):
+        if kind == "cancelled":
+            req.future.set_exception(CancelledError())
+        else:
+            req.future.set_exception(DeadlineExceededError(
+                f"request {req.id}: deadline passed after "
+                f"{now - req.t_submit:.3f}s in queue"))
+        if on_drop is not None:
+            on_drop(req, kind)
+
+    def pop_ready(self, now: float, on_drop=None) -> Optional[Request]:
+        """Next live request, resolving cancelled/expired ones inline
+        (``on_drop(req, kind)`` with kind "cancelled"/"timeout" fires
+        for each, for metrics/tracing); None when the queue holds no
+        admissible work."""
+        while True:
+            with self._lock:
+                if not self._q:
+                    self._event.clear()
+                    return None
+                req = self._q.popleft()
+            if req.cancelled:
+                self._resolve_dead(req, "cancelled", now, on_drop)
+                continue
+            if req.expired(now):
+                self._resolve_dead(req, "timeout", now, on_drop)
+                continue
+            return req
+
+    def sweep(self, now: float, on_drop=None) -> int:
+        """Resolve cancelled/expired requests ANYWHERE in the queue —
+        dying needs no slot, so the dispatcher runs this every tick:
+        a queued request's deadline/cancel must not wait for a slot to
+        free before its future resolves (the never-hang contract with
+        every slot busy). Returns how many were resolved."""
+        with self._lock:
+            dead = [r for r in self._q
+                    if r.cancelled or r.expired(now)]
+            if dead:
+                gone = set(map(id, dead))
+                self._q = collections.deque(
+                    r for r in self._q if id(r) not in gone)
+        for req in dead:
+            self._resolve_dead(
+                req, "cancelled" if req.cancelled else "timeout",
+                now, on_drop)
+        return len(dead)
+
+    def wait(self, timeout: float) -> bool:
+        """Park until offer()/close() signals (True) or timeout."""
+        signalled = self._event.wait(timeout)
+        return signalled
+
+    def close(self, drain: bool) -> List[Request]:
+        """Stop admissions. ``drain=False`` additionally fails every
+        queued request with `EngineClosedError` right now (the failed
+        requests are returned for metrics); with ``drain=True`` the
+        dispatcher keeps popping until empty."""
+        with self._lock:
+            self._closed = True
+            doomed = [] if drain else list(self._q)
+            if not drain:
+                self._q.clear()
+        for req in doomed:
+            req.future.set_exception(EngineClosedError(
+                f"engine shut down before request {req.id} started"))
+        self._event.set()
+        return doomed

@@ -1,0 +1,294 @@
+"""`ServingEngine` — the thin API over a background dispatch loop.
+
+Horovod's core architectural lesson (Sergeev & Del Balso,
+arXiv:1802.05799; SURVEY §L2) is that adoption comes from a minimal
+user-facing API (`hvd.init` + `DistributedOptimizer`) layered over a
+carefully engineered background coordinator thread that turns
+asynchronous per-tensor readiness into ordered batched device work.
+This engine is that architecture pointed at serving: callers get TWO
+calls — ``submit(prompt, ...) -> handle`` and ``shutdown()`` — and a
+single background dispatch thread turns asynchronously arriving
+requests into full decode batches (`ContinuousBatchingScheduler` over
+a `SlotPool`), with admission control in front (`AdmissionQueue`) and
+request-level metrics behind (`EngineMetrics`).
+
+Threading model (mirrors the reference's one-background-thread rule,
+`operations.cc` there): ALL jax work happens on the dispatch thread.
+Submitter threads touch only the queue, the metrics counters, and
+their own request's future/cancel-flag — so arbitrary caller threads
+compose with single-threaded device dispatch.
+
+Usage::
+
+    from horovod_tpu.serving import ServingEngine, SamplingParams
+
+    with ServingEngine(model, params, num_slots=8, eos_id=2) as eng:
+        h = eng.submit(prompt_tokens, max_new_tokens=64)
+        out = h.result(timeout=30)        # CompletedRequest
+        print(out.tokens, out.finish_reason, out.ttft_s)
+
+With ``HOROVOD_TIMELINE`` set (or `start_timeline`), every request
+renders as its own trace process with QUEUE → PREFILL → DECODE spans
+in chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional
+
+import numpy as np
+
+from horovod_tpu.models.transformer import TransformerLM
+from horovod_tpu.serving.admission import (
+    AdmissionQueue, EngineClosedError, QueueFullError, Request,
+    SamplingParams,
+)
+from horovod_tpu.serving.metrics import EngineMetrics
+from horovod_tpu.serving.scheduler import (
+    CompletedRequest, ContinuousBatchingScheduler, _span,
+)
+from horovod_tpu.serving.slots import SlotPool
+
+__all__ = ["ServingEngine", "RequestHandle", "CompletedRequest",
+           "SamplingParams", "QueueFullError", "EngineClosedError"]
+
+# How long the idle dispatcher parks between queue checks. Wake-ups on
+# submit are event-driven (AdmissionQueue.wait returns early); this
+# only bounds how stale a shutdown/cancel notice can go unnoticed.
+_IDLE_WAIT_S = 0.05
+
+
+class RequestHandle:
+    """The caller's view of one in-flight request."""
+
+    def __init__(self, req: Request):
+        self._req = req
+
+    @property
+    def id(self) -> int:
+        return self._req.id
+
+    @property
+    def future(self) -> Future:
+        return self._req.future
+
+    def result(self, timeout: Optional[float] = None) -> CompletedRequest:
+        """Block for the outcome. Raises `DeadlineExceededError` /
+        `CancelledError` / `EngineClosedError` for the non-completion
+        exits, or `concurrent.futures.TimeoutError` if ``timeout``
+        passes first (the request itself keeps running)."""
+        return self._req.future.result(timeout)
+
+    def done(self) -> bool:
+        return self._req.future.done()
+
+    def cancel(self):
+        """Best-effort cancel: queued requests are dropped before
+        prefill, running requests retire (freeing their slot) at the
+        next decode tick. No-op once done."""
+        self._req.cancel()
+
+    def tokens_so_far(self) -> list:
+        """Snapshot of the generated tokens (grows per tick) — the
+        polling flavor of streaming."""
+        return list(self._req.tokens)
+
+
+class ServingEngine:
+    """In-process continuous-batching serving engine over one model.
+
+    Parameters
+    ----------
+    model, params : the `TransformerLM` and its (unboxed) params —
+        exactly what `generate` takes. Pre-cast with `serving_params`
+        and/or quantize with `quantize_lm_params` as usual.
+    num_slots : decode-batch width S. Throughput rises with S until
+        the per-tick HBM roofline saturates (docs/serving.md's tuning
+        section); latency under load prefers the queue bounded and S
+        modest.
+    max_queue : admission bound; submits beyond it shed immediately.
+    eos_id : stop token (None = budget-only stops), as in `generate`;
+        results end at the first eos, so no pad convention is needed —
+        the engine returns ragged per-request tokens, not a rectangle.
+    default_timeout_s : per-request deadline applied when `submit`
+        gets no explicit ``timeout_s`` (None = no deadline).
+    mesh : optional mesh for TP-sharded params, as in `generate`.
+    """
+
+    def __init__(self, model: TransformerLM, params, *,
+                 num_slots: int = 4, max_queue: int = 16,
+                 eos_id: Optional[int] = None,
+                 default_timeout_s: Optional[float] = None,
+                 mesh=None):
+        if eos_id is not None and not 0 <= eos_id < model.vocab_size:
+            raise ValueError(
+                f"eos_id must be in [0, vocab_size={model.vocab_size}"
+                f"), got {eos_id}")
+        self.model = model
+        self.eos_id = eos_id
+        self.default_timeout_s = default_timeout_s
+        self.metrics = EngineMetrics()
+        self.pool = SlotPool(model, params, num_slots, mesh=mesh)
+        self.queue = AdmissionQueue(max_queue)
+        self.scheduler = ContinuousBatchingScheduler(
+            self.pool, self.queue, self.metrics, eos_id=eos_id)
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self._closing = False
+        self._drain = True
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="serving-dispatch",
+            daemon=True)
+        self._thread.start()
+
+    # -- submit side --------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               temperature: float = 0.0,
+               top_p: Optional[float] = None, seed: int = 0,
+               timeout_s: Optional[float] = None) -> RequestHandle:
+        """Enqueue one generation request; returns immediately.
+
+        Raises `QueueFullError` when the admission queue is at
+        capacity (load shedding — never blocks the caller) and
+        `EngineClosedError` after shutdown. Validation errors raise
+        before the request is queued.
+        """
+        prompt = np.asarray(prompt)
+        if prompt.ndim != 1 or prompt.shape[0] == 0:
+            raise ValueError(
+                f"prompt must be a non-empty 1-D token array, got "
+                f"shape {prompt.shape}")
+        if not np.issubdtype(prompt.dtype, np.integer):
+            raise ValueError(
+                f"prompt must hold integer token ids, got dtype "
+                f"{prompt.dtype}")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        P = int(prompt.shape[0])
+        unbounded = (self.model.pos_emb == "rope"
+                     and self.model.window is not None)
+        if not unbounded and P + max_new_tokens - 1 > self.model.max_len:
+            raise ValueError(
+                f"prompt ({P}) + max_new_tokens ({max_new_tokens}) - 1 "
+                f"exceeds max_len={self.model.max_len}")
+        sampling = SamplingParams(temperature=temperature, top_p=top_p,
+                                  seed=seed)
+        sampling.validate()
+        timeout_s = (self.default_timeout_s if timeout_s is None
+                     else timeout_s)
+        now = time.time()
+        req = Request(
+            id=next(self._ids), prompt=prompt,
+            max_new_tokens=max_new_tokens, sampling=sampling,
+            deadline=None if timeout_s is None else now + timeout_s,
+            future=Future(), t_submit=now)
+        self.metrics.count("submitted")
+        _span("begin_span", req.id, "QUEUE")
+        try:
+            self.queue.offer(req)
+        except QueueFullError:
+            self.metrics.count("rejected")
+            _span("end_span", req.id, "QUEUE")
+            raise
+        except EngineClosedError:
+            _span("end_span", req.id, "QUEUE")
+            raise
+        return RequestHandle(req)
+
+    # -- dispatch side ------------------------------------------------
+
+    def _dispatch_loop(self):
+        try:
+            while True:
+                progressed = self.scheduler.step()
+                self.metrics.observe_gauges(
+                    len(self.queue), self.pool.busy_slots,
+                    self.pool.num_slots)
+                with self._lock:
+                    closing, drain = self._closing, self._drain
+                if closing:
+                    if not drain:
+                        self.scheduler.abort_active()
+                        return
+                    if (not self.scheduler.has_active()
+                            and len(self.queue) == 0):
+                        return
+                    continue
+                if not progressed and not self.scheduler.has_active():
+                    self.queue.wait(_IDLE_WAIT_S)
+        except BaseException as e:  # noqa: BLE001 — fail futures, not hang
+            # The degrade-by-shedding contract extends to the engine's
+            # own faults (a poison request, a compile failure, device
+            # OOM): a dead dispatch thread must not leave callers
+            # blocked in result() forever. Fail every in-flight and
+            # queued future with the error, mark the engine closed so
+            # later submits are rejected, and log the traceback (no
+            # re-raise: the futures carry the failure to callers).
+            import sys
+            import traceback
+            with self._lock:
+                self._closing = True
+            for slot, req in list(self.scheduler.active.items()):
+                self.scheduler.active.pop(slot, None)
+                req.future.set_exception(EngineClosedError(
+                    f"serving dispatch thread died: {e!r}"))
+            self.queue.close(drain=False)  # fails queued futures too
+            sys.stderr.write("serving dispatch thread died:\n")
+            traceback.print_exc(file=sys.stderr)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def shutdown(self, *, drain: bool = True,
+                 timeout: Optional[float] = None):
+        """Stop the engine. ``drain=True`` (default) finishes every
+        queued and in-flight request first — the clean-exit contract;
+        ``drain=False`` fails queued requests with `EngineClosedError`
+        and aborts in-flight ones at the next tick. Idempotent."""
+        with self._lock:
+            self._closing = True
+            self._drain = self._drain and drain
+            effective_drain = self._drain
+        # close() is idempotent; re-closing after a drain→no-drain
+        # downgrade (force-stop following a timed-out graceful
+        # shutdown) fails whatever is STILL queued instead of leaving
+        # those futures pending forever.
+        doomed = self.queue.close(effective_drain)
+        self.metrics.count("aborted", len(doomed))
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError(
+                f"serving dispatch thread still draining after "
+                f"{timeout}s (queue={len(self.queue)}, "
+                f"active={self.pool.busy_slots})")
+        # The dispatcher is gone. A submit racing the close above (its
+        # offer landed after the dispatcher saw `closing` and exited,
+        # but before queue.close flipped the rejected flag) would
+        # leave a future nobody will ever resolve — fail any such
+        # straggler now (idempotent re-close with drain=False).
+        stragglers = self.queue.close(drain=False)
+        self.metrics.count("aborted", len(stragglers))
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.shutdown(drain=exc_type is None)
+
+    # -- introspection ------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        return self.metrics.snapshot()
+
+    @property
+    def num_slots(self) -> int:
+        return self.pool.num_slots
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)

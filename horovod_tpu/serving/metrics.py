@@ -1,0 +1,144 @@
+"""Request-level serving metrics: TTFT / TPOT / throughput with
+p50/p95, queue depth, and slot occupancy.
+
+The vocabulary is the standard serving triple:
+
+* **TTFT** (time to first token): submit → first token out — queue
+  wait + prefill; the interactive-latency number.
+* **TPOT** (time per output token): decode time / (tokens - 1) — the
+  steady-state streaming rate a user sees after the first token.
+* **tokens/s**: completed output tokens per wall-clock second — the
+  capacity number the continuous-batching scheduler exists to maximize
+  (keep the decode batch full ⇒ tokens/s holds as load rises while
+  TTFT degrades gracefully).
+
+Percentiles come from a bounded reservoir (newest `maxlen` samples) —
+serving metrics answer "how is it behaving NOW", so recency beats
+completeness and memory stays O(1) under unbounded load.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, Optional
+
+
+class Series:
+    """Bounded sample reservoir with percentile readout."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._buf: collections.deque = collections.deque(maxlen=maxlen)
+
+    def add(self, value: float):
+        self._buf.append(float(value))
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Nearest-rank percentile (q in [0, 100]); None when empty."""
+        if not self._buf:
+            return None
+        xs = sorted(self._buf)
+        rank = min(len(xs) - 1, max(0, int(round(q / 100.0
+                                                 * (len(xs) - 1)))))
+        return xs[rank]
+
+    def mean(self) -> Optional[float]:
+        if not self._buf:
+            return None
+        return sum(self._buf) / len(self._buf)
+
+    def summary(self, scale: float = 1.0, nd: int = 2) -> Dict:
+        """{p50, p95, mean, n} with values scaled (e.g. 1e3 for ms)."""
+        if not self._buf:
+            return {"p50": None, "p95": None, "mean": None, "n": 0}
+        return {"p50": round(self.percentile(50) * scale, nd),
+                "p95": round(self.percentile(95) * scale, nd),
+                "mean": round(self.mean() * scale, nd),
+                "n": len(self._buf)}
+
+
+class EngineMetrics:
+    """The engine's counters, gauges, and latency series.
+
+    Counter/series writes come from both the submit threads (submitted
+    / rejected) and the dispatch thread (everything else) — one lock
+    covers them; reads (`snapshot`) take the same lock so a scrape
+    never sees a torn update.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.time()
+        # Counters.
+        self.submitted = 0
+        self.rejected = 0          # shed at the full queue
+        self.completed = 0         # eos or token budget
+        self.cancelled = 0
+        self.timed_out = 0         # deadline exceeded (queue or decode)
+        self.aborted = 0           # non-drain shutdown took the slot
+        self.tokens_out = 0        # generated tokens, completed or not
+        self.prefill_tokens = 0
+        self.ticks = 0             # decode ticks executed
+        # Gauges (set by the engine each loop).
+        self.queue_depth = 0
+        self.slots_busy = 0
+        self.num_slots = 0
+        # Latency series (seconds).
+        self.queue_wait_s = Series()
+        self.ttft_s = Series()
+        self.tpot_s = Series()
+        self.e2e_s = Series()
+
+    def count(self, name: str, n: int = 1):
+        with self._lock:
+            setattr(self, name, getattr(self, name) + n)
+
+    def observe_gauges(self, queue_depth: int, slots_busy: int,
+                       num_slots: int):
+        with self._lock:
+            self.queue_depth = queue_depth
+            self.slots_busy = slots_busy
+            self.num_slots = num_slots
+
+    def observe_request(self, *, t_submit: float, t_prefill: float,
+                        t_first: float, t_done: float, n_tokens: int):
+        """Fold one finished request into the series (called by the
+        dispatcher at retire time, successful finishes only)."""
+        with self._lock:
+            self.queue_wait_s.add(t_prefill - t_submit)
+            self.ttft_s.add(t_first - t_submit)
+            if n_tokens > 1:
+                self.tpot_s.add((t_done - t_first) / (n_tokens - 1))
+            self.e2e_s.add(t_done - t_submit)
+
+    def snapshot(self) -> Dict:
+        """One JSON-ready dict: counters, gauges, p50/p95 latencies
+        (ms), and the engine-lifetime output tokens/s."""
+        with self._lock:
+            dt = max(time.time() - self._t0, 1e-9)
+            return {
+                "submitted": self.submitted,
+                "rejected": self.rejected,
+                "completed": self.completed,
+                "cancelled": self.cancelled,
+                "timed_out": self.timed_out,
+                "aborted": self.aborted,
+                "tokens_out": self.tokens_out,
+                "prefill_tokens": self.prefill_tokens,
+                "ticks": self.ticks,
+                "queue_depth": self.queue_depth,
+                "slots_busy": self.slots_busy,
+                "num_slots": self.num_slots,
+                "slot_occupancy": (round(self.slots_busy
+                                         / self.num_slots, 3)
+                                   if self.num_slots else None),
+                "tokens_per_s": round(self.tokens_out / dt, 2),
+                "queue_wait_ms": self.queue_wait_s.summary(1e3),
+                "ttft_ms": self.ttft_s.summary(1e3),
+                "tpot_ms": self.tpot_s.summary(1e3),
+                "e2e_ms": self.e2e_s.summary(1e3),
+            }

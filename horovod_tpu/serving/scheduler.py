@@ -1,0 +1,213 @@
+"""Continuous (iteration-level) batching over the slot pool.
+
+Request-level batching — `generate_bucketed`'s model — picks a batch,
+decodes it to completion, then picks the next: short requests finish
+early and their rows decode padding until the batch's straggler is
+done, so the accelerator batch drains as load-imbalance grows. The
+MLPerf TPU-pod lesson (arXiv:1909.09756) is that throughput at scale
+is won by keeping the accelerator batch FULL; for serving that means
+scheduling at token granularity: every tick, finished sequences are
+RETIRED from their slots and queued prompts are PREFILLED into the
+freed slots, so the decode batch stays full under load (Yu et al.,
+OSDI '22 "Orca" — iteration-level scheduling).
+
+Each `step()` runs one tick of that loop on the engine's dispatch
+thread::
+
+    retire finished  ->  admit queued into free slots (prefill)
+                     ->  one vmapped decode tick over all slots
+
+Requests also leave slots for non-completion reasons — cancellation,
+deadline expiry, a non-draining shutdown — all resolved here so the
+engine degrades by shedding, never by hanging.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import CancelledError
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from horovod_tpu.serving.admission import (
+    AdmissionQueue, DeadlineExceededError, EngineClosedError, Request,
+)
+from horovod_tpu.serving.metrics import EngineMetrics
+from horovod_tpu.serving.slots import SlotPool
+
+
+@dataclass(frozen=True)
+class CompletedRequest:
+    """The future's payload for a successfully finished request."""
+
+    request_id: int
+    prompt: np.ndarray            # [P] the submitted tokens
+    tokens: np.ndarray            # generated tokens (eos included)
+    finish_reason: str            # "eos" | "length"
+    ttft_s: float
+    tpot_s: Optional[float]       # None for single-token outputs
+    e2e_s: float
+
+    @property
+    def full_sequence(self) -> np.ndarray:
+        """prompt ++ generated — `generate`'s row, truncated at eos."""
+        return np.concatenate([self.prompt, self.tokens])
+
+
+def _timeline():
+    """The process-global Horovod timeline, or None (spans are then
+    no-ops) — the same handle `utils.timeline.step_bracket` reads."""
+    try:
+        from horovod_tpu.runtime import state as _state
+        return _state.global_state().timeline
+    except Exception:
+        return None
+
+
+def _span(method: str, request_id: int, name: str):
+    tl = _timeline()
+    if tl is not None:
+        getattr(tl, method)(f"request:{request_id}", name)
+
+
+class ContinuousBatchingScheduler:
+    """The policy half of the engine: owns which request sits in which
+    slot and why it leaves. Single-threaded by contract (the engine's
+    dispatch thread); only the Request futures/cancel flags are shared
+    with submitters."""
+
+    def __init__(self, pool: SlotPool, queue: AdmissionQueue,
+                 metrics: EngineMetrics, *,
+                 eos_id: Optional[int] = None):
+        self.pool = pool
+        self.queue = queue
+        self.metrics = metrics
+        self.eos_id = eos_id
+        self.active: Dict[int, Request] = {}   # slot -> request
+
+    def has_active(self) -> bool:
+        return bool(self.active)
+
+    # -- the tick -----------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> bool:
+        """One scheduling iteration; True when any device work ran
+        (the engine parks the thread on False)."""
+        now = time.time() if now is None else now
+        # Dead queued requests (cancelled / deadline-expired) resolve
+        # NOW, slot or no slot — with every slot busy, _admit below
+        # never pops the queue, and a 100 ms deadline must not wait
+        # minutes for a slot to free.
+        self.queue.sweep(now, on_drop=self._queue_drop)
+        admitted = self._admit(now)
+        if not self.active:
+            return admitted
+        toks = self.pool.tick()
+        self.metrics.count("ticks")
+        t_tick = time.time()
+        for slot, req in list(self.active.items()):
+            tok = int(toks[slot])
+            req.tokens.append(tok)
+            self.metrics.count("tokens_out")
+            self._maybe_retire(slot, req, tok, t_tick)
+        return True
+
+    def _admit(self, now: float) -> bool:
+        """Fill free slots from the queue (prefill-into-slot)."""
+        admitted = False
+        while self.pool.has_free():
+            req = self.queue.pop_ready(now, on_drop=self._queue_drop)
+            if req is None:
+                break
+            slot = self.pool.alloc()
+            req.t_prefill = time.time()
+            _span("end_span", req.id, "QUEUE")
+            _span("begin_span", req.id, "PREFILL")
+            # Registered BEFORE prefill so a fault inside it (compile
+            # failure, OOM) leaves the request findable by the
+            # engine's crash containment — never a future in limbo.
+            self.active[slot] = req
+            first = self.pool.prefill(
+                slot, req.prompt, req.sampling.temperature,
+                req.sampling.top_p, req.sampling.seed)
+            req.t_first = time.time()
+            req.tokens.append(first)
+            self.metrics.count("prefill_tokens",
+                               int(req.prompt.shape[0]))
+            self.metrics.count("tokens_out")
+            _span("end_span", req.id, "PREFILL")
+            _span("begin_span", req.id, "DECODE")
+            admitted = True
+            # A request can be over the moment prefill ends: first
+            # token is eos, budget of 1, deadline blown mid-prefill,
+            # cancelled while prefilling.
+            self._maybe_retire(slot, req, first, req.t_first)
+        return admitted
+
+    def _queue_drop(self, req: Request, kind: str):
+        """A queued request died before reaching a slot (cancelled or
+        deadline-expired); its future already carries the exception."""
+        self.metrics.count("cancelled" if kind == "cancelled"
+                           else "timed_out")
+        _span("end_span", req.id, "QUEUE")
+        tl = _timeline()
+        if tl is not None:
+            tl.mark(f"request:{req.id}", kind.upper())
+
+    def _maybe_retire(self, slot: int, req: Request, tok: int,
+                      now: float):
+        if req.cancelled:
+            self._retire(slot, req, "cancelled", now)
+        elif req.expired(now):
+            self._retire(slot, req, "timeout", now)
+        elif self.eos_id is not None and tok == self.eos_id:
+            self._retire(slot, req, "eos", now)
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._retire(slot, req, "length", now)
+
+    def _retire(self, slot: int, req: Request, reason: str,
+                now: float):
+        """Free the slot and resolve the request's future."""
+        self.pool.free(slot)
+        self.active.pop(slot, None)
+        _span("end_span", req.id, "DECODE")
+        tl = _timeline()
+        if tl is not None:
+            tl.mark(f"request:{req.id}", reason.upper())
+        if reason in ("eos", "length"):
+            n = len(req.tokens)
+            self.metrics.count("completed")
+            self.metrics.observe_request(
+                t_submit=req.t_submit, t_prefill=req.t_prefill,
+                t_first=req.t_first, t_done=now, n_tokens=n)
+            req.future.set_result(CompletedRequest(
+                request_id=req.id,
+                prompt=np.asarray(req.prompt),
+                tokens=np.asarray(req.tokens, np.int64),
+                finish_reason=reason,
+                ttft_s=req.t_first - req.t_submit,
+                tpot_s=((now - req.t_first) / (n - 1)
+                        if n > 1 else None),
+                e2e_s=now - req.t_submit))
+        elif reason == "cancelled":
+            self.metrics.count("cancelled")
+            req.future.set_exception(CancelledError())
+        elif reason == "timeout":
+            self.metrics.count("timed_out")
+            req.future.set_exception(DeadlineExceededError(
+                f"request {req.id}: deadline passed after "
+                f"{len(req.tokens)} tokens",
+                partial_tokens=list(req.tokens)))
+        else:   # aborted — non-draining shutdown
+            self.metrics.count("aborted")
+            req.future.set_exception(EngineClosedError(
+                f"engine shut down while request {req.id} was "
+                f"decoding ({len(req.tokens)} tokens in)"))
+
+    def abort_active(self):
+        """Non-draining shutdown: fail every in-flight request now."""
+        now = time.time()
+        for slot, req in list(self.active.items()):
+            self._retire(slot, req, "aborted", now)

@@ -1,0 +1,218 @@
+"""Slot-pool KV cache: the device state behind continuous batching.
+
+The linear decode cache (`parallel.tensor.ParallelSelfAttention`,
+``decode=True``) keeps ONE scalar ``cache_index`` shared by the whole
+batch — every row must sit at the same fill level, which is exactly
+what continuous batching breaks (each slot holds a different request
+at a different depth). `models.transformer`'s slot primitives
+generalize that cache to a pool: every leaf gains a leading
+[num_slots] axis (the per-layer fill scalars become per-slot vectors),
+prefill streams a prompt into ONE slot through the `chunked_prefill`
+cache-wide-mask path, and the decode tick vmaps the B=1 decode step
+over the slot axis. This module wraps those primitives with the
+host-side bookkeeping the scheduler needs: a free list, per-slot
+sampling state (temperature / top_p / RNG stream), and reset-on-retire
+hygiene.
+
+Slot lifecycle::
+
+    FREE --alloc()--> prefill() [reset + stream] --> ACTIVE --tick()*
+      ^                                                           |
+      +------------------------- free() --------------------------+
+
+A slot is zeroed TWICE per recycle, for two different reasons. At
+`prefill()` for correctness: a freed slot keeps riding the shared
+vmapped tick while others decode, so by admission time its fill index
+has crept to garbage — prefilling without a reset would append the
+prompt at that index (shifted RoPE, garbage prefix attended). At
+`free()` for cost: restarting the idle creep from 0 keeps the
+prefix-attention trip count — which every OTHER slot pays through the
+shared vmapped loop — following the ticks-since-free, not the retired
+request's full length.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models.transformer import (
+    TransformerLM, init_slot_cache, prefill_chunks, sample_token,
+    slot_decode_model, slot_decode_tick, slot_prefill_chunk,
+    slot_reset,
+)
+from horovod_tpu.parallel.mesh import use
+
+
+@jax.jit
+def _first_token(logits, temp, top_p, key):
+    """First-token sample closing the prefill: split the request key
+    exactly as `generate` does (``rng, r0 = split(key)``; the tick
+    keeps splitting ``rng``), so a request's sample stream is
+    reproducible from its seed regardless of which slot it lands in or
+    what else shares the batch."""
+    rng, r0 = jax.random.split(key)
+    tok = sample_token(logits, temp, top_p, r0)
+    return tok.astype(jnp.int32), rng
+
+
+# A FREE slot is re-zeroed after idling this many ticks. Idle lanes
+# ride the shared vmapped tick and creep their fill index; free()'s
+# reset restarts the creep, but a slot that sits in the free list
+# forever (LIFO alloc under partial occupancy) would otherwise creep
+# unboundedly — and the vmapped prefix-attention loop runs to the MAX
+# lane's trip count, so every ACTIVE slot would pay for it. The bound
+# caps the waste at ceil(64/decode_prefix_block) ≈ 1 extra prefix
+# block per lane at the default block size.
+RESET_IDLE_TICKS = 64
+
+
+class SlotPool:
+    """A fixed pool of ``num_slots`` decode slots over one shared
+    slot-pool KV cache.
+
+    All device work (prefill chunks, the vmapped tick, slot resets)
+    happens on the caller's thread — the engine's dispatch thread —
+    so jax never sees concurrent mutation of the pool state.
+    """
+
+    def __init__(self, model: TransformerLM, params, num_slots: int,
+                 *, mesh=None):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.model = model
+        self.dec_model = slot_decode_model(model)
+        self.params = params
+        self.num_slots = num_slots
+        self.mesh = mesh
+        self._cache = init_slot_cache(model, num_slots)
+        self._toks = jnp.zeros((num_slots,), jnp.int32)
+        self._temps = jnp.zeros((num_slots,), jnp.float32)
+        self._top_ps = jnp.ones((num_slots,), jnp.float32)
+        self._rngs = jnp.stack(
+            [jax.random.PRNGKey(i) for i in range(num_slots)])
+        self._free: List[int] = list(range(num_slots))
+        # Host-side ticks since each slot's last reset (see
+        # RESET_IDLE_TICKS).
+        self._idle_ticks = np.zeros((num_slots,), np.int64)
+
+    def _ctx(self):
+        return use(self.mesh) if self.mesh is not None \
+            else contextlib.nullcontext()
+
+    def fill_indices(self) -> np.ndarray:
+        """Per-slot cache fill index, maxed across layers (and the
+        pos_index at learned-position models) — introspection for
+        tests and debugging (e.g. asserting the idle-creep bound)."""
+        from jax.tree_util import tree_flatten_with_path
+        flat, _ = tree_flatten_with_path(self._cache)
+        idx = [np.asarray(leaf) for path, leaf in flat
+               if "index" in str(path)]
+        assert idx, "slot cache has no index leaves"
+        return np.max(np.stack(idx), axis=0)
+
+    # -- occupancy ----------------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def busy_slots(self) -> int:
+        return self.num_slots - len(self._free)
+
+    def has_free(self) -> bool:
+        return bool(self._free)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def alloc(self) -> Optional[int]:
+        """Claim a free slot; None when the pool is full. The slot's
+        device rows are NOT assumed clean — `prefill` re-zeroes them
+        at use time, because a freed slot keeps riding the shared
+        vmapped tick while other slots decode, creeping its fill
+        index past whatever `free` zeroed."""
+        if not self._free:
+            return None
+        return self._free.pop()
+
+    def prefill(self, slot: int, prompt, temperature: float,
+                top_p: Optional[float], seed: int) -> int:
+        """Stream ``prompt`` (1-D int tokens) into ``slot`` and return
+        the request's FIRST generated token.
+
+        Starts with a `slot_reset`: the slot has been ticking while
+        free (see `alloc`), so its fill index is nonzero garbage by
+        now — prefilling without the reset appends the prompt at that
+        index with shifted RoPE offsets and attends the idle-decode
+        garbage as prefix (token corruption, found by staggered-
+        arrival review). Chunks then follow the binary decomposition
+        (`prefill_chunks`), so the set of compiled prefill programs is
+        bounded by log2(max_len) — never one per prompt length.
+        """
+        prompt = np.asarray(prompt)
+        with self._ctx():
+            self._cache = slot_reset(self.dec_model, self._cache,
+                                     jnp.int32(slot))
+            self._idle_ticks[slot] = 0
+            off = 0
+            for c in prefill_chunks(int(prompt.shape[0])):
+                self._cache, logits = slot_prefill_chunk(
+                    self.dec_model, self.params, self._cache,
+                    jnp.int32(slot),
+                    jnp.asarray(prompt[off:off + c], jnp.int32))
+                off += c
+            temp = jnp.float32(temperature)
+            tp = jnp.float32(1.0 if top_p is None else top_p)
+            tok, rng = _first_token(logits, temp, tp,
+                                    jax.random.PRNGKey(seed))
+            # Install the slot's tick-side sampling state.
+            self._toks = self._toks.at[slot].set(tok)
+            self._temps = self._temps.at[slot].set(temp)
+            self._top_ps = self._top_ps.at[slot].set(tp)
+            self._rngs = self._rngs.at[slot].set(rng)
+            return int(tok)
+
+    def tick(self) -> np.ndarray:
+        """One continuous-batching decode tick over every slot; returns
+        the [num_slots] next-token vector (host). The caller decides
+        which entries belong to live requests. Long-idle FREE slots
+        are re-zeroed afterwards (`RESET_IDLE_TICKS`): a never-
+        allocated lane must not creep its fill index — and with it the
+        shared prefix-attention trip count — for the engine's
+        lifetime."""
+        with self._ctx():
+            self._cache, self._toks, self._rngs = slot_decode_tick(
+                self.dec_model, self.params, self._cache, self._toks,
+                self._temps, self._top_ps, self._rngs)
+            toks = np.asarray(self._toks)
+            self._idle_ticks += 1
+            for slot in self._free:
+                if self._idle_ticks[slot] >= RESET_IDLE_TICKS:
+                    self._cache = slot_reset(self.dec_model,
+                                             self._cache,
+                                             jnp.int32(slot))
+                    self._idle_ticks[slot] = 0
+            return toks
+
+    def free(self, slot: int):
+        """Retire a slot: zero its rows (cost hygiene — see module
+        doc; `prefill` re-zeroes for correctness) and return it to the
+        free list."""
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is already free")
+        with self._ctx():
+            self._cache = slot_reset(self.dec_model, self._cache,
+                                     jnp.int32(slot))
+            self._idle_ticks[slot] = 0
+            # Neutral sampling state so the freed lane's garbage decode
+            # stays cheap and deterministic.
+            self._toks = self._toks.at[slot].set(0)
+            self._temps = self._temps.at[slot].set(0.0)
+            self._top_ps = self._top_ps.at[slot].set(1.0)
+        self._free.append(slot)

@@ -120,6 +120,40 @@ class Timeline:
                 self._states[tensor] = UNKNOWN
             self._maybe_flush()
 
+    def begin_span(self, process: str, name: str):
+        """Open a named B span on ``process`` (interned as its own
+        trace pid, like a tensor) — the request-level vocabulary the
+        serving engine emits (QUEUE / PREFILL / DECODE), so every
+        request renders as a distinct trace process in
+        chrome://tracing. Unlike `record` there is no per-tensor state
+        machine: spans pair by name via `end_span` and nest freely.
+
+        The native C++ writer has no generic-span verb, so spans ride
+        its TOP_LEVEL/DONE tensor lifecycle (one outer process-named
+        bar wrapping each span's activity bar) — same trace, slightly
+        chattier nesting."""
+        if self._native is not None:
+            if not self._closed:
+                self._native.timeline_record(process, "TOP_LEVEL", name)
+            return
+        with self._lock:
+            if self._closed:
+                return
+            self._emit("B", name, self._pid(process))
+            self._maybe_flush()
+
+    def end_span(self, process: str, name: str):
+        """Close the matching `begin_span` (see its doc)."""
+        if self._native is not None:
+            if not self._closed:
+                self._native.timeline_record(process, "DONE", None)
+            return
+        with self._lock:
+            if self._closed:
+                return
+            self._emit("E", name, self._pid(process))
+            self._maybe_flush()
+
     def mark(self, tensor: str, name: str):
         """Instant event (`X`, timeline.cc:78-92)."""
         if self._native is not None:
@@ -139,9 +173,21 @@ class Timeline:
     def _flush_locked(self):
         if not self._events:
             return
-        with open(self._path, "a") as f:
-            for ev in self._events:
-                f.write(json.dumps(ev) + ",\n")
+        try:
+            with open(self._path, "a") as f:
+                for ev in self._events:
+                    f.write(json.dumps(ev) + ",\n")
+        except OSError as e:
+            # Same warn-and-disable contract as the constructor
+            # (timeline.cc:32-34): a mid-run I/O failure (disk full,
+            # file removed) must cost the trace, never the training
+            # step or serving request that happened to trigger the
+            # flush.
+            import sys
+            sys.stderr.write(
+                f"WARNING: Error writing the Horovod Timeline file "
+                f"{self._path!r}, disabling the timeline: {e}\n")
+            self._closed = True
         self._events = []
         self._last_flush = time.time()
 
@@ -158,8 +204,11 @@ class Timeline:
             # Chrome tolerates a trailing comma without a closing bracket
             # (the reference also streams without closing, timeline.cc);
             # write a terminator for strict parsers.
-            with open(self._path, "a") as f:
-                f.write("{}]\n")
+            try:
+                with open(self._path, "a") as f:
+                    f.write("{}]\n")
+            except OSError:
+                pass  # flush already warned; close stays quiet
             self._closed = True
 
 

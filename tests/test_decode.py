@@ -454,6 +454,51 @@ class TestGenerate:
             generate_bucketed(model, params,
                               [jnp.zeros((2, 3), jnp.int32)], steps=2)
 
+    def test_early_stop_matches_fixed_scan(self, hvd):
+        """early_stop=True (while_loop exits at the last finisher)
+        produces the SAME [B, P + steps] rectangle as the fixed-length
+        scan — eos positions, pads, and unfinished rows all identical;
+        it only stops paying for ticks nobody needs."""
+        model = _tiny_model()
+        prompt = _tokens(B=4, S=4, seed=82)[:, :4]
+        params = unbox(model.init(
+            jax.random.PRNGKey(83),
+            jnp.zeros((4, 16), jnp.int32))["params"])
+        steps, P = 12, 4
+        base = np.asarray(generate(model, params, prompt, steps=steps))
+        eos = int(base[0, P + steps // 2])
+        ref = generate(model, params, prompt, steps=steps,
+                       eos_id=eos, pad_id=63)
+        out = generate(model, params, prompt, steps=steps,
+                       eos_id=eos, pad_id=63, early_stop=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        with pytest.raises(ValueError, match="early_stop"):
+            generate(model, params, prompt, steps=steps,
+                     early_stop=True)
+
+    def test_bucketed_early_stop_parity(self, hvd):
+        """Satellite contract: eos/pad + early_stop propagate through
+        the bucketed path — each bucket stops early yet returns exactly
+        the per-prompt `generate` rows (same post-eos padding)."""
+        from horovod_tpu.models.transformer import generate_bucketed
+        model = _tiny_model()
+        params = unbox(model.init(
+            jax.random.PRNGKey(92),
+            jnp.zeros((2, 16), jnp.int32))["params"])
+        rng = np.random.RandomState(93)
+        prompts = [jnp.asarray(rng.randint(0, 64, (n,)))
+                   for n in (3, 5, 3, 7)]
+        probe = generate_bucketed(model, params, prompts, steps=8)
+        eos = int(np.asarray(probe[0])[5])
+        outs = generate_bucketed(model, params, prompts, steps=8,
+                                 eos_id=eos, pad_id=63,
+                                 early_stop=True)
+        for p, o in zip(prompts, outs):
+            solo = generate(model, params, p[None], steps=8,
+                            eos_id=eos, pad_id=63)[0]
+            np.testing.assert_array_equal(np.asarray(o),
+                                          np.asarray(solo))
+
     def test_serving_params_cast_rules(self, hvd):
         """serving_params: ndim>=2 float params cast to bf16; 1-D
         (norm scales/biases) stay f32; int8 leaves untouched; and at

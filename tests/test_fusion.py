@@ -157,6 +157,13 @@ class TestOverlapStructure:
         import jax.numpy as jnp
         import optax
 
+        from horovod_tpu.ops.fusion import _combiner_override_supported
+        if not _combiner_override_supported():
+            pytest.skip("this jax/xla build cannot express "
+                        "xla_disable_hlo_passes via compiler_options; "
+                        "the combiner override degrades to a no-op "
+                        "(ops.fusion._combiner_override_supported)")
+
         from horovod_tpu import models
         from horovod_tpu.models import make_cnn_train_step
         from horovod_tpu.models.train import init_cnn_state
