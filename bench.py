@@ -532,21 +532,35 @@ def run_serving(args, devices, n_chips, log):
             h.result()
     log(f"serving warmup (compiles) in {time.time() - t0:.1f}s")
 
+    chaos_mode = getattr(args, "chaos", False)
+    if chaos_mode:
+        from horovod_tpu.resilience import chaos as chaos_mod
+        log("serving chaos mode: one dispatch-thread crash injected "
+            "per rate point; recovery latency (time-to-requeue) "
+            "recorded")
+
     per_rate = {}
     best_tok_s = 0.0
     for rate in rates:
         gaps = np.random.RandomState(7).exponential(1.0 / rate,
                                                     size=n_req)
         eng = ServingEngine(model, params, num_slots=S,
-                            max_queue=2 * n_req)
+                            max_queue=2 * n_req,
+                            auto_restart=chaos_mode, max_restarts=8)
         t0 = time.time()
         handles = []
         for i, p in enumerate(prompts):
             handles.append(eng.submit(p, steps))
+            if chaos_mode and i == n_req // 3:
+                # Mid-load crash: deterministic site, armed once the
+                # engine is demonstrably busy.
+                chaos_mod.arm("serving_dispatch_crash", 1)
             if i < n_req - 1:
                 time.sleep(float(gaps[i]))
         results = [h.result() for h in handles]
         eng.shutdown()
+        if chaos_mode:
+            chaos_mod.install(None)
         dt = time.time() - t0
         snap = eng.metrics_snapshot()
         out_tokens = sum(len(r.tokens) for r in results)
@@ -561,13 +575,28 @@ def run_serving(args, devices, n_chips, log):
             "queue_wait_ms_p95": snap["queue_wait_ms"]["p95"],
             "completed": snap["completed"],
         }
+        if chaos_mode:
+            # The robustness cost on the perf trajectory: how long a
+            # crash-to-requeued recovery takes under this load.
+            per_rate[str(rate)].update({
+                "restarts": snap["restarts"],
+                "requeued": snap["requeued"],
+                "faults_injected": snap["faults_injected"],
+                "recovery_ms_p50": snap["recovery_ms"]["p50"],
+                "recovery_ms_p95": snap["recovery_ms"]["p95"],
+            })
+            log(f"serving rate={rate}/s chaos: "
+                f"{snap['restarts']} restart(s), "
+                f"{snap['requeued']} requeued, recovery p95 = "
+                f"{snap['recovery_ms']['p95']} ms")
         log(f"serving rate={rate}/s: {tok_s:.1f} tok/s, "
             f"ttft p50/p95 = {snap['ttft_ms']['p50']}/"
             f"{snap['ttft_ms']['p95']} ms, tpot p50/p95 = "
             f"{snap['tpot_ms']['p50']}/{snap['tpot_ms']['p95']} ms")
     return {"tok_s_chip": best_tok_s, "n_params": n_params,
             "num_slots": S, "max_new_tokens": steps,
-            "requests_per_rate": n_req, "rates": per_rate}
+            "requests_per_rate": n_req, "chaos": chaos_mode,
+            "rates": per_rate}
 
 
 def run_bert(args, devices, n_chips, log):
@@ -790,6 +819,13 @@ def main():
     ap.add_argument("--arrival-rates", default="2,6,12",
                     metavar="R0,R1,...",
                     help="serving: open-loop arrival rates (req/s)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="serving: self-healing cost mode — inject "
+                         "one dispatch-thread crash per rate point "
+                         "(engine runs with auto_restart) and record "
+                         "recovery latency (time-to-requeue p50/p95) "
+                         "plus restart/requeue counts in the BENCH "
+                         "json (docs/resilience.md)")
     ap.add_argument("--decode-steps", type=int, default=256)
     ap.add_argument("--decode-prefix-block", type=int, default=256,
                     help="decode reads the filled cache prefix in "

@@ -30,6 +30,17 @@ JAX_PLATFORMS=cpu python -m pytest -q --collect-only \
 # sequential generate (the engine's oracle contract).
 JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4
 
+# Chaos smoke (docs/resilience.md): one injected checkpoint-write
+# failure mid-run — the shared RetryPolicy must retry with backoff and
+# the run must still complete and leave a restorable checkpoint.
+rm -rf /tmp/hvd_chaos_smoke
+HVD_CHAOS=ckpt_write_fail:1 JAX_PLATFORMS=cpu \
+    python examples/jax_checkpoint_resume.py --steps 10 --save-every 5 \
+    --ckpt-dir /tmp/hvd_chaos_smoke 2>&1 | tee /tmp/hvd_chaos_smoke.log
+grep -q "retry 1/" /tmp/hvd_chaos_smoke.log       # the retry happened
+grep -q "final loss" /tmp/hvd_chaos_smoke.log     # ...and run finished
+test -d /tmp/hvd_chaos_smoke/step_00000010        # ...with the save
+
 python -m horovod_tpu.runner -np 2 --platform cpu -- \
     python examples/jax_mnist.py --steps 20
 

@@ -1,21 +1,36 @@
-"""Checkpoint/resume flow — the reference's §5.4 contract end-to-end.
+"""Checkpoint/resume flow — the reference's §5.4 contract end-to-end,
+now preemption-safe (docs/resilience.md).
 
 The reference delegates checkpointing to TF but pins two rules
 (`README.md:74-81`): (a) save on rank 0 only, (b) on restore, broadcast
 rank-0's state so every worker resumes identically. This example runs
-that flow with the TPU-native pieces: `save_step`/`restore_latest`
-(Orbax under the hood, rank-0-only with step discovery + pruning) and
-`broadcast_global_variables`.
+that flow with the TPU-native pieces — `save_step`/`restore_latest`
+(Orbax under the hood, rank-0-only, atomic temp+rename, retried under
+the shared `RetryPolicy`) and `broadcast_global_variables` — plus the
+resilience layer:
+
+* SIGTERM/SIGINT triggers an emergency checkpoint at the next step
+  boundary (`PreemptionHandler`), so a preempted run loses at most
+  one step; ``--sigterm-after N`` demonstrates it by signalling this
+  very process mid-run.
+* Restore is latest-GOOD: a corrupt/partial newest checkpoint (a
+  preemption mid-write) is skipped with a warning and the previous
+  step loads instead.
+* Injected checkpoint-write failures (``HVD_CHAOS=ckpt_write_fail:1``,
+  the CI chaos smoke) are retried with exponential backoff.
 
 Run it twice with the same --ckpt-dir to see the resume path:
     hvdrun -np 2 python examples/jax_checkpoint_resume.py --steps 30
     hvdrun -np 2 python examples/jax_checkpoint_resume.py --steps 60
 The second run discovers step 30, restores, broadcasts, and continues
-from there.
+from there. To see the preemption flow:
+    python examples/jax_checkpoint_resume.py --steps 60 --sigterm-after 12
+    python examples/jax_checkpoint_resume.py --steps 60
 """
 
 import argparse
 import os
+import signal
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -26,6 +41,7 @@ import numpy as np
 import optax
 
 import horovod_tpu as hvd
+from horovod_tpu.resilience import PreemptionHandler
 from horovod_tpu.utils import checkpoint as ckpt
 
 
@@ -36,6 +52,10 @@ def main():
     ap.add_argument("--ckpt-dir", default="/tmp/hvd_tpu_resume_example")
     ap.add_argument("--save-every", type=int, default=10)
     ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--sigterm-after", type=int, default=0,
+                    help="demo: send SIGTERM to this process after N "
+                         "steps — the loop cuts an emergency "
+                         "checkpoint and exits cleanly")
     args = ap.parse_args()
 
     hvd.init()
@@ -48,9 +68,10 @@ def main():
     params = {"w": jnp.zeros((3, 1), jnp.float32)}
     opt_state = tx.init(params)
 
-    # Resume discovery: restore the newest step and broadcast rank-0's
-    # copy so every worker starts from identical state (reference rule
-    # b). `like` gives Orbax the dtype/structure template.
+    # Resume discovery: restore the newest GOOD step (partial/corrupt
+    # checkpoints from a mid-write preemption are skipped with a
+    # warning) and broadcast rank-0's copy so every worker starts from
+    # identical state (reference rule b).
     start = 0
     latest = ckpt.latest_step(args.ckpt_dir)
     if latest is not None:
@@ -65,6 +86,11 @@ def main():
     else:
         params = hvd.broadcast_global_variables(params, 0)
 
+    # Preemption safety: the handler only sets a flag; the loop cuts
+    # the emergency checkpoint at the next step boundary (signal
+    # frames must not run checkpoint I/O mid-XLA-dispatch).
+    handler = PreemptionHandler().install()
+
     step = hvd.make_train_step(loss_fn, tx)
     rng = np.random.RandomState(7 + hvd.rank())
     w_true = np.asarray([[1.0], [-2.0], [0.5]], np.float32)
@@ -73,11 +99,27 @@ def main():
         x = rng.randn(32, 3).astype(np.float32)
         batch = hvd.make_global_batch((x, x @ w_true))
         params, opt_state, loss = step(params, opt_state, batch)
+        if args.sigterm_after and i + 1 == args.sigterm_after:
+            signal.raise_signal(signal.SIGTERM)   # simulated preempt
+        if handler.triggered:
+            # Emergency: synchronous save (the process is about to
+            # die) of THIS step, then a clean exit; the next run
+            # resumes here.
+            ckpt.wait_pending()
+            ckpt.save_step(args.ckpt_dir, i + 1,
+                           {"params": params, "opt": opt_state,
+                            "step": i + 1})
+            if hvd.rank() == 0:
+                print(f"preempted (signal {handler.signum}): "
+                      f"emergency checkpoint at step {i + 1}")
+            return
         if (i + 1) % args.save_every == 0:
             # Rank-0-only save (reference rule a); keep the newest 3.
             # block=False: the write runs on background threads so the
             # step loop keeps the device busy (atexit fences the last
-            # one; ckpt.wait_pending() fences explicitly).
+            # one; ckpt.wait_pending() fences explicitly). Transient
+            # write failures retry with backoff (ckpt_write_fail
+            # chaos site — the CI smoke injects one here).
             ckpt.save_step(args.ckpt_dir, i + 1,
                            {"params": params, "opt": opt_state,
                             "step": i + 1}, block=False)

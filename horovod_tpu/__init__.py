@@ -59,6 +59,7 @@ from horovod_tpu.jax import (
 from horovod_tpu.ops.sparse import IndexedSlices
 from horovod_tpu.runtime.config import config
 from horovod_tpu.utils.timeline import start_timeline, stop_timeline
+from horovod_tpu import resilience  # chaos / retry / elastic (docs/resilience.md)
 
 __version__ = "0.10.0"  # mirrors the reference's version (setup.py:348)
 
@@ -73,5 +74,5 @@ __all__ = [
     "broadcast_optimizer_state", "broadcast_object",
     "allgather_object", "grouped_allreduce",
     "make_train_step", "make_global_batch", "IndexedSlices", "config",
-    "start_timeline", "stop_timeline",
+    "start_timeline", "stop_timeline", "resilience",
 ]

@@ -70,3 +70,47 @@ def broadcast_on_train_begin(params, root_rank: int = 0):
     """Alias for broadcast_global_variables with callback naming."""
     from horovod_tpu.jax import broadcast_global_variables
     return broadcast_global_variables(params, root_rank)
+
+
+class ResilientCheckpointCallback:
+    """Keras-style step/epoch-end callback over
+    `resilience.ElasticTrainer`: periodic atomic checkpoints, an
+    emergency save the moment SIGTERM/SIGINT lands, and NaN/loss-spike
+    rollback to the last good checkpoint (docs/resilience.md).
+
+    ::
+
+        cb = ResilientCheckpointCallback("/ckpts", save_every=50)
+        state, start = cb.resume(like=state)
+        for i in range(start, steps):
+            state, loss = step(state, batch())
+            state = cb(i + 1, state, loss)
+            if cb.should_stop:
+                break
+    """
+
+    def __init__(self, directory: str, *, save_every: int = 50,
+                 keep: int = 3, block: bool = False,
+                 install_signals: bool = True):
+        from horovod_tpu.resilience import ElasticTrainer
+        self._trainer = ElasticTrainer(
+            directory, save_every=save_every, keep=keep, block=block,
+            install_signals=install_signals)
+
+    def resume(self, *, like=None, broadcast: bool = False):
+        return self._trainer.resume(like=like, broadcast=broadcast)
+
+    def __call__(self, step: int, state, loss):
+        return self._trainer.after_step(step, state, loss)
+
+    @property
+    def should_stop(self) -> bool:
+        return self._trainer.should_stop
+
+    @property
+    def rollbacks(self) -> int:
+        return self._trainer.rollbacks
+
+    def close(self):
+        """Uninstall the signal handlers (see ElasticTrainer.close)."""
+        self._trainer.close()

@@ -25,7 +25,28 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from horovod_tpu.resilience import chaos
+from horovod_tpu.resilience.retry import default_io_policy
+
 Spec = Sequence[Tuple[str, str, Tuple[int, ...]]]
+
+
+def _open_with_retry(path: str, mode: str):
+    """Shard open under the shared IO `RetryPolicy` (the same policy
+    checkpoint writes use): transient filesystem faults back off and
+    retry instead of killing the epoch. Chaos sites are split by
+    direction — ``data_read_fail`` fires only on read-mode opens (the
+    input pipeline), ``data_write_fail`` only on writes
+    (`write_shards`) — so arming read faults cannot corrupt a
+    concurrent dataset write's premise."""
+    site = "data_read_fail" if "r" in mode else "data_write_fail"
+
+    def _attempt():
+        if chaos.fires(site):
+            raise chaos.ChaosError(
+                f"injected shard open failure at {path} (site {site})")
+        return open(path, mode)
+    return default_io_policy().call(_attempt)
 
 
 def _field_bytes(dtype: str, shape: Tuple[int, ...]) -> int:
@@ -88,7 +109,7 @@ def write_shards(directory: str, prefix: str, spec: Spec,
         idx = np.arange(s, n, num_shards)
         shard = {k: v[idx] for k, v in arrays.items()}
         tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
+        with _open_with_retry(tmp, "wb") as f:
             f.write(pack_records(spec, shard))
         os.replace(tmp, path)
     return paths
@@ -173,7 +194,7 @@ class _PythonLoader:
             rng.shuffle(order)
         buf = np.empty(self._batch * self._rb, np.uint8)
         n_in = 0
-        handles = [open(f, "rb") for f in self._files]
+        handles = [_open_with_retry(f, "rb") for f in self._files]
         try:
             for fi, ri in order:
                 handles[fi].seek(ri * self._rb)

@@ -97,9 +97,45 @@ def make_cnn_train_step(model, tx: optax.GradientTransformation,
     )
     donate_argnums = (0,) if donate else ()
     from horovod_tpu.utils.timeline import step_bracket
-    return step_bracket(jax.jit(
+    jitted = step_bracket(jax.jit(
         sharded, donate_argnums=donate_argnums,
         compiler_options=combiner_override_options() or None))
+    return _chaos_step(jitted)
+
+
+def _chaos_step(step_fn):
+    """Chaos sites for one train-step invocation (host-side wrapper;
+    disarmed cost is one global None check per step):
+
+    * ``step_exception`` — a worker dies mid-step (the reference's
+      "one rank raised" scenario): raises `ChaosError` before the
+      dispatch, so the step never ran and state was not consumed.
+    * ``grad_nan`` — a diverged step: the returned loss AND params are
+      poisoned with NaN, exactly what an inf/NaN gradient produces
+      after `apply_updates` — the `NaNGuard` rollback path's fault.
+    """
+    from horovod_tpu.resilience import chaos
+
+    def stepped(state, batch, rng):
+        if chaos.fires("step_exception"):
+            raise chaos.ChaosError(
+                "injected worker exception mid-step "
+                "(site step_exception)")
+        new_state, loss = step_fn(state, batch, rng)
+        if chaos.fires("grad_nan"):
+            nan = jnp.float32(jnp.nan)
+            new_state = dict(
+                new_state,
+                params=jax.tree.map(lambda x: x * nan.astype(x.dtype),
+                                    new_state["params"]))
+            loss = loss * nan
+        return new_state, loss
+
+    # `__wrapped__` keeps resolving to the innermost JITTED step (the
+    # contract step_bracket established and tests/test_fusion.py's HLO
+    # introspection relies on: `step.__wrapped__.lower(...)`).
+    stepped.__wrapped__ = getattr(step_fn, "__wrapped__", step_fn)
+    return stepped
 
 
 def init_cnn_state(model, tx: optax.GradientTransformation, rng,

@@ -23,6 +23,20 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from horovod_tpu.resilience import chaos
+
+
+def _chaos_collective(op: str):
+    """Chaos site ``collective_slow``: when armed, the calling host
+    thread sleeps before dispatching `op` — the slow/hung-collective
+    fault (a peer died, the rendezvous never completes). Host-side by
+    design: under jit it fires at trace/dispatch time, which is
+    exactly where a hung collective parks the controller in practice
+    (the eager dispatch boundary in `ops/eager.py` carries the same
+    site). Disabled ⇒ one global load + None check."""
+    del op  # sites are engine-wide today; per-op filtering would key here
+    chaos.slow_site("collective_slow")
+
 
 def allreduce(x: jax.Array, *, average: bool = True,
               axis_name: str = "data") -> jax.Array:
@@ -36,6 +50,7 @@ def allreduce(x: jax.Array, *, average: bool = True,
     Integer inputs with `average=True` floor-divide and keep their dtype,
     matching the reference's `tf.div` semantics.
     """
+    _chaos_collective("allreduce")
     if not average:
         return lax.psum(x, axis_name)
     if jnp.issubdtype(x.dtype, jnp.integer):
@@ -58,6 +73,7 @@ def allgather(x: jax.Array, *, axis_name: str = "data") -> jax.Array:
     semantics of `MPI_Allgatherv` (`mpi_ops.cc:732-809`) live in
     `allgatherv` below and in the eager path.
     """
+    _chaos_collective("allgather")
     return lax.all_gather(x, axis_name, axis=0, tiled=True)
 
 
@@ -99,6 +115,7 @@ def broadcast(x: jax.Array, root_rank: int, *,
     so one-shot cost beats the complexity of a chunked ppermute ring
     pipeline (the only way to reach 1x with today's JAX collectives).
     """
+    _chaos_collective("broadcast")
     idx = lax.axis_index(axis_name)
     if jnp.issubdtype(x.dtype, jnp.bool_):
         masked = jnp.where(idx == root_rank, x, False)

@@ -396,6 +396,13 @@ def _run_collective(st, key, fn, data, *, mesh=None, in_specs=None,
     `data` is either a host [world, ...] stack (single-controller) or an
     already-placed global jax.Array (multi-controller).
     """
+    from horovod_tpu.resilience import chaos
+    # The slow/hung-collective fault at the eager dispatch boundary
+    # (the traced twin in ops/collectives.py fires at trace time): the
+    # host thread blocks exactly as it would waiting on a dead peer's
+    # rendezvous, so StallMonitor brackets around this call see the op
+    # pending.
+    chaos.slow_site("collective_slow")
     jitted = st.op_cache.get(key)
     if jitted is None:
         # check_vma=False: all_gather outputs are replicated by

@@ -1,0 +1,47 @@
+"""`horovod_tpu.resilience` — fault injection, retry, and
+preemption-safe training.
+
+Horovod's only failure story is "warn after 60 s and hope"
+(`CheckForStalledTensors`, mirrored in `utils/stall.py`). On TPU pods
+preemption is a scheduled fact of life and a single hung collective
+stalls the whole mesh, so this package gives the repo a tested
+recovery layer (docs/resilience.md):
+
+* `chaos` — a `ChaosMonkey` fault injector armed via ``HVD_CHAOS``;
+  named sites instrument checkpoint I/O, collectives, the train step,
+  and the serving engine with zero-overhead-when-disabled hooks, with
+  deterministic seeding so failures replay.
+* `retry` — `RetryPolicy`: bounded exponential backoff with jitter
+  and a deadline, shared by checkpoint I/O and data loading.
+* `elastic` — `PreemptionHandler` (SIGTERM/SIGINT emergency
+  checkpoint), `NaNGuard` (loss-spike / NaN rollback), and
+  `ElasticTrainer` tying resume discovery, periodic + emergency
+  checkpointing, and rollback into one loop-side helper.
+
+The chaos-vs-recovery contract is exercised end-to-end in
+`tests/test_resilience.py`: every recovery path in this package is
+driven by an injected fault, not asserted.
+"""
+
+from horovod_tpu.resilience.chaos import (
+    ChaosError,
+    ChaosMonkey,
+    armed,
+    fires,
+)
+from horovod_tpu.resilience.elastic import (
+    ElasticTrainer,
+    NaNGuard,
+    PreemptionHandler,
+)
+from horovod_tpu.resilience.retry import (
+    RetryError,
+    RetryPolicy,
+    default_io_policy,
+)
+
+__all__ = [
+    "ChaosError", "ChaosMonkey", "armed", "fires",
+    "RetryError", "RetryPolicy", "default_io_policy",
+    "ElasticTrainer", "NaNGuard", "PreemptionHandler",
+]

@@ -1,0 +1,265 @@
+"""Chaos injection: deterministic, replayable faults at named sites.
+
+The subsystems this repo claims are robust (checkpoint I/O, the train
+step, collectives, the serving engine) are instrumented with *sites* —
+single-line hooks of the form::
+
+    from horovod_tpu.resilience import chaos
+    if chaos.fires("ckpt_write_fail"):
+        raise chaos.ChaosError("injected checkpoint write failure")
+
+A site costs one module-global load and a ``None`` check when chaos is
+disarmed (the common case), so production paths pay nothing
+measurable. When a `ChaosMonkey` is installed — programmatically or
+via the ``HVD_CHAOS`` environment variable — sites fire according to
+their armed spec, and every fire is counted so tests can assert the
+fault actually happened.
+
+Spec grammar (comma-separated sites)::
+
+    HVD_CHAOS="ckpt_write_fail:2,collective_slow:1:delay=0.5"
+    HVD_CHAOS="serving_tick_stall:1:delay=2:p=0.5"  HVD_CHAOS_SEED=7
+
+``site:count`` fires on the first ``count`` opportunities
+(``count=-1`` = every opportunity); ``p=<float>`` makes each
+opportunity fire with that probability from a per-site RNG seeded by
+``HVD_CHAOS_SEED`` ^ hash(site) — the same seed replays the same
+fault schedule; ``delay=<seconds>`` parameterizes slow/hang sites.
+
+Instrumented sites (docs/resilience.md has the full table):
+
+======================  ==================================================
+site                    instrumented at
+======================  ==================================================
+ckpt_write_fail         `utils/checkpoint.py::save` (each write attempt)
+data_read_fail          `data/__init__.py` shard open, read mode
+data_write_fail         `data/__init__.py` shard open, write mode
+collective_slow         `ops/collectives.py` op entry (host-side; under
+                        jit this fires at trace/dispatch time)
+step_exception          `models/train.py` step invocation
+grad_nan                `models/train.py` step result (NaNs loss+params)
+serving_dispatch_crash  `serving/engine.py` dispatch-loop top
+serving_tick_stall      `serving/scheduler.py` inside the tick bracket
+                        (cooperative: ends early once abandoned)
+serving_deadline_storm  `serving/scheduler.py` — expires every queued
+                        request's deadline at once
+======================  ==================================================
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+class ChaosError(RuntimeError):
+    """The exception injected faults raise — typed so recovery code
+    (and tests) can target injected failures without catching real
+    programming errors by accident."""
+
+
+@dataclass
+class _Site:
+    name: str
+    count: int = 1               # fires remaining; -1 = unbounded
+    prob: float = 1.0            # per-opportunity fire probability
+    delay: float = 0.0           # seconds, for slow/hang sites
+    fired: int = 0               # fires so far
+    seen: int = 0                # opportunities so far
+    rng: random.Random = field(default_factory=random.Random)
+
+
+class ChaosMonkey:
+    """A set of armed sites. Thread-safe: sites fire from submit
+    threads, the serving dispatch thread, and training loops alike."""
+
+    def __init__(self, spec: str = "", *, seed: int = 0):
+        self._seed = seed
+        self._lock = threading.Lock()
+        self._sites: Dict[str, _Site] = {}
+        if spec:
+            self.arm_spec(spec)
+
+    def arm(self, site: str, count: int = 1, *, prob: float = 1.0,
+            delay: float = 0.0) -> "ChaosMonkey":
+        """Arm `site` to fire `count` times (-1 = always), each
+        opportunity firing with probability `prob`. Returns self so
+        arms chain."""
+        import zlib
+        with self._lock:
+            s = _Site(site, count=count, prob=prob, delay=delay)
+            # Deterministic per-site stream: same seed ⇒ same schedule,
+            # independent of what other sites consume. crc32, not
+            # hash() — str hashing is salted per process and must not
+            # change the replayed fault schedule.
+            s.rng.seed((self._seed << 16)
+                       ^ zlib.crc32(site.encode()))
+            self._sites[site] = s
+        return self
+
+    def arm_spec(self, spec: str) -> "ChaosMonkey":
+        """Parse and arm an ``HVD_CHAOS``-style spec string. Malformed
+        fields raise a `ValueError` naming the offending part — a
+        typo'd spec must fail loudly and legibly, not as a bare
+        float() traceback at import."""
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            name = fields[0]
+            count, prob, delay = 1, 1.0, 0.0
+            for f in fields[1:]:
+                try:
+                    if f.startswith("p="):
+                        prob = float(f[2:])
+                    elif f.startswith("delay="):
+                        delay = float(f[6:])
+                    else:
+                        count = int(f)
+                except ValueError:
+                    raise ValueError(
+                        f"bad chaos spec field {f!r} in {part!r} "
+                        f"(grammar: site:count[:p=<float>]"
+                        f"[:delay=<seconds>])") from None
+            self.arm(name, count, prob=prob, delay=delay)
+        return self
+
+    def fires(self, site: str) -> bool:
+        """One opportunity at `site`: True when the armed fault should
+        trigger now (and consumes one fire)."""
+        with self._lock:
+            s = self._sites.get(site)
+            if s is None:
+                return False
+            s.seen += 1
+            if s.count == 0:
+                return False
+            if s.prob < 1.0 and s.rng.random() >= s.prob:
+                return False
+            if s.count > 0:
+                s.count -= 1
+            s.fired += 1
+            return True
+
+    def delay_of(self, site: str, default: float = 1.0) -> float:
+        with self._lock:
+            s = self._sites.get(site)
+            return default if s is None or s.delay <= 0 else s.delay
+
+    def fired(self, site: str) -> int:
+        with self._lock:
+            s = self._sites.get(site)
+            return 0 if s is None else s.fired
+
+    def counts(self) -> Dict[str, int]:
+        """{site: fires so far} — the test/bench assertion surface."""
+        with self._lock:
+            return {n: s.fired for n, s in self._sites.items()}
+
+    def disarm(self, site: Optional[str] = None):
+        with self._lock:
+            if site is None:
+                self._sites.clear()
+            else:
+                self._sites.pop(site, None)
+
+
+# The module-level switch every site checks. None ⇒ disabled ⇒ a site
+# is one global load + `is None`.
+_active: Optional[ChaosMonkey] = None
+
+
+def install(monkey: Optional[ChaosMonkey]) -> Optional[ChaosMonkey]:
+    """Install (or with None, remove) the process-global monkey."""
+    global _active
+    _active = monkey
+    return monkey
+
+
+def active() -> Optional[ChaosMonkey]:
+    return _active
+
+
+def fires(site: str) -> bool:
+    """The zero-overhead-when-disabled site hook."""
+    m = _active
+    return False if m is None else m.fires(site)
+
+
+def slow_site(site: str, default_delay: float = 1.0) -> bool:
+    """The shared slow/hang site body: when `site` fires, block the
+    calling thread for its armed ``delay`` (modeling a host parked on
+    a dead peer's rendezvous). Returns whether it fired. Same
+    zero-overhead shape as `fires` when disarmed."""
+    m = _active
+    if m is None or not m.fires(site):
+        return False
+    import time
+    time.sleep(m.delay_of(site, default_delay))
+    return True
+
+
+def delay_of(site: str, default: float = 1.0) -> float:
+    m = _active
+    return default if m is None else m.delay_of(site, default)
+
+
+def fired(site: str) -> int:
+    m = _active
+    return 0 if m is None else m.fired(site)
+
+
+def arm(site: str, count: int = 1, *, prob: float = 1.0,
+        delay: float = 0.0) -> ChaosMonkey:
+    """Arm one site on the installed monkey (installing a fresh one if
+    chaos was disabled) — the programmatic entry bench.py uses."""
+    m = _active or install(ChaosMonkey(seed=_env_seed()))
+    return m.arm(site, count, prob=prob, delay=delay)
+
+
+@contextlib.contextmanager
+def armed(spec: str, *, seed: int = 0):
+    """Test scoping: install a monkey for the with-block, restore the
+    previous one (usually None) after::
+
+        with chaos.armed("ckpt_write_fail:2") as monkey:
+            ...
+        assert monkey.fired("ckpt_write_fail") == 2
+    """
+    prev = _active
+    monkey = ChaosMonkey(spec, seed=seed)
+    install(monkey)
+    try:
+        yield monkey
+    finally:
+        install(prev)
+
+
+def _env_seed() -> int:
+    try:
+        return int(os.environ.get("HVD_CHAOS_SEED", "0"))
+    except ValueError:
+        return 0
+
+
+def _init_from_env():
+    """Arm from ``HVD_CHAOS`` at import — how subprocess runs (the CI
+    chaos smoke, hvdrun workers) get their faults. A malformed spec
+    fails the import loudly with the offending field named (chaos
+    that silently fails to arm would let a broken resilience drill
+    pass green)."""
+    spec = os.environ.get("HVD_CHAOS", "")
+    if spec:
+        try:
+            install(ChaosMonkey(spec, seed=_env_seed()))
+        except ValueError as e:
+            raise ValueError(
+                f"HVD_CHAOS={spec!r}: {e}") from None
+
+
+_init_from_env()

@@ -179,6 +179,43 @@ class AdmissionQueue:
                 continue
             return req
 
+    def requeue(self, reqs: List[Request]) -> int:
+        """Recovery-path re-admission (engine watchdog restart): put
+        `reqs` at the FRONT of the queue in their original order —
+        they were admitted once already, so they bypass the depth
+        bound and keep their head start over later submits. If the
+        queue closed while the watchdog was working, the requests are
+        failed with `EngineClosedError` instead (never silently
+        dropped). Returns how many were re-admitted."""
+        if not reqs:
+            return 0
+        with self._lock:
+            doomed = list(reqs) if self._closed else []
+            if not self._closed:
+                for r in reversed(reqs):
+                    self._q.appendleft(r)
+        for req in doomed:
+            if not req.future.done():
+                req.future.set_exception(EngineClosedError(
+                    f"engine shut down while request {req.id} awaited "
+                    f"requeue"))
+        self._event.set()
+        return len(reqs) - len(doomed)
+
+    def force_expire(self, now: float) -> int:
+        """Chaos site ``serving_deadline_storm``'s hammer: every queued
+        request's deadline collapses to `now`, so the next sweep fails
+        them all with `DeadlineExceededError` at once — the thundering-
+        expiry worst case for the dispatcher. Returns how many
+        deadlines were tightened."""
+        with self._lock:
+            n = 0
+            for r in self._q:
+                if r.deadline is None or r.deadline > now:
+                    r.deadline = now
+                    n += 1
+        return n
+
     def sweep(self, now: float, on_drop=None) -> int:
         """Resolve cancelled/expired requests ANYWHERE in the queue —
         dying needs no slot, so the dispatcher runs this every tick:

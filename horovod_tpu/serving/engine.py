@@ -34,24 +34,29 @@ in chrome://tracing.
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
+import sys
 import threading
 import time
-from concurrent.futures import Future
+import traceback
+from concurrent.futures import CancelledError, Future
 from typing import Optional
 
 import numpy as np
 
+from horovod_tpu.resilience import chaos
 from horovod_tpu.models.transformer import TransformerLM
 from horovod_tpu.serving.admission import (
-    AdmissionQueue, EngineClosedError, QueueFullError, Request,
-    SamplingParams,
+    AdmissionQueue, DeadlineExceededError, EngineClosedError,
+    QueueFullError, Request, SamplingParams,
 )
 from horovod_tpu.serving.metrics import EngineMetrics
 from horovod_tpu.serving.scheduler import (
     CompletedRequest, ContinuousBatchingScheduler, _span,
 )
 from horovod_tpu.serving.slots import SlotPool
+from horovod_tpu.utils.stall import StallMonitor
 
 __all__ = ["ServingEngine", "RequestHandle", "CompletedRequest",
            "SamplingParams", "QueueFullError", "EngineClosedError"]
@@ -117,13 +122,35 @@ class ServingEngine:
     default_timeout_s : per-request deadline applied when `submit`
         gets no explicit ``timeout_s`` (None = no deadline).
     mesh : optional mesh for TP-sharded params, as in `generate`.
+    auto_restart : self-healing (docs/resilience.md): a watchdog
+        thread detects a dead dispatch thread (uncaught exception) or
+        a stuck one (no heartbeat for ``tick_deadline_s`` with work
+        pending) and restarts the engine IN PLACE — fresh slot pool,
+        fresh dispatch thread, same admission queue. In-flight
+        requests whose deadlines still have room are re-queued at the
+        front and replayed from their prompt (token-exact: greedy and
+        per-request-seeded sampling are both deterministic given the
+        prompt); requests past their deadline fail with
+        `DeadlineExceededError` carrying the partial tokens. After
+        ``max_restarts`` the engine falls back to fail-everything
+        containment. Off by default: without it a dispatch crash fails
+        all futures immediately (the PR-1 contract).
+    tick_deadline_s : stuck-dispatch threshold for the watchdog (None
+        disables stuck detection; crashes are still healed).
+    stall_warning_s : threshold for the engine's `StallMonitor`, which
+        brackets every decode tick so a hang warns naming the serving
+        tick (``serving_tick_<n>``). Default: the
+        ``HOROVOD_STALL_CHECK_TIME`` config (60 s).
     """
 
     def __init__(self, model: TransformerLM, params, *,
                  num_slots: int = 4, max_queue: int = 16,
                  eos_id: Optional[int] = None,
                  default_timeout_s: Optional[float] = None,
-                 mesh=None):
+                 mesh=None, auto_restart: bool = False,
+                 max_restarts: int = 2,
+                 tick_deadline_s: Optional[float] = None,
+                 stall_warning_s: Optional[float] = None):
         if eos_id is not None and not 0 <= eos_id < model.vocab_size:
             raise ValueError(
                 f"eos_id must be in [0, vocab_size={model.vocab_size}"
@@ -132,18 +159,42 @@ class ServingEngine:
         self.eos_id = eos_id
         self.default_timeout_s = default_timeout_s
         self.metrics = EngineMetrics()
+        self.auto_restart = auto_restart
+        self.max_restarts = max_restarts
+        self.tick_deadline_s = tick_deadline_s
+        if stall_warning_s is None:
+            from horovod_tpu.runtime.config import config as _cfg
+            stall_warning_s = _cfg.stall_warning_time
+        self.stall = StallMonitor(warning_time_s=stall_warning_s,
+                                  check_every_s=max(
+                                      1.0, stall_warning_s / 4))
         self.pool = SlotPool(model, params, num_slots, mesh=mesh)
         self.queue = AdmissionQueue(max_queue)
         self.scheduler = ContinuousBatchingScheduler(
-            self.pool, self.queue, self.metrics, eos_id=eos_id)
+            self.pool, self.queue, self.metrics, eos_id=eos_id,
+            stall=self.stall)
         self._ids = itertools.count()
         self._lock = threading.Lock()
         self._closing = False
         self._drain = True
+        # Restart machinery: `_epoch` names the CURRENT dispatch
+        # generation; a dispatch thread that observes a newer epoch
+        # knows it was superseded and exits without touching anything.
+        self._epoch = 0
+        self._restart_count = 0
+        self._heartbeat = time.time()
         self._thread = threading.Thread(
-            target=self._dispatch_loop, name="serving-dispatch",
-            daemon=True)
+            target=self._dispatch_loop,
+            args=(0, self.scheduler, self.queue),
+            name="serving-dispatch", daemon=True)
         self._thread.start()
+        self._watchdog: Optional[threading.Thread] = None
+        self._wd_stop = threading.Event()
+        if auto_restart:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="serving-watchdog",
+                daemon=True)
+            self._watchdog.start()
 
     # -- submit side --------------------------------------------------
 
@@ -203,44 +254,192 @@ class ServingEngine:
 
     # -- dispatch side ------------------------------------------------
 
-    def _dispatch_loop(self):
+    def _dispatch_loop(self, epoch: int,
+                       scheduler: ContinuousBatchingScheduler,
+                       queue: AdmissionQueue):
+        # `scheduler`/`queue` are BOUND at thread start: after a
+        # watchdog restart `self.scheduler` points at the successor's
+        # state, and a superseded thread limping out of a hung device
+        # call must keep driving its own (abandoned) scheduler, never
+        # the replacement's.
         try:
             while True:
-                progressed = self.scheduler.step()
-                self.metrics.observe_gauges(
-                    len(self.queue), self.pool.busy_slots,
-                    self.pool.num_slots)
+                if chaos.fires("serving_dispatch_crash"):
+                    self.metrics.count("faults_injected")
+                    raise chaos.ChaosError(
+                        "injected serving dispatch-thread crash "
+                        "(site serving_dispatch_crash)")
+                progressed = scheduler.step()
                 with self._lock:
+                    if self._epoch != epoch:
+                        return   # superseded by a watchdog restart
                     closing, drain = self._closing, self._drain
+                # Heartbeat only AFTER the epoch check: a superseded
+                # thread limping out of a hung call must not refresh
+                # the live generation's stuck timer.
+                self._heartbeat = time.time()
+                self.metrics.observe_gauges(
+                    len(queue), scheduler.pool.busy_slots,
+                    scheduler.pool.num_slots)
                 if closing:
                     if not drain:
-                        self.scheduler.abort_active()
+                        scheduler.abort_active()
                         return
-                    if (not self.scheduler.has_active()
-                            and len(self.queue) == 0):
+                    if (not scheduler.has_active()
+                            and len(queue) == 0):
                         return
                     continue
-                if not progressed and not self.scheduler.has_active():
-                    self.queue.wait(_IDLE_WAIT_S)
+                if not progressed and not scheduler.has_active():
+                    queue.wait(_IDLE_WAIT_S)
         except BaseException as e:  # noqa: BLE001 — fail futures, not hang
-            # The degrade-by-shedding contract extends to the engine's
-            # own faults (a poison request, a compile failure, device
-            # OOM): a dead dispatch thread must not leave callers
-            # blocked in result() forever. Fail every in-flight and
-            # queued future with the error, mark the engine closed so
-            # later submits are rejected, and log the traceback (no
-            # re-raise: the futures carry the failure to callers).
-            import sys
-            import traceback
+            # A dispatch-thread fault (a poison request, a compile
+            # failure, device OOM, an injected crash). With the
+            # watchdog on and restart budget left, just exit: the
+            # watchdog sees the dead thread and restarts the engine in
+            # place, re-queuing this thread's in-flight requests.
+            with self._lock:
+                superseded = self._epoch != epoch
+                healable = (self.auto_restart and not self._closing
+                            and not superseded
+                            and self._restart_count < self.max_restarts)
+            if superseded:
+                # A watchdog restart already took this generation's
+                # requests; the queue and futures belong to the
+                # successor now — containment here would close the
+                # LIVE engine. Exit quietly.
+                sys.stderr.write(
+                    f"superseded serving dispatch thread exited with "
+                    f"{e!r} (already recovered)\n")
+                return
+            if healable:
+                sys.stderr.write(
+                    f"serving dispatch thread crashed ({e!r}); "
+                    f"watchdog restarting the engine\n")
+                return
+            # Containment (no watchdog / budget exhausted): a dead
+            # dispatch thread must not leave callers blocked in
+            # result() forever. Fail every in-flight and queued future
+            # with the error, mark the engine closed so later submits
+            # are rejected, and log the traceback (no re-raise: the
+            # futures carry the failure to callers).
             with self._lock:
                 self._closing = True
-            for slot, req in list(self.scheduler.active.items()):
-                self.scheduler.active.pop(slot, None)
-                req.future.set_exception(EngineClosedError(
+            for slot, req in list(scheduler.active.items()):
+                scheduler.active.pop(slot, None)
+                scheduler._resolve(req.future, exc=EngineClosedError(
                     f"serving dispatch thread died: {e!r}"))
-            self.queue.close(drain=False)  # fails queued futures too
+            queue.close(drain=False)  # fails queued futures too
             sys.stderr.write("serving dispatch thread died:\n")
             traceback.print_exc(file=sys.stderr)
+
+    # -- self-healing (docs/resilience.md) ----------------------------
+
+    def _watchdog_loop(self):
+        """Detect a dead or stuck dispatch thread and heal in place."""
+        poll = 0.02
+        if self.tick_deadline_s is not None:
+            poll = min(poll, self.tick_deadline_s / 4)
+        while not self._wd_stop.wait(poll):
+            with self._lock:
+                if self._closing:
+                    return
+                thread = self._thread
+            dead = not thread.is_alive()
+            # Stuck = stale heartbeat with work pending, EXCEPT while
+            # the pool may be inside a first-time-shape XLA compile
+            # (arbitrarily long, and progress, not a hang). No
+            # first-step grace beyond that: a poison request re-queued
+            # to the front must trip detection again in the successor
+            # generation, not hang it forever.
+            stuck = (self.tick_deadline_s is not None
+                     and not self.pool.maybe_compiling
+                     and (self.scheduler.has_active()
+                          or len(self.queue) > 0)
+                     and (time.time() - self._heartbeat
+                          > self.tick_deadline_s))
+            if not (dead or stuck):
+                continue
+            if self._restart_count >= self.max_restarts:
+                self._contain(
+                    f"dispatch {'died' if dead else 'stuck'} with the "
+                    f"restart budget ({self.max_restarts}) exhausted")
+                return
+            self._restart("died" if dead else
+                          f"no heartbeat for {self.tick_deadline_s}s")
+
+    def _restart(self, reason: str):
+        """Restart the engine in place: abandon the old dispatch
+        generation, re-queue its recoverable requests, stand up a
+        fresh slot pool + scheduler + dispatch thread."""
+        t_fault = self._heartbeat   # last sign of life
+        with self._lock:
+            if self._closing:
+                return
+            self._epoch += 1
+            epoch = self._epoch
+            self._restart_count += 1
+        old = self.scheduler
+        # abandon() marks the old generation dead and takes its
+        # in-flight requests atomically vs the old thread's admit
+        # registration (scheduler handoff lock) — no request can fall
+        # between the snapshot and the old thread's bookkeeping.
+        inflight = old.abandon()
+        now = time.time()
+        requeued = []
+        for req in inflight:
+            if req.cancelled:
+                self.metrics.count("cancelled")
+                old._resolve(req.future, exc=CancelledError())
+            elif req.expired(now):
+                self.metrics.count("timed_out")
+                old._resolve(req.future, exc=DeadlineExceededError(
+                    f"request {req.id}: deadline passed during engine "
+                    f"restart ({len(req.tokens)} tokens in)",
+                    partial_tokens=list(req.tokens)))
+            else:
+                # Fresh Request sharing the future/cancel-flag/id:
+                # replay from the prompt is token-exact (greedy and
+                # seeded sampling are deterministic), and a fresh
+                # tokens list means the old thread limping out of a
+                # hung tick cannot corrupt the replay.
+                requeued.append(dataclasses.replace(
+                    req, tokens=[], t_prefill=0.0, t_first=0.0))
+        n = self.queue.requeue(requeued)
+        self.metrics.count("restarts")
+        if n:
+            self.metrics.count("requeued", n)
+        # Fresh device state: the old pool's cache is mid-unknown-
+        # tick; compiled programs are shared so this is cheap.
+        self.pool = self.pool.clone_fresh()
+        self.scheduler = ContinuousBatchingScheduler(
+            self.pool, self.queue, self.metrics, eos_id=self.eos_id,
+            stall=self.stall)
+        with self._lock:
+            self._heartbeat = time.time()
+            self._thread = threading.Thread(
+                target=self._dispatch_loop,
+                args=(epoch, self.scheduler, self.queue),
+                name=f"serving-dispatch-{epoch}", daemon=True)
+            self._thread.start()
+        self.metrics.observe_recovery(time.time() - t_fault)
+        sys.stderr.write(
+            f"serving watchdog: dispatch {reason}; engine restarted "
+            f"in place (restart {self._restart_count}/"
+            f"{self.max_restarts}, {n} request(s) re-queued, "
+            f"{len(inflight) - len(requeued)} failed)\n")
+
+    def _contain(self, why: str):
+        """Terminal failure: close and fail everything (the PR-1
+        degrade-by-shedding contract)."""
+        with self._lock:
+            self._closing = True
+        sched = self.scheduler
+        for req in sched.abandon():
+            sched._resolve(req.future, exc=EngineClosedError(
+                f"serving engine gave up: {why}"))
+        doomed = self.queue.close(drain=False)
+        self.metrics.count("aborted", len(doomed))
+        sys.stderr.write(f"serving watchdog: {why}; engine closed\n")
 
     # -- lifecycle ----------------------------------------------------
 
@@ -250,6 +449,12 @@ class ServingEngine:
         queued and in-flight request first — the clean-exit contract;
         ``drain=False`` fails queued requests with `EngineClosedError`
         and aborts in-flight ones at the next tick. Idempotent."""
+        # The watchdog goes down FIRST (joined, not just signalled): a
+        # restart racing the close below could stand up a new dispatch
+        # thread after this join picked the old one.
+        self._wd_stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join()
         with self._lock:
             self._closing = True
             self._drain = self._drain and drain
@@ -266,6 +471,7 @@ class ServingEngine:
                 f"serving dispatch thread still draining after "
                 f"{timeout}s (queue={len(self.queue)}, "
                 f"active={self.pool.busy_slots})")
+        self.stall.stop()
         # The dispatcher is gone. A submit racing the close above (its
         # offer landed after the dispatcher saw `closing` and exited,
         # but before queue.close flipped the rejected flag) would
@@ -273,6 +479,16 @@ class ServingEngine:
         # straggler now (idempotent re-close with drain=False).
         stragglers = self.queue.close(drain=False)
         self.metrics.count("aborted", len(stragglers))
+        # And if the dispatcher died (crash between watchdog stop and
+        # here, or healable crash whose restart never happened), its
+        # in-flight futures must not dangle.
+        sched = self.scheduler
+        for slot, req in list(sched.active.items()):
+            sched.active.pop(slot, None)
+            sched._resolve(req.future, exc=EngineClosedError(
+                f"engine shut down while request {req.id} was in "
+                f"flight"))
+            self.metrics.count("aborted")
 
     def __enter__(self) -> "ServingEngine":
         return self

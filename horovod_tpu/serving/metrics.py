@@ -83,6 +83,10 @@ class EngineMetrics:
         self.tokens_out = 0        # generated tokens, completed or not
         self.prefill_tokens = 0
         self.ticks = 0             # decode ticks executed
+        # Self-healing counters (engine watchdog, docs/resilience.md).
+        self.restarts = 0          # in-place engine restarts
+        self.requeued = 0          # in-flight requests replayed
+        self.faults_injected = 0   # chaos sites fired inside serving
         # Gauges (set by the engine each loop).
         self.queue_depth = 0
         self.slots_busy = 0
@@ -92,6 +96,13 @@ class EngineMetrics:
         self.ttft_s = Series()
         self.tpot_s = Series()
         self.e2e_s = Series()
+        # Fault → requeued-and-running latency per watchdog restart
+        # (time-to-requeue): the robustness cost bench --chaos tracks.
+        self.recovery_s = Series()
+
+    def observe_recovery(self, dt_s: float):
+        with self._lock:
+            self.recovery_s.add(dt_s)
 
     def count(self, name: str, n: int = 1):
         with self._lock:
@@ -130,6 +141,10 @@ class EngineMetrics:
                 "tokens_out": self.tokens_out,
                 "prefill_tokens": self.prefill_tokens,
                 "ticks": self.ticks,
+                "restarts": self.restarts,
+                "requeued": self.requeued,
+                "faults_injected": self.faults_injected,
+                "recovery_ms": self.recovery_s.summary(1e3),
                 "queue_depth": self.queue_depth,
                 "slots_busy": self.slots_busy,
                 "num_slots": self.num_slots,

@@ -24,13 +24,16 @@ engine degrades by shedding, never by hanging.
 
 from __future__ import annotations
 
+import itertools
+import threading
 import time
-from concurrent.futures import CancelledError
+from concurrent.futures import CancelledError, InvalidStateError
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
+from horovod_tpu.resilience import chaos
 from horovod_tpu.serving.admission import (
     AdmissionQueue, DeadlineExceededError, EngineClosedError, Request,
 )
@@ -72,6 +75,12 @@ def _span(method: str, request_id: int, name: str):
         getattr(tl, method)(f"request:{request_id}", name)
 
 
+# Distinguishes stall-bracket names across scheduler generations: a
+# superseded thread's finally-end() must never cancel the successor's
+# identically-numbered pending tick (both count from shared metrics).
+_SCHED_GEN = itertools.count()
+
+
 class ContinuousBatchingScheduler:
     """The policy half of the engine: owns which request sits in which
     slot and why it leaves. Single-threaded by contract (the engine's
@@ -80,12 +89,33 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, pool: SlotPool, queue: AdmissionQueue,
                  metrics: EngineMetrics, *,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None, stall=None):
         self.pool = pool
         self.queue = queue
         self.metrics = metrics
         self.eos_id = eos_id
+        self.stall = stall           # optional utils.stall.StallMonitor
         self.active: Dict[int, Request] = {}   # slot -> request
+        # Set (only through `abandon()`) by the engine watchdog when
+        # this scheduler's dispatch thread is declared dead/stuck and
+        # a replacement takes over: an abandoned scheduler must
+        # neither admit nor resolve anything — its requests now belong
+        # to the successor. The handoff lock makes admit-registration
+        # and the watchdog's abandon+snapshot mutually exclusive, so a
+        # request can never fall between the successor's snapshot and
+        # the old thread's bookkeeping (a stranded future).
+        self.abandoned = False
+        self._handoff = threading.Lock()
+        self._gen = next(_SCHED_GEN)
+
+    def abandon(self) -> List[Request]:
+        """Watchdog entry: mark this scheduler dead and take ownership
+        of its in-flight requests atomically vs `_admit`."""
+        with self._handoff:
+            self.abandoned = True
+            inflight = list(self.active.values())
+            self.active.clear()
+        return inflight
 
     def has_active(self) -> bool:
         return bool(self.active)
@@ -95,7 +125,14 @@ class ContinuousBatchingScheduler:
     def step(self, now: Optional[float] = None) -> bool:
         """One scheduling iteration; True when any device work ran
         (the engine parks the thread on False)."""
+        if self.abandoned:
+            return False
         now = time.time() if now is None else now
+        if chaos.fires("serving_deadline_storm"):
+            # Every queued deadline collapses at once — the sweep
+            # below must fail them all in one tick, never hang.
+            self.metrics.count("faults_injected")
+            self.queue.force_expire(now)
         # Dead queued requests (cancelled / deadline-expired) resolve
         # NOW, slot or no slot — with every slot busy, _admit below
         # never pops the queue, and a 100 ms deadline must not wait
@@ -104,8 +141,34 @@ class ContinuousBatchingScheduler:
         admitted = self._admit(now)
         if not self.active:
             return admitted
-        toks = self.pool.tick()
+        # The StallMonitor brackets the device tick so a hang warns
+        # with the serving tick named (engine wires the monitor in).
+        tick_name = f"serving_tick_{self._gen}.{self.metrics.ticks}"
+        if self.stall is not None:
+            self.stall.begin(tick_name)
+        try:
+            if chaos.fires("serving_tick_stall"):
+                # Cooperative hung-tick injection INSIDE the stall
+                # bracket: the heartbeat goes stale (watchdog food),
+                # the monitor sees this tick pending. Ends early once
+                # abandoned so the superseded thread can exit.
+                self.metrics.count("faults_injected")
+                t_end = time.time() + chaos.delay_of(
+                    "serving_tick_stall", 1.0)
+                while time.time() < t_end and not self.abandoned:
+                    time.sleep(0.005)
+            toks = self.pool.tick()
+        finally:
+            # end() even when the tick raises — a crashed tick must
+            # not leave a forever-pending entry warning every sweep.
+            if self.stall is not None:
+                self.stall.end(tick_name)
         self.metrics.count("ticks")
+        if self.abandoned:
+            # Superseded mid-tick: the successor owns these requests
+            # now — appending this tick's tokens would corrupt their
+            # replay-from-prompt.
+            return True
         t_tick = time.time()
         for slot, req in list(self.active.items()):
             tok = int(toks[slot])
@@ -117,18 +180,30 @@ class ContinuousBatchingScheduler:
     def _admit(self, now: float) -> bool:
         """Fill free slots from the queue (prefill-into-slot)."""
         admitted = False
-        while self.pool.has_free():
+        while self.pool.has_free() and not self.abandoned:
             req = self.queue.pop_ready(now, on_drop=self._queue_drop)
             if req is None:
                 break
-            slot = self.pool.alloc()
+            # Registration is the handoff-critical line: between
+            # pop_ready above and active[slot]=req the request is in
+            # neither the queue nor `active`, so a watchdog abandon
+            # landing in that window would strand its future. The lock
+            # forces an order: either the registration happens before
+            # the snapshot (the successor requeues it) or the abandon
+            # is visible here (we hand it straight back to the queue).
+            with self._handoff:
+                if self.abandoned:
+                    self.queue.requeue([req])
+                    break
+                slot = self.pool.alloc()
+                # Registered BEFORE prefill so a fault inside it
+                # (compile failure, OOM) leaves the request findable
+                # by the engine's crash containment — never a future
+                # in limbo.
+                self.active[slot] = req
             req.t_prefill = time.time()
             _span("end_span", req.id, "QUEUE")
             _span("begin_span", req.id, "PREFILL")
-            # Registered BEFORE prefill so a fault inside it (compile
-            # failure, OOM) leaves the request findable by the
-            # engine's crash containment — never a future in limbo.
-            self.active[slot] = req
             first = self.pool.prefill(
                 slot, req.prompt, req.sampling.temperature,
                 req.sampling.top_p, req.sampling.seed)
@@ -167,9 +242,26 @@ class ContinuousBatchingScheduler:
         elif len(req.tokens) >= req.max_new_tokens:
             self._retire(slot, req, "length", now)
 
+    @staticmethod
+    def _resolve(future, *, result=None, exc=None):
+        """Resolve a future, tolerating the recovery race: an
+        abandoned predecessor thread limping to a retire AFTER the
+        watchdog already failed/requeued the request must not crash on
+        the already-resolved future."""
+        try:
+            if exc is not None:
+                future.set_exception(exc)
+            else:
+                future.set_result(result)
+        except InvalidStateError:
+            pass
+
     def _retire(self, slot: int, req: Request, reason: str,
                 now: float):
         """Free the slot and resolve the request's future."""
+        if self.abandoned:
+            self.active.pop(slot, None)
+            return
         self.pool.free(slot)
         self.active.pop(slot, None)
         _span("end_span", req.id, "DECODE")
@@ -182,7 +274,7 @@ class ContinuousBatchingScheduler:
             self.metrics.observe_request(
                 t_submit=req.t_submit, t_prefill=req.t_prefill,
                 t_first=req.t_first, t_done=now, n_tokens=n)
-            req.future.set_result(CompletedRequest(
+            self._resolve(req.future, result=CompletedRequest(
                 request_id=req.id,
                 prompt=np.asarray(req.prompt),
                 tokens=np.asarray(req.tokens, np.int64),
@@ -193,16 +285,16 @@ class ContinuousBatchingScheduler:
                 e2e_s=now - req.t_submit))
         elif reason == "cancelled":
             self.metrics.count("cancelled")
-            req.future.set_exception(CancelledError())
+            self._resolve(req.future, exc=CancelledError())
         elif reason == "timeout":
             self.metrics.count("timed_out")
-            req.future.set_exception(DeadlineExceededError(
+            self._resolve(req.future, exc=DeadlineExceededError(
                 f"request {req.id}: deadline passed after "
                 f"{len(req.tokens)} tokens",
                 partial_tokens=list(req.tokens)))
         else:   # aborted — non-draining shutdown
             self.metrics.count("aborted")
-            req.future.set_exception(EngineClosedError(
+            self._resolve(req.future, exc=EngineClosedError(
                 f"engine shut down while request {req.id} was "
                 f"decoding ({len(req.tokens)} tokens in)"))
 

@@ -100,10 +100,33 @@ class SlotPool:
         # Host-side ticks since each slot's last reset (see
         # RESET_IDLE_TICKS).
         self._idle_ticks = np.zeros((num_slots,), np.int64)
+        # Compile awareness for the engine watchdog: True while a
+        # device call whose shape this pool has not executed before is
+        # in flight — a first-time XLA compile can take arbitrarily
+        # long and must not read as a stuck tick (stuck detection is
+        # suppressed while set). Shapes already seen are jit-cache
+        # hits, so the flag clears in microseconds for warm calls.
+        self.maybe_compiling = False
+        self._seen_shapes: set = set()
 
     def _ctx(self):
         return use(self.mesh) if self.mesh is not None \
             else contextlib.nullcontext()
+
+    def clone_fresh(self) -> "SlotPool":
+        """A brand-new pool over the same model/params/mesh — the
+        engine watchdog's restart primitive (docs/resilience.md). The
+        old pool may be mid-tick in a hung dispatch thread, so its
+        cache and free-list are untrusted; a clone starts from zeroed
+        slots. Compiled tick/prefill programs are keyed by the model
+        config and shapes, both unchanged, so the clone recompiles
+        nothing."""
+        fresh = SlotPool(self.model, self.params, self.num_slots,
+                         mesh=self.mesh)
+        # The jit cache is process-global: shapes this pool compiled
+        # are warm for the clone too.
+        fresh._seen_shapes = set(self._seen_shapes)
+        return fresh
 
     def fill_indices(self) -> np.ndarray:
         """Per-slot cache fill index, maxed across layers (and the
@@ -156,27 +179,37 @@ class SlotPool:
         bounded by log2(max_len) — never one per prompt length.
         """
         prompt = np.asarray(prompt)
-        with self._ctx():
-            self._cache = slot_reset(self.dec_model, self._cache,
-                                     jnp.int32(slot))
-            self._idle_ticks[slot] = 0
-            off = 0
-            for c in prefill_chunks(int(prompt.shape[0])):
-                self._cache, logits = slot_prefill_chunk(
-                    self.dec_model, self.params, self._cache,
-                    jnp.int32(slot),
-                    jnp.asarray(prompt[off:off + c], jnp.int32))
-                off += c
-            temp = jnp.float32(temperature)
-            tp = jnp.float32(1.0 if top_p is None else top_p)
-            tok, rng = _first_token(logits, temp, tp,
-                                    jax.random.PRNGKey(seed))
-            # Install the slot's tick-side sampling state.
-            self._toks = self._toks.at[slot].set(tok)
-            self._temps = self._temps.at[slot].set(temp)
-            self._top_ps = self._top_ps.at[slot].set(tp)
-            self._rngs = self._rngs.at[slot].set(rng)
-            return int(tok)
+        chunks = prefill_chunks(int(prompt.shape[0]))
+        self.maybe_compiling = (
+            ("first_token",) not in self._seen_shapes
+            or any(("prefill", c) not in self._seen_shapes
+                   for c in chunks))
+        try:
+            with self._ctx():
+                self._cache = slot_reset(self.dec_model, self._cache,
+                                         jnp.int32(slot))
+                self._idle_ticks[slot] = 0
+                off = 0
+                for c in chunks:
+                    self._cache, logits = slot_prefill_chunk(
+                        self.dec_model, self.params, self._cache,
+                        jnp.int32(slot),
+                        jnp.asarray(prompt[off:off + c], jnp.int32))
+                    self._seen_shapes.add(("prefill", c))
+                    off += c
+                temp = jnp.float32(temperature)
+                tp = jnp.float32(1.0 if top_p is None else top_p)
+                tok, rng = _first_token(logits, temp, tp,
+                                        jax.random.PRNGKey(seed))
+                self._seen_shapes.add(("first_token",))
+                # Install the slot's tick-side sampling state.
+                self._toks = self._toks.at[slot].set(tok)
+                self._temps = self._temps.at[slot].set(temp)
+                self._top_ps = self._top_ps.at[slot].set(tp)
+                self._rngs = self._rngs.at[slot].set(rng)
+                return int(tok)
+        finally:
+            self.maybe_compiling = False
 
     def tick(self) -> np.ndarray:
         """One continuous-batching decode tick over every slot; returns
@@ -186,10 +219,15 @@ class SlotPool:
         allocated lane must not creep its fill index — and with it the
         shared prefix-attention trip count — for the engine's
         lifetime."""
+        self.maybe_compiling = ("tick",) not in self._seen_shapes
         with self._ctx():
-            self._cache, self._toks, self._rngs = slot_decode_tick(
-                self.dec_model, self.params, self._cache, self._toks,
-                self._temps, self._top_ps, self._rngs)
+            try:
+                self._cache, self._toks, self._rngs = slot_decode_tick(
+                    self.dec_model, self.params, self._cache,
+                    self._toks, self._temps, self._top_ps, self._rngs)
+                self._seen_shapes.add(("tick",))
+            finally:
+                self.maybe_compiling = False
             toks = np.asarray(self._toks)
             self._idle_ticks += 1
             for slot in self._free:

@@ -16,10 +16,28 @@ something the reference cannot do) is available via ``distributed=True``.
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+import sys
+from typing import Any, Optional, Tuple, Union
 
 import jax
 import numpy as np
+
+from horovod_tpu.resilience import chaos
+from horovod_tpu.resilience.retry import RetryPolicy, default_io_policy
+
+
+class CheckpointError(RuntimeError):
+    """Base class for checkpoint load/store failures."""
+
+
+class CheckpointNotFoundError(CheckpointError):
+    """`restore()` was pointed at a path with no checkpoint directory."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The checkpoint directory exists but cannot be read back — a
+    partial write (the process died mid-save), filesystem damage, or a
+    template mismatch. The original Orbax error is chained."""
 
 
 def _solo_mp_options(prefix: str):
@@ -97,7 +115,8 @@ def _fence_swallowing() -> None:
 
 
 def save(path: str, state: Any, *, force: bool = True,
-         distributed: bool = False, block: bool = True) -> bool:
+         distributed: bool = False, block: bool = True,
+         retry: Optional[RetryPolicy] = None) -> bool:
     """Write `state` (any pytree of arrays) to `path`.
 
     Rank-0-only unless ``distributed`` (Orbax multi-host mode where all
@@ -109,6 +128,25 @@ def save(path: str, state: Any, *, force: bool = True,
     checkpoint IO must not stall the device). At most one save is in
     flight; a new one first waits for the previous. `wait_pending()`
     (also registered atexit) fences explicitly.
+
+    Transient write failures (`OSError`, injected `ChaosError`s at the
+    ``ckpt_write_fail`` site) are retried with exponential backoff
+    under ``retry`` — default `default_io_policy()` (3 attempts,
+    ``HVD_IO_RETRIES`` overrides). All attempts exhausted raises
+    `resilience.retry.RetryError`. Ranks cannot diverge: ranks other
+    than 0 return before the write, and the ``distributed`` path is
+    NEVER retried — it is a collective write with cross-process
+    barriers, and one rank re-entering it alone (a rank-local 5xx)
+    would park every peer in a mismatched barrier; a distributed save
+    fails fast instead.
+
+    Async caveat: with ``block=False`` the policy covers the
+    *scheduling* of the save (fencing the previous one included); a
+    failure in the background commit itself is NOT retried — it
+    surfaces at the next fence (`wait_pending()`, the next save, or
+    atexit), the same place async failures always surface. Runs that
+    need the full retry guarantee for a particular save (emergency
+    checkpoints) use ``block=True``.
     """
     from horovod_tpu.runtime import bootstrap as bs
 
@@ -121,17 +159,43 @@ def save(path: str, state: Any, *, force: bool = True,
         return False
     state = jax.tree.map(
         lambda x: np.asarray(x) if not distributed else x, state)
+    policy = retry if retry is not None else default_io_policy()
     if not block and not distributed:
         ckpt = _async_checkpointer()
+        # Fence the PREVIOUS async save OUTSIDE the retry: a failure
+        # re-raised here belongs to that save and must propagate to
+        # the caller (the wait_pending contract) — the retry loop
+        # must not consume it as this save's transient error.
         ckpt.wait_until_finished()
-        ckpt.save(os.path.abspath(path), state, force=force)
+
+        def _schedule():
+            if chaos.fires("ckpt_write_fail"):
+                raise chaos.ChaosError(
+                    f"injected checkpoint write failure at {path} "
+                    f"(site ckpt_write_fail)")
+            ckpt.save(os.path.abspath(path), state, force=force)
+        policy.call(_schedule)
         return True
     # The sync path must also fence any in-flight async save: an async
     # write committing AFTER a sync write to the same path would
     # silently replace the newer data with the stale save.
     wait_pending()
-    _checkpointer(solo=not distributed).save(
-        os.path.abspath(path), state, force=force)
+
+    def _write():
+        if chaos.fires("ckpt_write_fail"):
+            raise chaos.ChaosError(
+                f"injected checkpoint write failure at {path} "
+                f"(site ckpt_write_fail)")
+        _checkpointer(solo=not distributed).save(
+            os.path.abspath(path), state, force=force)
+    if distributed:
+        # Collective multi-host write: retrying on a rank-LOCAL error
+        # would re-enter Orbax's cross-process barriers on one rank
+        # only — the pod hangs instead of failing fast (see
+        # docstring). Raw error propagates, no retry.
+        _write()
+    else:
+        policy.call(_write)
     return True
 
 
@@ -144,15 +208,31 @@ def restore(path: str, *, like: Optional[Any] = None,
     the reference's resume contract by broadcasting the loaded state
     from rank 0 (meaningful in multi-controller mode where workers may
     read different files or a stale mirror).
+
+    Failure surface (instead of a raw Orbax traceback): a missing
+    directory raises `CheckpointNotFoundError`; a directory that
+    exists but cannot be read back (partial write, corruption,
+    template mismatch) raises `CheckpointCorruptError` with the path
+    named and the underlying error chained. `restore_latest` catches
+    both and falls back to the previous step.
     """
+    apath = os.path.abspath(path)
+    if not os.path.isdir(apath):
+        raise CheckpointNotFoundError(
+            f"no checkpoint directory at {apath}")
     restore_args = None
     if like is not None:
         import orbax.checkpoint as ocp
         restore_args = ocp.checkpoint_utils.construct_restore_args(like)
     # solo: every process reads the full tree independently (read-only;
     # no cross-process barriers), then `broadcast` re-synchronizes.
-    restored = _checkpointer(solo=True).restore(
-        os.path.abspath(path), item=like, restore_args=restore_args)
+    try:
+        restored = _checkpointer(solo=True).restore(
+            apath, item=like, restore_args=restore_args)
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint at {apath} is unreadable — partial write, "
+            f"corruption, or a template mismatch ({e!r})") from e
     if broadcast:
         import horovod_tpu as hvd
         restored = hvd.broadcast_global_variables(restored, 0)
@@ -183,16 +263,36 @@ def latest_step(directory: str) -> Optional[int]:
 
 
 def save_step(directory: str, step: int, state: Any, *,
-              keep: int = 3, block: bool = True) -> bool:
+              keep: int = 3, block: bool = True,
+              retry: Optional[RetryPolicy] = None) -> bool:
     """`save()` into `directory/step_{step:08d}`, then prune the lowest
     steps down to `keep` entries — never the one just written (rank 0
     only). ``block=False`` saves asynchronously; Orbax commits the
     directory atomically, so pruning only ever sees finished steps —
     which also means the in-flight save isn't counted yet and the
     directory can transiently hold `keep + 1` entries until the next
-    call (or `wait_pending()` + another `save_step`) prunes it."""
+    call (or `wait_pending()` + another `save_step`) prunes it.
+
+    The sync path is atomic end-to-end: the tree is written into a
+    hidden ``.tmp.step_*`` staging directory (invisible to step
+    discovery) and renamed into place only after the write fully
+    committed — a process killed mid-save leaves either the previous
+    checkpoint set or the complete new one, never a discoverable
+    half-written step. (The async path relies on Orbax's own atomic
+    directory commit.)"""
     current = f"step_{step:08d}"
-    wrote = save(os.path.join(directory, current), state, block=block)
+    final = os.path.join(directory, current)
+    if block:
+        import shutil
+        tmp = os.path.join(directory, f".tmp.{current}")
+        shutil.rmtree(tmp, ignore_errors=True)  # stale staging dir
+        wrote = save(tmp, state, block=True, retry=retry)
+        if wrote:
+            if os.path.isdir(final):
+                shutil.rmtree(final, ignore_errors=True)
+            os.replace(tmp, final)
+    else:
+        wrote = save(final, state, block=False, retry=retry)
     if wrote and keep > 0:
         import shutil
         entries = _step_entries(directory)
@@ -205,10 +305,56 @@ def save_step(directory: str, step: int, state: Any, *,
 
 
 def restore_latest(directory: str, *, like: Optional[Any] = None,
-                   broadcast: bool = False) -> Optional[Any]:
-    """Restore the highest step under `directory`, or None if empty."""
+                   broadcast: bool = False,
+                   with_step: bool = False
+                   ) -> Union[None, Any, Tuple[Any, int]]:
+    """Restore the highest GOOD step under `directory`, or None if
+    empty.
+
+    Latest-good discovery: when the newest step directory is a partial
+    write or corrupt (`CheckpointCorruptError` — e.g. the process was
+    preempted mid-save without the atomic rename, or the filesystem
+    ate blocks), it is skipped with a warning and the previous step is
+    tried, newest to oldest. Only when *every* step fails does the
+    last `CheckpointCorruptError` propagate — silent loss of the whole
+    directory would hide real damage.
+
+    ``with_step=True`` returns ``(state, step)`` so resume logic knows
+    which step actually loaded (it may not be the highest on disk).
+    """
     entries = _step_entries(directory)
     if not entries:
         return None
-    return restore(os.path.join(directory, entries[-1][1]),
-                   like=like, broadcast=broadcast)
+    last_err: Optional[CheckpointError] = None
+    restored = None
+    found_step = None
+    for step, name in reversed(entries):
+        try:
+            # broadcast deliberately NOT passed through: the per-step
+            # read must stay collective-free, because ranks can
+            # disagree on WHICH step is corrupt (rank-local FS damage,
+            # a stale mirror) — a collective inside this loop would
+            # pair mismatched broadcasts across ranks and hang the
+            # pod. Every rank broadcasts exactly once below instead.
+            restored = restore(os.path.join(directory, name),
+                               like=like, broadcast=False)
+            found_step = step
+            break
+        except CheckpointError as e:
+            sys.stderr.write(
+                f"horovod_tpu: skipping bad checkpoint "
+                f"{os.path.join(directory, name)} ({e}); falling back "
+                f"to the previous step\n")
+            last_err = e
+    if found_step is None:
+        raise CheckpointCorruptError(
+            f"no restorable checkpoint among {len(entries)} step(s) "
+            f"in {directory}; newest failure chained") from last_err
+    if broadcast:
+        # Rank-0's tree wins even if this rank fell back to an older
+        # step than rank 0 did (the returned step is then the LOCAL
+        # discovery; the state is rank 0's — the reference's resume
+        # contract).
+        import horovod_tpu as hvd
+        restored = hvd.broadcast_global_variables(restored, 0)
+    return (restored, found_step) if with_step else restored

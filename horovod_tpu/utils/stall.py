@@ -21,6 +21,14 @@ import time
 class StallMonitor:
     def __init__(self, warning_time_s: float = 60.0,
                  check_every_s: float = 10.0, native=None):
+        # State the (idempotent) stop() touches is defined FIRST: a
+        # partially-constructed monitor whose stop() is called from a
+        # finally block must not AttributeError (the stop-before-start
+        # race).
+        self._thread = None
+        self._stop = threading.Event()
+        self._stopped = False
+        self._lock = threading.Lock()
         # Delegate to the C++ detector (control_plane.cc) when loaded;
         # it runs its own sweep thread.
         self._native = None
@@ -33,10 +41,8 @@ class StallMonitor:
                 self._native = None
         self._warning_time = warning_time_s
         self._check_every = check_every_s
-        self._lock = threading.Lock()
         self._pending = {}   # name -> start timestamp
         self._warned = set()
-        self._stop = threading.Event()
         if self._native is None:
             self._thread = threading.Thread(
                 target=self._loop, name="hvd-stall-monitor", daemon=True)
@@ -91,8 +97,21 @@ class StallMonitor:
         while not self._stop.wait(self._check_every):
             self.check_once()
 
-    def stop(self):
+    def stop(self, timeout: float = 5.0):
+        """Stop the sweep and JOIN its thread so no warning can land
+        after stop() returns (engines stop their monitor at shutdown
+        and then tear down the state the sweep reads). Idempotent:
+        double-stop and stop-before-start are both no-op-safe — the
+        flag is claimed under the lock, so concurrent stops perform
+        the native stop / join exactly once."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
         if self._native is not None:
             self._native.stall_stop_thread()
             return
         self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout)
