@@ -79,11 +79,19 @@ def log(msg):
 
 _EMIT_LOCK = threading.Lock()
 
+# Set when the TPU backend was unreachable and the bench fell back to
+# CPU (HVD_BENCH_PROBE_BUDGET_S / --no-cpu-fallback): every emitted
+# line carries the tag so a CPU number can never masquerade as a TPU
+# one.
+_BACKEND_FALLBACK = None
+
 
 def emit(result):
     # Serialized against the watchdog's re-emit so the driver-parsed
     # final line can never be interleaved/corrupted JSON.
     with _EMIT_LOCK:
+        if _BACKEND_FALLBACK and isinstance(result, dict):
+            result.setdefault("backend_fallback", _BACKEND_FALLBACK)
         print(json.dumps(result), flush=True)
 
 
@@ -95,6 +103,8 @@ _BEST_RESULT = {}
 
 def _set_best(result):
     with _EMIT_LOCK:
+        if _BACKEND_FALLBACK and isinstance(result, dict):
+            result.setdefault("backend_fallback", _BACKEND_FALLBACK)
         _BEST_RESULT.clear()
         _BEST_RESULT.update(result)
 
@@ -134,6 +144,20 @@ def start_deadline_watchdog(metric, unit, deadline_s):
     t.daemon = True
     t.start()
     return t
+
+
+def write_out(args):
+    """--out: persist the current best (final) result JSON to a file
+    — every mode's final emit calls this, so the artifact exists
+    whether the bench measured serving, decode, training, or CNNs."""
+    if not getattr(args, "out", None):
+        return
+    with _EMIT_LOCK:
+        data = dict(_BEST_RESULT)
+    with open(args.out, "w") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+    log(f"result written to {args.out}")
 
 
 def fail(metric, unit, kind, detail, rc=1):
@@ -481,14 +505,118 @@ def _build_decode_lm(args):
     return model, params
 
 
+def _tpot_histogram(results):
+    """Inter-token latency distribution over one rate point's
+    completed requests: percentiles + an 8-bin histogram (ms) — the
+    before/after evidence artifact for the hot-path pipelining PR."""
+    import numpy as np
+    xs = np.asarray([r.tpot_s for r in results
+                     if r.tpot_s is not None]) * 1e3
+    if xs.size == 0:
+        return None
+    counts, edges = np.histogram(xs, bins=8)
+    out = {f"p{q}": round(float(np.percentile(xs, q)), 3)
+           for q in (10, 25, 50, 75, 90, 95, 99)}
+    out.update({"mean": round(float(xs.mean()), 3), "n": int(xs.size),
+                "hist_edges_ms": [round(float(e), 3) for e in edges],
+                "hist_counts": [int(c) for c in counts]})
+    return out
+
+
+def _serve_rate(model, params, args, prompts, rate, *,
+                pipeline_depth, prefill_chunk_budget, chaos_mode,
+                log):
+    """One open-loop Poisson rate point through a fresh (pre-warmed)
+    engine; returns the per-rate record. ``pipeline_depth`` /
+    ``prefill_chunk_budget`` parameterize the hot path so the same
+    harness measures the PR-3 pipeline and its PR-1-shaped control."""
+    import numpy as np
+
+    from horovod_tpu.serving import ServingEngine
+
+    S, steps, n_req = (args.serving_slots, args.decode_steps,
+                       args.serving_requests)
+    if chaos_mode:
+        from horovod_tpu.resilience import chaos as chaos_mod
+    gaps = np.random.RandomState(7).exponential(1.0 / rate, size=n_req)
+    eng = ServingEngine(model, params, num_slots=S,
+                        max_queue=2 * n_req, warmup=True,
+                        pipeline_depth=pipeline_depth,
+                        prefill_chunk_budget=prefill_chunk_budget,
+                        auto_restart=chaos_mode, max_restarts=8)
+    t0 = time.time()
+    handles = []
+    for i, p in enumerate(prompts):
+        handles.append(eng.submit(p, steps))
+        if chaos_mode and i == n_req // 3:
+            # Mid-load crash: deterministic site, armed once the
+            # engine is demonstrably busy.
+            chaos_mod.arm("serving_dispatch_crash", 1)
+        if i < n_req - 1:
+            time.sleep(float(gaps[i]))
+    results = [h.result() for h in handles]
+    eng.shutdown()
+    if chaos_mode:
+        chaos_mod.install(None)
+    dt = time.time() - t0
+    snap = eng.metrics_snapshot()
+    tok_s = sum(len(r.tokens) for r in results) / dt
+    rec = {
+        "tok_s": round(tok_s, 2),
+        "ttft_ms_p50": snap["ttft_ms"]["p50"],
+        "ttft_ms_p95": snap["ttft_ms"]["p95"],
+        "tpot_ms_p50": snap["tpot_ms"]["p50"],
+        "tpot_ms_p95": snap["tpot_ms"]["p95"],
+        "tpot_hist_ms": _tpot_histogram(results),
+        "queue_wait_ms_p95": snap["queue_wait_ms"]["p95"],
+        "completed": snap["completed"],
+        # Hot-path serialization evidence (the tentpole's metric):
+        # exposed host syncs per generated token, and how many tick
+        # reads hid behind the next tick's device compute.
+        "host_syncs": snap["host_syncs"],
+        "host_syncs_per_token": snap["host_syncs_per_token"],
+        "ticks": snap["ticks"],
+        "ticks_overlapped": snap["ticks_overlapped"],
+        "compiles": snap["compiles"],
+        "pipeline_depth": pipeline_depth,
+        "prefill_chunk_budget": prefill_chunk_budget,
+    }
+    if chaos_mode:
+        # The robustness cost on the perf trajectory: how long a
+        # crash-to-requeued recovery takes under this load.
+        rec.update({
+            "restarts": snap["restarts"],
+            "requeued": snap["requeued"],
+            "faults_injected": snap["faults_injected"],
+            "recovery_ms_p50": snap["recovery_ms"]["p50"],
+            "recovery_ms_p95": snap["recovery_ms"]["p95"],
+        })
+        log(f"serving rate={rate}/s chaos: "
+            f"{snap['restarts']} restart(s), "
+            f"{snap['requeued']} requeued, recovery p95 = "
+            f"{snap['recovery_ms']['p95']} ms")
+    log(f"serving rate={rate}/s depth={pipeline_depth} "
+        f"budget={prefill_chunk_budget}: {tok_s:.1f} tok/s, "
+        f"ttft p50/p95 = {snap['ttft_ms']['p50']}/"
+        f"{snap['ttft_ms']['p95']} ms, tpot p50/p95 = "
+        f"{snap['tpot_ms']['p50']}/{snap['tpot_ms']['p95']} ms, "
+        f"host-syncs/token = {snap['host_syncs_per_token']}")
+    return rec
+
+
 def run_serving(args, devices, n_chips, log):
     """Serving-engine throughput/latency under open-loop load: Poisson
     arrivals against `horovod_tpu.serving.ServingEngine` at each
-    --arrival-rates point, reporting tokens/s plus TTFT/TPOT p50/p95 —
-    the continuous-batching counterpart of the closed-loop `--decode`
+    --arrival-rates point, reporting tokens/s plus TTFT/TPOT p50/p95,
+    the inter-token (TPOT) histogram, and host-syncs-per-token — the
+    continuous-batching counterpart of the closed-loop `--decode`
     number (which measures the decode kernel with the batch always
     full; this measures how close admission + scheduling get to that
-    ceiling when requests arrive asynchronously)."""
+    ceiling when requests arrive asynchronously). Unless --no-serving-
+    ab, the highest rate is additionally measured in the PR-1-shaped
+    control configuration (pipeline_depth=0, no prefill interleaving)
+    so the pipelining win is an in-artifact A/B, not a cross-run
+    diff."""
     import jax
     import numpy as np
 
@@ -504,7 +632,7 @@ def run_serving(args, devices, n_chips, log):
     # P + steps - 1 <= max_len, so max_prompt may never exceed
     # seq - steps + 1 (a floor here would reintroduce mid-run submit
     # ValueErrors after a passing warmup).
-    max_prompt = min(64, args.seq - steps + 1)
+    max_prompt = min(args.serving_max_prompt, args.seq - steps + 1)
     if max_prompt < 5:
         raise ValueError(
             f"--seq {args.seq} leaves no prompt room at "
@@ -518,85 +646,60 @@ def run_serving(args, devices, n_chips, log):
     prompts = [rs.randint(0, 32768, (int(rs.randint(4, max_prompt)),))
                for _ in range(n_req)]
 
-    # Warmup engine: pays every compile outside the timed windows —
-    # the vmapped tick once, plus one prefill per power-of-two chunk
-    # size any sampled prompt length can decompose into (otherwise the
-    # first rate point's TTFT tail measures XLA, not the scheduler).
+    # Program warmup: the first engine construction precompiles the
+    # tick + pinned prefill-chunk set (ServingEngine(warmup=True));
+    # the jit cache is process-global, so every later per-rate engine
+    # warms in milliseconds and no timed window ever contains an XLA
+    # compile (each rate point's `compiles` field pins that at 0).
     t0 = time.time()
-    with ServingEngine(model, params, num_slots=S,
-                       max_queue=2 * n_req) as eng:
-        warm = [eng.submit(np.zeros((1 << j,), np.int32),
-                           min(4, steps))
-                for j in range((max_prompt - 1).bit_length())]
-        for h in warm:
-            h.result()
+    ServingEngine(model, params, num_slots=S, warmup=True).shutdown()
     log(f"serving warmup (compiles) in {time.time() - t0:.1f}s")
 
     chaos_mode = getattr(args, "chaos", False)
     if chaos_mode:
-        from horovod_tpu.resilience import chaos as chaos_mod
         log("serving chaos mode: one dispatch-thread crash injected "
             "per rate point; recovery latency (time-to-requeue) "
             "recorded")
 
+    depth = args.serving_pipeline_depth
+    budget = args.prefill_chunk_budget
     per_rate = {}
     best_tok_s = 0.0
     for rate in rates:
-        gaps = np.random.RandomState(7).exponential(1.0 / rate,
-                                                    size=n_req)
-        eng = ServingEngine(model, params, num_slots=S,
-                            max_queue=2 * n_req,
-                            auto_restart=chaos_mode, max_restarts=8)
-        t0 = time.time()
-        handles = []
-        for i, p in enumerate(prompts):
-            handles.append(eng.submit(p, steps))
-            if chaos_mode and i == n_req // 3:
-                # Mid-load crash: deterministic site, armed once the
-                # engine is demonstrably busy.
-                chaos_mod.arm("serving_dispatch_crash", 1)
-            if i < n_req - 1:
-                time.sleep(float(gaps[i]))
-        results = [h.result() for h in handles]
-        eng.shutdown()
-        if chaos_mode:
-            chaos_mod.install(None)
-        dt = time.time() - t0
-        snap = eng.metrics_snapshot()
-        out_tokens = sum(len(r.tokens) for r in results)
-        tok_s = out_tokens / dt
-        best_tok_s = max(best_tok_s, tok_s)
-        per_rate[str(rate)] = {
-            "tok_s": round(tok_s, 2),
-            "ttft_ms_p50": snap["ttft_ms"]["p50"],
-            "ttft_ms_p95": snap["ttft_ms"]["p95"],
-            "tpot_ms_p50": snap["tpot_ms"]["p50"],
-            "tpot_ms_p95": snap["tpot_ms"]["p95"],
-            "queue_wait_ms_p95": snap["queue_wait_ms"]["p95"],
-            "completed": snap["completed"],
+        rec = _serve_rate(model, params, args, prompts, rate,
+                          pipeline_depth=depth,
+                          prefill_chunk_budget=budget,
+                          chaos_mode=chaos_mode, log=log)
+        best_tok_s = max(best_tok_s, rec["tok_s"])
+        per_rate[str(rate)] = rec
+    out = {"tok_s_chip": best_tok_s, "n_params": n_params,
+           "num_slots": S, "max_new_tokens": steps,
+           "requests_per_rate": n_req, "chaos": chaos_mode,
+           "pipeline_depth": depth, "prefill_chunk_budget": budget,
+           "rates": per_rate}
+    if args.serving_ab and not chaos_mode:
+        # In-artifact A/B at the highest rate: the PR-1-shaped hot
+        # path (synchronous ticks, whole-prompt prefill) vs the PR-3
+        # pipeline — TPOT p50 and host-syncs-per-token side by side.
+        rate = max(rates)
+        out["pipeline_ab"] = {
+            "rate": rate,
+            "pre_pipelining": _serve_rate(
+                model, params, args, prompts, rate,
+                pipeline_depth=0, prefill_chunk_budget=0,
+                chaos_mode=False, log=log),
+            "pipelined": _serve_rate(
+                model, params, args, prompts, rate,
+                pipeline_depth=depth, prefill_chunk_budget=budget,
+                chaos_mode=False, log=log),
         }
-        if chaos_mode:
-            # The robustness cost on the perf trajectory: how long a
-            # crash-to-requeued recovery takes under this load.
-            per_rate[str(rate)].update({
-                "restarts": snap["restarts"],
-                "requeued": snap["requeued"],
-                "faults_injected": snap["faults_injected"],
-                "recovery_ms_p50": snap["recovery_ms"]["p50"],
-                "recovery_ms_p95": snap["recovery_ms"]["p95"],
-            })
-            log(f"serving rate={rate}/s chaos: "
-                f"{snap['restarts']} restart(s), "
-                f"{snap['requeued']} requeued, recovery p95 = "
-                f"{snap['recovery_ms']['p95']} ms")
-        log(f"serving rate={rate}/s: {tok_s:.1f} tok/s, "
-            f"ttft p50/p95 = {snap['ttft_ms']['p50']}/"
-            f"{snap['ttft_ms']['p95']} ms, tpot p50/p95 = "
-            f"{snap['tpot_ms']['p50']}/{snap['tpot_ms']['p95']} ms")
-    return {"tok_s_chip": best_tok_s, "n_params": n_params,
-            "num_slots": S, "max_new_tokens": steps,
-            "requests_per_rate": n_req, "chaos": chaos_mode,
-            "rates": per_rate}
+        a = out["pipeline_ab"]["pre_pipelining"]
+        b = out["pipeline_ab"]["pipelined"]
+        log(f"pipeline A/B at rate={rate}/s: tpot p50 "
+            f"{a['tpot_ms_p50']} -> {b['tpot_ms_p50']} ms, "
+            f"host-syncs/token {a['host_syncs_per_token']} -> "
+            f"{b['host_syncs_per_token']}")
+    return out
 
 
 def run_bert(args, devices, n_chips, log):
@@ -768,7 +871,17 @@ def main():
                          "(the driver default — a window opening 30 "
                          "min in is still caught); 0 = fixed "
                          "--init-attempts (fast-fail for callers with "
-                         "their own probe loop, e.g. bench_daemon)")
+                         "their own probe loop, e.g. bench_daemon). "
+                         "HVD_BENCH_PROBE_BUDGET_S caps either mode "
+                         "(BENCH_r05 burned 26 min re-probing a dead "
+                         "tunnel)")
+    ap.add_argument("--no-cpu-fallback", dest="cpu_fallback",
+                    action="store_false", default=True,
+                    help="fail with backend_unavailable instead of "
+                         "falling back to CPU benches when the probe "
+                         "budget expires (default: fall back, so "
+                         "every bench run emits real numbers, tagged "
+                         "backend_fallback)")
     ap.add_argument("--retries", type=int, default=4,
                     help="re-attempts after a transient tunnel/backend "
                          "error (remote_compile drops mid-run)")
@@ -816,6 +929,29 @@ def main():
                     help="serving: decode-slot pool width S")
     ap.add_argument("--serving-requests", type=int, default=24,
                     help="serving: requests submitted per rate point")
+    ap.add_argument("--serving-max-prompt", type=int, default=64,
+                    help="serving: prompt lengths sample [4, this) "
+                         "(clamped to seq - decode_steps + 1); raise "
+                         "it to make long-prompt admission churn — "
+                         "what interleaved chunked prefill exists "
+                         "for — visible in the TPOT histogram")
+    ap.add_argument("--serving-pipeline-depth", type=int, default=1,
+                    choices=[0, 1],
+                    help="serving: decode-tick pipeline depth (1 = "
+                         "one-deep async in-flight ring, 0 = sync "
+                         "every tick — the PR-1-shaped control)")
+    ap.add_argument("--prefill-chunk-budget", type=int, default=128,
+                    help="serving: max prompt tokens streamed per "
+                         "scheduler step (interleaved chunked "
+                         "prefill; 0 = whole prompt at once). Env "
+                         "parity: HVD_PREFILL_CHUNK_BUDGET")
+    ap.add_argument("--no-serving-ab", dest="serving_ab",
+                    action="store_false", default=True,
+                    help="serving: skip the in-artifact pipelined-vs-"
+                         "control A/B at the highest rate")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="also write the final result JSON to PATH "
+                         "(e.g. BENCH_serving_pr3.json)")
     ap.add_argument("--arrival-rates", default="2,6,12",
                     metavar="R0,R1,...",
                     help="serving: open-loop arrival rates (req/s)")
@@ -929,6 +1065,14 @@ def main():
                 and args.deadline > 0):
             budget = (args.probe_budget if args.probe_budget > 0
                       else max(300.0, args.deadline - 480.0))
+        # HVD_BENCH_PROBE_BUDGET_S caps the probe loop in EVERY mode
+        # (BENCH_r05 burned 26 min retrying "probe hung > 90s"): with
+        # the CPU fallback below, a dead tunnel costs at most this
+        # long before real (CPU) numbers start.
+        env_cap = os.environ.get("HVD_BENCH_PROBE_BUDGET_S", "")
+        if env_cap and args.platform != "cpu":
+            cap = float(env_cap)
+            budget = cap if budget is None else min(budget, cap)
 
         def _probe_heartbeat(last_err, elapsed):
             emit({"metric": metric, "value": 0.0, "unit": unit,
@@ -942,9 +1086,23 @@ def main():
             platform=args.platform, budget_s=budget,
             heartbeat=_probe_heartbeat if budget else None)
         if not ok:
-            fail(metric, unit, "backend_unavailable",
-                 f"{err} (after {probes} probes over "
-                 f"{waited / 60:.1f}min)")
+            if args.cpu_fallback and args.platform != "cpu":
+                # Degrade to real numbers instead of a zero: the same
+                # benches run on the CPU backend, every emitted line
+                # tagged `backend_fallback` so the artifact cannot be
+                # mistaken for a TPU measurement.
+                global _BACKEND_FALLBACK
+                _BACKEND_FALLBACK = (
+                    f"cpu ({err} after {probes} probes over "
+                    f"{waited / 60:.1f}min)")
+                log(f"backend unreachable ({err}); falling back to "
+                    f"the CPU backend so this run still emits real "
+                    f"numbers")
+                _force_platform("cpu")
+            else:
+                fail(metric, unit, "backend_unavailable",
+                     f"{err} (after {probes} probes over "
+                     f"{waited / 60:.1f}min)")
         devices, err = acquire_devices(args.init_timeout)
         if err is not None:
             fail(metric, unit, "backend_unavailable",
@@ -1247,10 +1405,11 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "overlap_measured": _measured_overlap(args),
         })
         emit(_BEST_RESULT)
+        write_out(args)
         return
     if is_lm and args.serving:
         r = run_serving(args, devices, n_chips, log)
-        _set_best({
+        result = {
             "metric": metric,
             "value": round(r["tok_s_chip"], 1),
             "unit": unit,
@@ -1263,10 +1422,16 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "requests_per_rate": r["requests_per_rate"],
             "seq": args.seq,
             "params_m": round(r["n_params"] / 1e6, 1),
+            "pipeline_depth": r["pipeline_depth"],
+            "prefill_chunk_budget": r["prefill_chunk_budget"],
             "rates": r["rates"],
             "arch": args.arch,
-        })
+        }
+        if "pipeline_ab" in r:
+            result["pipeline_ab"] = r["pipeline_ab"]
+        _set_best(result)
         emit(_BEST_RESULT)
+        write_out(args)
         return
     if is_lm and args.decode:
         r = run_decode(args, devices, n_chips, log)
@@ -1296,6 +1461,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "overlap_measured": _measured_overlap(args),
         })
         emit(_BEST_RESULT)
+        write_out(args)
         return
     if is_lm:
         r = run_transformer(args, devices, n_chips, log)
@@ -1320,6 +1486,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "overlap_measured": _measured_overlap(args),
         })
         emit(_BEST_RESULT)
+        write_out(args)
         return
 
     # Reuse the warm start's init (params + opt state) for the full
@@ -1401,6 +1568,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
     _set_best(result)
     if not args.all_models:
         emit(result)
+        write_out(args)
         return
 
     # --all-models (the no-args driver default): one tunnel window
@@ -1444,6 +1612,8 @@ def _bench_body(args, devices, n_chips, metric, unit,
             r = None  # free this model's state before the next init
     result["models"] = extras
     emit(result)
+    _set_best(result)
+    write_out(args)
 
 
 if __name__ == "__main__":

@@ -89,9 +89,13 @@ def run_config(cfg):
     # The daemon owns the probe loop, so bench.py itself fast-fails:
     # --probe-budget 0 keeps the fixed two-attempt wait (a mid-suite
     # tunnel drop must surface as backend_unavailable quickly, not
-    # burn the window re-probing inside every config).
+    # burn the window re-probing inside every config), and
+    # --no-cpu-fallback keeps a TPU-window config from silently
+    # recording a CPU number — the daemon re-queues it for the next
+    # window instead.
     cmd = [sys.executable, os.path.join(REPO, "bench.py"),
-           "--init-attempts", "2", "--probe-budget", "0"]
+           "--init-attempts", "2", "--probe-budget", "0",
+           "--no-cpu-fallback"]
     if "--deadline" not in args:
         # bench.py's silent-hang watchdog must fire BEFORE our own
         # subprocess kill or it can never salvage a final line; leave

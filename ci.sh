@@ -27,8 +27,14 @@ JAX_PLATFORMS=cpu python -m pytest -q --collect-only \
 
 # Serving-engine smoke: 4 concurrent requests through the continuous-
 # batching engine on CPU; asserts completion AND token-exactness vs
-# sequential generate (the engine's oracle contract).
-JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4
+# sequential generate (the engine's oracle contract), PLUS the PR-3
+# hot-path guarantees: --warmup pins that program warmup happened (no
+# XLA compile inside the timed serving window, compiles == 0) and
+# --interleave-check pins that TPOT under a concurrent long-prompt
+# admission stays within 2x the idle-pool TPOT (interleaved chunked
+# prefill; bound loose enough for CPU CI).
+JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4 \
+    --warmup --interleave-check
 
 # Chaos smoke (docs/resilience.md): one injected checkpoint-write
 # failure mid-run — the shared RetryPolicy must retry with backoff and

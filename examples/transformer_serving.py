@@ -9,9 +9,19 @@ engine reports TTFT/TPOT/tokens-per-second at the end.
 Doubles as the CI smoke (ci.sh): submits --requests concurrent
 mixed-length prompts on CPU, asserts every one completes AND matches
 sequential `generate` token for token, then prints the metrics
-snapshot.
+snapshot. Two extra CI legs exercise the PR-3 hot-path guarantees:
 
-Run:  python examples/transformer_serving.py --requests 4
+* ``--warmup`` builds the engine with program warmup and asserts NO
+  XLA compile happened inside the serving window
+  (``metrics_snapshot()["compiles"] == 0``);
+* ``--interleave-check`` measures an idle-pool TPOT reference, then
+  decodes a victim request while several long prompts are admitted
+  concurrently, and asserts the victim's TPOT stays within 2x the
+  idle reference — the interleaved-chunked-prefill guarantee (a long
+  prompt no longer freezes every active slot's TPOT).
+
+Run:  python examples/transformer_serving.py --requests 4 \
+          [--warmup] [--interleave-check]
 """
 
 import argparse
@@ -31,11 +41,70 @@ from horovod_tpu.parallel.tensor import unbox
 from horovod_tpu.serving import ServingEngine
 
 
+def interleave_check(model, params, budget, factor=2.0, repeats=3):
+    """Pin the chunked-prefill interleaving guarantee: TPOT under a
+    concurrent long-prompt admission stays within ``factor`` x the
+    idle-pool TPOT. Both sides take the best of ``repeats`` so a noisy
+    shared CI box measures the scheduler, not its neighbors (min is
+    the standard contention denoiser — interference only ever inflates
+    a timing)."""
+    def idle_once(eng, i):
+        # The SAME request shape the loaded phase measures (3-token
+        # prompt, 48 decode steps), alone in the pool: per-tick cost
+        # grows with the lane's own fill depth, so a shallower
+        # reference would undercount the idle baseline.
+        return eng.submit(np.array([3 + i, 7, 11]), 48).result(
+            timeout=600).tpot_s
+
+    def victim_once(eng):
+        # The victim holds one slot for many ticks; each long prompt
+        # prefills into the other slot in budget-bounded chunks
+        # INTERLEAVED with the victim's ticks.
+        short = eng.submit(np.array([5, 9]), 4)  # frees a slot early
+        victim = eng.submit(np.array([2, 4, 6]), 48)
+        short.result(timeout=600)
+        longs = [eng.submit(np.arange(1, 49) % 128, 4)]
+        v = victim.result(timeout=600)
+        for h in longs:
+            h.result(timeout=600)
+        return v.tpot_s
+
+    with ServingEngine(model, params, num_slots=2, warmup=True,
+                       prefill_chunk_budget=budget) as eng:
+        idle = min(idle_once(eng, i) for i in range(repeats + 1))
+    victims = []
+    chunks = 0
+    for _ in range(repeats):
+        with ServingEngine(model, params, num_slots=2, warmup=True,
+                           prefill_chunk_budget=budget) as eng:
+            victims.append(victim_once(eng))
+            chunks = eng.metrics_snapshot()["prefill_chunks"]
+    assert chunks > 2, ("long prompts were not chunked", chunks)
+    best = min(victims)
+    ratio = best / idle
+    print(f"interleave check: idle tpot {idle * 1e3:.2f} ms, victim "
+          f"tpot under long-prompt admission {best * 1e3:.2f} ms "
+          f"({ratio:.2f}x, bound {factor}x, {chunks} prefill chunks "
+          f"streamed per run)")
+    assert ratio <= factor, (
+        f"victim TPOT {best * 1e3:.2f} ms exceeded {factor}x the "
+        f"idle-pool TPOT {idle * 1e3:.2f} ms — interleaving broken?")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=4)
     ap.add_argument("--slots", type=int, default=2)
     ap.add_argument("--max-new-tokens", type=int, default=8)
+    ap.add_argument("--warmup", action="store_true",
+                    help="precompile the hot path at engine build and "
+                         "assert zero compiles in the serving window")
+    ap.add_argument("--interleave-check", action="store_true",
+                    help="assert TPOT under concurrent long-prompt "
+                         "admission stays within 2x idle (chunked-"
+                         "prefill interleaving)")
+    ap.add_argument("--prefill-chunk-budget", type=int, default=8,
+                    help="prompt tokens streamed per scheduler step")
     args = ap.parse_args()
 
     model = TransformerLM(vocab_size=128, num_layers=2, num_heads=4,
@@ -48,7 +117,10 @@ def main():
                for _ in range(args.requests)]
 
     with ServingEngine(model, params, num_slots=args.slots,
-                       max_queue=2 * args.requests) as eng:
+                       max_queue=2 * args.requests,
+                       warmup=args.warmup,
+                       prefill_chunk_budget=args.prefill_chunk_budget
+                       ) as eng:
         handles = [eng.submit(p, args.max_new_tokens)
                    for p in prompts]
         results = [h.result(timeout=600) for h in handles]
@@ -61,8 +133,20 @@ def main():
     snap = eng.metrics_snapshot()
     print(json.dumps(snap, indent=1))
     assert snap["completed"] == args.requests
+    if args.warmup:
+        # Program warmup precompiled the tick + prefill buckets at
+        # construction: the timed serving window must be compile-free.
+        assert snap["compiles"] == 0, (
+            f"warmed engine compiled in the hot path "
+            f"({snap['compiles']} first-time shapes)")
+        print(f"warmup OK: {snap['warmup_compiles']} programs "
+              f"precompiled in {snap['warmup_s']}s, 0 hot-path "
+              f"compiles")
     print(f"serving smoke OK: {args.requests} requests, "
-          f"{snap['tokens_out']} tokens, token-exact vs generate")
+          f"{snap['tokens_out']} tokens, token-exact vs generate, "
+          f"host-syncs/token {snap['host_syncs_per_token']}")
+    if args.interleave_check:
+        interleave_check(model, params, args.prefill_chunk_budget)
 
 
 if __name__ == "__main__":

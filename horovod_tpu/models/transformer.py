@@ -1046,14 +1046,27 @@ def slot_prefill_chunk(dec_model, params, cache, slot, chunk):
     return cache, logits.astype(jnp.float32)
 
 
-def prefill_chunks(length: int) -> list:
+def prefill_chunks(length: int, max_chunk: Optional[int] = None) -> list:
     """Binary decomposition of a prompt length into descending
     power-of-two chunk sizes (13 -> [8, 4, 1]) — the compile-bounded
-    schedule `slot_prefill_chunk` is fed with."""
+    schedule `slot_prefill_chunk` is fed with.
+
+    ``max_chunk`` caps every chunk at the largest power of two <=
+    max_chunk (200 at max_chunk=64 -> [64, 64, 64, 8]) — the
+    Sarathi-style knob behind HVD_PREFILL_CHUNK_BUDGET: the scheduler
+    interleaves one bounded chunk with decode ticks instead of
+    streaming a whole long prompt back-to-back. Chunk sizes stay
+    powers of two, so the compiled-program set stays log2-bounded
+    regardless of the cap."""
     if length <= 0:
         raise ValueError(f"prompt length must be positive, got {length}")
-    return [1 << b for b in range(length.bit_length() - 1, -1, -1)
-            if length >> b & 1]
+    out = []
+    if max_chunk is not None and max_chunk >= 1:
+        cap = 1 << (int(max_chunk).bit_length() - 1)   # pow2 floor
+        out = [cap] * (length // cap)
+        length -= cap * (length // cap)
+    return out + [1 << b for b in range(length.bit_length() - 1, -1, -1)
+                  if length >> b & 1]
 
 
 def nucleus_mask(logits, top_p):
@@ -1087,29 +1100,64 @@ def sample_token(logits, temperature, top_p, key):
     return jnp.where(temperature <= 0.0, greedy, sampled)
 
 
+def _freeze_cache_indices(new_cache, old_cache, advance):
+    """Select per-leaf between the advanced and the input fill indices
+    (scalar ``advance`` under the tick's vmap): a lane whose index must
+    not move (FREE or mid-prefill slots riding the shared vmapped tick,
+    finished-but-unretired slots) keeps its old index. The K/V bytes
+    the masked lane wrote at that frozen position are harmless — the
+    causal masks attend positions < index, and the next real writer
+    (prefill chunk or live tick) lands on the same position — so only
+    the cheap scalar index leaves need the select, never the [max_len]
+    cache rows."""
+    from jax.tree_util import tree_flatten_with_path, tree_unflatten
+    flat, treedef = tree_flatten_with_path(new_cache)
+    old_leaves = jax.tree.leaves(old_cache)
+    out = [jnp.where(advance, leaf, old)
+           if "index" in str(path) else leaf
+           for (path, leaf), old in zip(flat, old_leaves)]
+    return tree_unflatten(treedef, out)
+
+
 @functools.partial(jax.jit, static_argnames=("dec_model",),
                    donate_argnums=(2,))
 def slot_decode_tick(dec_model, params, cache, toks, temps, top_ps,
-                     rngs):
+                     rngs, live, done, eos):
     """One continuous-batching decode tick over EVERY slot: vmap of the
     B=1 decode step over the slot axis. Returns ``(cache, next_toks
-    [num_slots], new_rngs)``. Free slots tick too — decoding garbage
-    and CREEPING their fill index, which the pool's prefill-time
-    `slot_reset` erases before the slot is reused — the
-    fixed-rectangle trade `generate` makes for finished rows, here
-    buying ONE compiled program for every occupancy pattern."""
+    [num_slots], new_rngs, done)``. One compiled program serves every
+    occupancy pattern; per-slot occupancy state is traced:
 
-    def one(sub, tok, temp, top_p, rng):
+    * ``live`` [S] bool — host-known active lanes. Non-live lanes
+      (FREE or mid-prefill slots) still ride the vmapped step but
+      their cache fill indices are FROZEN (`_freeze_cache_indices`),
+      so an idle lane never creeps its index — and with it the shared
+      prefix-attention trip count every live slot pays for — and a
+      partially prefilled slot's next chunk lands exactly where the
+      previous one stopped.
+    * ``done`` [S] bool + ``eos`` scalar (pass -1 to disable) — ON-
+      DEVICE stop detection: a lane that has emitted eos keeps
+      emitting eos (never a post-eos garbage token) and stops
+      advancing its cache, all decided on device. The host can
+      therefore retire from the (asynchronously transferred) token
+      buffer alone, pipeline-depth ticks late, without a second
+      device->host sync per tick to check stops.
+    """
+
+    def one(sub, tok, temp, top_p, rng, lv, dn):
         (hidden, embed), mut = dec_model.apply(
             {"params": params, "cache": sub}, tok[None, None],
             return_hidden=True, mutable=["cache"])
+        new = _freeze_cache_indices(mut["cache"], sub, lv & ~dn)
         logits = jnp.einsum("d,vd->v", hidden[0, -1],
                             embed.astype(hidden.dtype))
         rng, r = jax.random.split(rng)
         nxt = sample_token(logits.astype(jnp.float32), temp, top_p, r)
-        return mut["cache"], nxt.astype(tok.dtype), rng
+        nxt = nxt.astype(tok.dtype)
+        emit = jnp.where(dn, eos.astype(tok.dtype), nxt)
+        return new, emit, rng, dn | (emit == eos)
 
-    return jax.vmap(one)(cache, toks, temps, top_ps, rngs)
+    return jax.vmap(one)(cache, toks, temps, top_ps, rngs, live, done)
 
 
 def serving_params(params, dtype=jnp.bfloat16):

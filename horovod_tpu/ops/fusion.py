@@ -13,6 +13,9 @@ Buckets group leaves by dtype (the reference fuses only same-dtype
 responses, `mpi_ops.cc:1397-1404`) and close at
 `HOROVOD_FUSION_THRESHOLD` bytes (default 64 MB; 0 disables fusion =
 one collective per tensor, matching `docs/tensor-fusion.md:18-28`).
+`HVD_FUSION_MB` is the megabyte-denominated alias (fractions accepted;
+the byte-exact reference variable wins when both are set) — see
+`runtime.config.Config.refresh`.
 """
 
 from __future__ import annotations

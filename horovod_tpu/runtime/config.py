@@ -6,7 +6,10 @@ reads `HOROVOD_FUSION_THRESHOLD` (bytes, 0 disables, default 64 MB;
 (`mpi_ops.cc:1272-1275`), plus a 60 s stall-warning threshold
 (`mpi_ops.cc:228`) and 5 ms background tick (`mpi_ops.cc:1292`). The TPU
 build keeps the same variable names so existing Horovod deployment recipes
-carry over, and adds TPU-specific knobs.
+carry over, and adds TPU-specific knobs: `HVD_FUSION_MB` (megabyte alias
+of the fusion threshold), `HVD_PREFILL_CHUNK_BUDGET` (serving: prompt
+tokens streamed per dispatch step — docs/serving.md "Performance
+tuning").
 """
 
 from __future__ import annotations
@@ -17,6 +20,10 @@ import os
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024  # bytes, mpi_ops.cc:165
 DEFAULT_STALL_WARNING_TIME = 60.0            # seconds, mpi_ops.cc:228
 DEFAULT_CYCLE_TIME_MS = 5.0                  # mpi_ops.cc:1292 (latency floor)
+# Serving: max prompt tokens the dispatch loop streams per scheduling
+# step (interleaved chunked prefill, docs/serving.md "Performance
+# tuning"); <= 0 disables interleaving (whole prompt at once).
+DEFAULT_PREFILL_CHUNK_BUDGET = 128
 
 
 def _env_int(name: str, default: int) -> int:
@@ -47,6 +54,7 @@ class Config:
     timeline_path: str = ""
     stall_warning_time: float = DEFAULT_STALL_WARNING_TIME
     cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
+    prefill_chunk_budget: int = DEFAULT_PREFILL_CHUNK_BUDGET
     # TPU-specific additions
     allreduce_dtype: str = ""          # e.g. "bfloat16" to reduce in bf16
     mesh_axis_name: str = "data"       # default 1-D data-parallel axis
@@ -58,8 +66,21 @@ class Config:
     xla_combiner: str = "pin"
 
     def refresh(self) -> "Config":
-        self.fusion_threshold = _env_int(
-            "HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD)
+        # HOROVOD_FUSION_THRESHOLD (exact bytes, the reference's knob)
+        # wins; HVD_FUSION_MB (megabytes, accepts fractions) is the
+        # ergonomic alias — "HVD_FUSION_MB=8" == threshold 8 MiB.
+        if os.environ.get("HOROVOD_FUSION_THRESHOLD", ""):
+            self.fusion_threshold = _env_int(
+                "HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD)
+        elif os.environ.get("HVD_FUSION_MB", ""):
+            self.fusion_threshold = int(
+                _env_float("HVD_FUSION_MB",
+                           DEFAULT_FUSION_THRESHOLD / (1 << 20))
+                * (1 << 20))
+        else:
+            self.fusion_threshold = DEFAULT_FUSION_THRESHOLD
+        self.prefill_chunk_budget = _env_int(
+            "HVD_PREFILL_CHUNK_BUDGET", DEFAULT_PREFILL_CHUNK_BUDGET)
         self.timeline_path = os.environ.get("HOROVOD_TIMELINE", "")
         self.stall_warning_time = _env_float(
             "HOROVOD_STALL_CHECK_TIME", DEFAULT_STALL_WARNING_TIME)

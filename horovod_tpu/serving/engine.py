@@ -141,6 +141,24 @@ class ServingEngine:
         brackets every decode tick so a hang warns naming the serving
         tick (``serving_tick_<n>``). Default: the
         ``HOROVOD_STALL_CHECK_TIME`` config (60 s).
+    warmup : precompile the serving hot path at construction
+        (`SlotPool.warmup`): the vmapped tick, the pinned prefill-
+        chunk bucket set, the first-token sample. The first request of
+        every prompt shape is then a jit-cache hit — no XLA compile in
+        the hot path (``metrics_snapshot()["compiles"]`` stays 0), no
+        first-request TTFT cliff, nothing for the watchdog's
+        `maybe_compiling` exemption to special-case. Off by default
+        (constructor cost; turn on for latency-sensitive serving).
+    prefill_chunk_budget : max prompt tokens streamed per scheduler
+        step (interleaved chunked prefill — a long prompt no longer
+        freezes every in-flight request's TPOT). None reads
+        HVD_PREFILL_CHUNK_BUDGET (default 128); <= 0 = unbounded (the
+        PR-1 whole-prompt-at-once behavior).
+    pipeline_depth : decode-tick pipelining depth — 1 (default) keeps
+        a one-deep in-flight ring (tick N+1 dispatched before tick N's
+        tokens are read, hiding the host sync behind device compute);
+        0 syncs every tick immediately (the A/B control
+        `bench.py --serving` measures against).
     """
 
     def __init__(self, model: TransformerLM, params, *,
@@ -150,7 +168,10 @@ class ServingEngine:
                  mesh=None, auto_restart: bool = False,
                  max_restarts: int = 2,
                  tick_deadline_s: Optional[float] = None,
-                 stall_warning_s: Optional[float] = None):
+                 stall_warning_s: Optional[float] = None,
+                 warmup: bool = False,
+                 prefill_chunk_budget: Optional[int] = None,
+                 pipeline_depth: int = 1):
         if eos_id is not None and not 0 <= eos_id < model.vocab_size:
             raise ValueError(
                 f"eos_id must be in [0, vocab_size={model.vocab_size}"
@@ -162,17 +183,36 @@ class ServingEngine:
         self.auto_restart = auto_restart
         self.max_restarts = max_restarts
         self.tick_deadline_s = tick_deadline_s
+        if prefill_chunk_budget is None:
+            from horovod_tpu.runtime.config import config as _cfg
+            prefill_chunk_budget = _cfg.prefill_chunk_budget
+        self.prefill_chunk_budget = int(prefill_chunk_budget)
+        self.pipeline_depth = max(0, min(1, int(pipeline_depth)))
         if stall_warning_s is None:
             from horovod_tpu.runtime.config import config as _cfg
             stall_warning_s = _cfg.stall_warning_time
         self.stall = StallMonitor(warning_time_s=stall_warning_s,
                                   check_every_s=max(
                                       1.0, stall_warning_s / 4))
-        self.pool = SlotPool(model, params, num_slots, mesh=mesh)
+        self.pool = SlotPool(model, params, num_slots, mesh=mesh,
+                             eos_id=eos_id)
+        # Warmup runs on the constructor thread BEFORE the dispatch
+        # thread exists, so the single-jax-thread contract holds.
+        self.warmup_info = None
+        if warmup:
+            self.warmup_info = self.pool.warmup(
+                max_chunk=(self.prefill_chunk_budget
+                           if self.prefill_chunk_budget > 0 else None))
+            self.metrics.observe_warmup(self.warmup_info["seconds"])
+        # Hot-path compiles = pool compiles past this baseline.
+        self._compile_baseline = self.pool.compiles
+        self.metrics.observe_pipeline(self.pipeline_depth)
         self.queue = AdmissionQueue(max_queue)
         self.scheduler = ContinuousBatchingScheduler(
             self.pool, self.queue, self.metrics, eos_id=eos_id,
-            stall=self.stall)
+            stall=self.stall,
+            prefill_chunk_budget=self.prefill_chunk_budget,
+            pipeline_depth=self.pipeline_depth)
         self._ids = itertools.count()
         self._lock = threading.Lock()
         self._closing = False
@@ -324,10 +364,8 @@ class ServingEngine:
             # futures carry the failure to callers).
             with self._lock:
                 self._closing = True
-            for slot, req in list(scheduler.active.items()):
-                scheduler.active.pop(slot, None)
-                scheduler._resolve(req.future, exc=EngineClosedError(
-                    f"serving dispatch thread died: {e!r}"))
+            scheduler.fail_inflight(lambda req: EngineClosedError(
+                f"serving dispatch thread died: {e!r}"))
             queue.close(drain=False)  # fails queued futures too
             sys.stderr.write("serving dispatch thread died:\n")
             traceback.print_exc(file=sys.stderr)
@@ -413,7 +451,9 @@ class ServingEngine:
         self.pool = self.pool.clone_fresh()
         self.scheduler = ContinuousBatchingScheduler(
             self.pool, self.queue, self.metrics, eos_id=self.eos_id,
-            stall=self.stall)
+            stall=self.stall,
+            prefill_chunk_budget=self.prefill_chunk_budget,
+            pipeline_depth=self.pipeline_depth)
         with self._lock:
             self._heartbeat = time.time()
             self._thread = threading.Thread(
@@ -481,14 +521,13 @@ class ServingEngine:
         self.metrics.count("aborted", len(stragglers))
         # And if the dispatcher died (crash between watchdog stop and
         # here, or healable crash whose restart never happened), its
-        # in-flight futures must not dangle.
-        sched = self.scheduler
-        for slot, req in list(sched.active.items()):
-            sched.active.pop(slot, None)
-            sched._resolve(req.future, exc=EngineClosedError(
+        # in-flight futures — decoding AND mid-prefill — must not
+        # dangle.
+        n = self.scheduler.fail_inflight(
+            lambda req: EngineClosedError(
                 f"engine shut down while request {req.id} was in "
                 f"flight"))
-            self.metrics.count("aborted")
+        self.metrics.count("aborted", n)
 
     def __enter__(self) -> "ServingEngine":
         return self
@@ -499,7 +538,14 @@ class ServingEngine:
     # -- introspection ------------------------------------------------
 
     def metrics_snapshot(self) -> dict:
-        return self.metrics.snapshot()
+        snap = self.metrics.snapshot()
+        # Hot-path first-time-shape compiles (0 on a warmed engine —
+        # the "no compile inside the timed window" guarantee ci.sh
+        # asserts) and what warmup paid up front.
+        snap["compiles"] = self.pool.compiles - self._compile_baseline
+        snap["warmup_compiles"] = ((self.warmup_info or {})
+                                   .get("compiles", 0))
+        return snap
 
     @property
     def num_slots(self) -> int:

@@ -82,7 +82,18 @@ class EngineMetrics:
         self.aborted = 0           # non-drain shutdown took the slot
         self.tokens_out = 0        # generated tokens, completed or not
         self.prefill_tokens = 0
+        self.prefill_chunks = 0    # interleaved prefill chunks streamed
         self.ticks = 0             # decode ticks executed
+        # Hot-path pipelining counters (the tentpole's evidence):
+        # host_syncs counts EXPOSED device->host syncs — reads issued
+        # with no newer device work queued behind them (per-request
+        # first tokens, drain ticks, every tick at pipeline_depth=0);
+        # ticks_overlapped counts tick reads that hid behind the next
+        # tick's compute. host_syncs/tokens_out is the
+        # serialization-per-token number the async ring drives from
+        # ~1 toward ~1/request.
+        self.host_syncs = 0
+        self.ticks_overlapped = 0
         # Self-healing counters (engine watchdog, docs/resilience.md).
         self.restarts = 0          # in-place engine restarts
         self.requeued = 0          # in-flight requests replayed
@@ -91,6 +102,8 @@ class EngineMetrics:
         self.queue_depth = 0
         self.slots_busy = 0
         self.num_slots = 0
+        self.pipeline_depth = 0    # engine config (0 = sync ticks)
+        self.warmup_s = None       # startup precompile cost, if run
         # Latency series (seconds).
         self.queue_wait_s = Series()
         self.ttft_s = Series()
@@ -103,6 +116,14 @@ class EngineMetrics:
     def observe_recovery(self, dt_s: float):
         with self._lock:
             self.recovery_s.add(dt_s)
+
+    def observe_pipeline(self, depth: int):
+        with self._lock:
+            self.pipeline_depth = depth
+
+    def observe_warmup(self, seconds: float):
+        with self._lock:
+            self.warmup_s = seconds
 
     def count(self, name: str, n: int = 1):
         with self._lock:
@@ -140,7 +161,16 @@ class EngineMetrics:
                 "aborted": self.aborted,
                 "tokens_out": self.tokens_out,
                 "prefill_tokens": self.prefill_tokens,
+                "prefill_chunks": self.prefill_chunks,
                 "ticks": self.ticks,
+                "ticks_overlapped": self.ticks_overlapped,
+                "host_syncs": self.host_syncs,
+                "host_syncs_per_token": (
+                    round(self.host_syncs / self.tokens_out, 4)
+                    if self.tokens_out else None),
+                "pipeline_depth": self.pipeline_depth,
+                "warmup_s": (round(self.warmup_s, 3)
+                             if self.warmup_s is not None else None),
                 "restarts": self.restarts,
                 "requeued": self.requeued,
                 "faults_injected": self.faults_injected,

@@ -11,11 +11,31 @@ RETIRED from their slots and queued prompts are PREFILLED into the
 freed slots, so the decode batch stays full under load (Yu et al.,
 OSDI '22 "Orca" — iteration-level scheduling).
 
-Each `step()` runs one tick of that loop on the engine's dispatch
-thread::
+Each `step()` runs one scheduling iteration on the engine's dispatch
+thread, PIPELINED (the PR-3 hot-path rebuild, the Horovod lesson of
+hiding host work behind device work applied to decode)::
 
-    retire finished  ->  admit queued into free slots (prefill)
-                     ->  one vmapped decode tick over all slots
+    sweep dead queued  ->  advance chunked prefills (budgeted)
+                       ->  DISPATCH decode tick N (async)
+                       ->  SYNC tick N-1 (overlaps tick N's compute):
+                             append tokens, retire finished
+
+Two serialization points of the PR-1 loop are gone:
+
+* **Async tick pipelining** — the tick's token readback used to block
+  the dispatch thread every step before it could do anything else; now
+  tick N+1 is dispatched BEFORE tick N's tokens are read, so the
+  transfer and all host bookkeeping hide behind device compute (a
+  one-deep in-flight ring; `SlotPool.tick_dispatch`/`tick_sync`).
+  Retirement therefore lags one tick; the device-side done mask
+  guarantees the lagged tick emits eos, never a post-eos token.
+* **Interleaved chunked prefill** (Sarathi-style) — `prefill()` used
+  to stream a whole prompt back-to-back, freezing every in-flight
+  request's TPOT for the duration; now at most
+  ``prefill_chunk_budget`` prompt tokens are streamed per step
+  (HVD_PREFILL_CHUNK_BUDGET), with mid-prefill slots tracked in
+  `prefilling` and their fill indices frozen through interleaved
+  ticks by the pool's live mask.
 
 Requests also leave slots for non-completion reasons — cancellation,
 deadline expiry, a non-draining shutdown — all resolved here so the
@@ -28,8 +48,8 @@ import itertools
 import threading
 import time
 from concurrent.futures import CancelledError, InvalidStateError
-from dataclasses import dataclass
-from typing import Dict, List, Optional
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -59,6 +79,29 @@ class CompletedRequest:
         return np.concatenate([self.prompt, self.tokens])
 
 
+@dataclass
+class _PrefillJob:
+    """One partially prefilled slot: the request, its remaining chunk
+    schedule, and the last chunk's logits (device array — the first
+    token is sampled from them when the schedule drains)."""
+
+    req: Request
+    chunks: List[int]             # remaining chunk token counts
+    off: int = 0                  # prompt tokens already streamed
+    logits: Any = None
+
+
+@dataclass
+class _PendingTick:
+    """The one-deep pipeline ring: a dispatched-but-unsynced tick and
+    the slot->request map as of its dispatch (tokens are appended only
+    to requests STILL in that slot at sync time — a slot retired or
+    re-assigned in between discards its lagged token)."""
+
+    handle: Any
+    snapshot: Dict[int, Request] = field(default_factory=dict)
+
+
 def _timeline():
     """The process-global Horovod timeline, or None (spans are then
     no-ops) — the same handle `utils.timeline.step_bracket` reads."""
@@ -85,17 +128,36 @@ class ContinuousBatchingScheduler:
     """The policy half of the engine: owns which request sits in which
     slot and why it leaves. Single-threaded by contract (the engine's
     dispatch thread); only the Request futures/cancel flags are shared
-    with submitters."""
+    with submitters.
+
+    ``prefill_chunk_budget``: max prompt tokens streamed per step
+    (<= 0 = unbounded, the PR-1 whole-prompt behavior); also caps the
+    chunk sizes themselves, so a single chunk never exceeds the
+    budget. ``pipeline_depth``: 0 = sync every tick immediately (the
+    PR-1 behavior, the bench A/B control), 1 = the one-deep in-flight
+    ring (default)."""
 
     def __init__(self, pool: SlotPool, queue: AdmissionQueue,
                  metrics: EngineMetrics, *,
-                 eos_id: Optional[int] = None, stall=None):
+                 eos_id: Optional[int] = None, stall=None,
+                 prefill_chunk_budget: Optional[int] = None,
+                 pipeline_depth: int = 1):
         self.pool = pool
         self.queue = queue
         self.metrics = metrics
         self.eos_id = eos_id
         self.stall = stall           # optional utils.stall.StallMonitor
+        if prefill_chunk_budget is None:
+            from horovod_tpu.runtime.config import config as _cfg
+            prefill_chunk_budget = _cfg.prefill_chunk_budget
+        self.prefill_chunk_budget = int(prefill_chunk_budget)
+        self._max_chunk = (self.prefill_chunk_budget
+                           if self.prefill_chunk_budget > 0 else None)
+        self.pipeline_depth = max(0, min(1, int(pipeline_depth)))
         self.active: Dict[int, Request] = {}   # slot -> request
+        self.prefilling: Dict[int, _PrefillJob] = {}
+        self._prefill_order: List[int] = []    # FIFO over prefilling
+        self._pending: Optional[_PendingTick] = None
         # Set (only through `abandon()`) by the engine watchdog when
         # this scheduler's dispatch thread is declared dead/stuck and
         # a replacement takes over: an abandoned scheduler must
@@ -110,15 +172,43 @@ class ContinuousBatchingScheduler:
 
     def abandon(self) -> List[Request]:
         """Watchdog entry: mark this scheduler dead and take ownership
-        of its in-flight requests atomically vs `_admit`."""
+        of its in-flight requests — decoding AND mid-prefill —
+        atomically vs admit/finish registration. The pending tick's
+        tokens are dropped with it: the successor replays every
+        request from its prompt, token-exact."""
         with self._handoff:
             self.abandoned = True
             inflight = list(self.active.values())
+            inflight += [self.prefilling[s].req
+                         for s in self._prefill_order]
             self.active.clear()
+            self.prefilling.clear()
+            self._prefill_order.clear()
+            self._pending = None
         return inflight
 
     def has_active(self) -> bool:
-        return bool(self.active)
+        return bool(self.active or self.prefilling)
+
+    def fail_inflight(self, make_exc) -> int:
+        """Engine containment: resolve EVERY in-flight future —
+        decoding and mid-prefill — with ``make_exc(req)`` and clear
+        the containers (pending tick included). One method so the
+        in-flight-container invariant lives where the containers do:
+        a future container (e.g. a deeper pipeline ring) added here is
+        automatically covered by both engine paths that contain
+        (dispatch-thread death and shutdown's dangling cleanup).
+        Returns how many futures were failed."""
+        with self._handoff:
+            doomed = list(self.active.values()) + [
+                self.prefilling[s].req for s in self._prefill_order]
+            self.active.clear()
+            self.prefilling.clear()
+            self._prefill_order.clear()
+            self._pending = None
+        for req in doomed:
+            self._resolve(req.future, exc=make_exc(req))
+        return len(doomed)
 
     # -- the tick -----------------------------------------------------
 
@@ -134,92 +224,184 @@ class ContinuousBatchingScheduler:
             self.metrics.count("faults_injected")
             self.queue.force_expire(now)
         # Dead queued requests (cancelled / deadline-expired) resolve
-        # NOW, slot or no slot — with every slot busy, _admit below
+        # NOW, slot or no slot — with every slot busy, admission below
         # never pops the queue, and a 100 ms deadline must not wait
         # minutes for a slot to free.
         self.queue.sweep(now, on_drop=self._queue_drop)
-        admitted = self._admit(now)
-        if not self.active:
-            return admitted
-        # The StallMonitor brackets the device tick so a hang warns
-        # with the serving tick named (engine wires the monitor in).
-        tick_name = f"serving_tick_{self._gen}.{self.metrics.ticks}"
-        if self.stall is not None:
-            self.stall.begin(tick_name)
-        try:
-            if chaos.fires("serving_tick_stall"):
-                # Cooperative hung-tick injection INSIDE the stall
-                # bracket: the heartbeat goes stale (watchdog food),
-                # the monitor sees this tick pending. Ends early once
-                # abandoned so the superseded thread can exit.
-                self.metrics.count("faults_injected")
-                t_end = time.time() + chaos.delay_of(
-                    "serving_tick_stall", 1.0)
-                while time.time() < t_end and not self.abandoned:
-                    time.sleep(0.005)
-            toks = self.pool.tick()
-        finally:
-            # end() even when the tick raises — a crashed tick must
-            # not leave a forever-pending entry warning every sweep.
+        progressed = self._advance_prefills(now)
+        handle = snapshot = None
+        if self.active:
+            # The StallMonitor brackets the dispatch (where a
+            # first-time compile would hang) and, separately below,
+            # the sync (where a device hang surfaces) so either warns
+            # with the serving tick named.
+            tick_name = (f"serving_tick_{self._gen}."
+                         f"{self.metrics.ticks}")
             if self.stall is not None:
-                self.stall.end(tick_name)
-        self.metrics.count("ticks")
+                self.stall.begin(tick_name)
+            try:
+                if chaos.fires("serving_tick_stall"):
+                    # Cooperative hung-tick injection INSIDE the stall
+                    # bracket: the heartbeat goes stale (watchdog
+                    # food), the monitor sees this tick pending. Ends
+                    # early once abandoned so the superseded thread
+                    # can exit.
+                    self.metrics.count("faults_injected")
+                    t_end = time.time() + chaos.delay_of(
+                        "serving_tick_stall", 1.0)
+                    while time.time() < t_end and not self.abandoned:
+                        time.sleep(0.005)
+                handle = self.pool.tick_dispatch()
+            finally:
+                if self.stall is not None:
+                    self.stall.end(tick_name)
+            snapshot = dict(self.active)
+            self.metrics.count("ticks")
+            progressed = True
+        # Sync the PREVIOUS tick while this one computes on device —
+        # the pipeline overlap that deletes one exposed host sync per
+        # token from the critical path.
+        if self._pending is not None:
+            self._sync_pending(overlapped=handle is not None)
+            progressed = True
+        if handle is not None:
+            self._pending = _PendingTick(handle, snapshot)
+            if self.pipeline_depth < 1:
+                self._sync_pending(overlapped=False)
+        return progressed
+
+    def _sync_pending(self, overlapped: bool):
+        """Read one dispatched tick's tokens; append to the requests
+        still occupying their dispatch-time slots and retire the
+        finished. ``overlapped`` records whether newer device work was
+        already queued behind the read (the metric the tentpole
+        moves: exposed host syncs per token)."""
+        pending, self._pending = self._pending, None
+        sync_name = f"serving_sync_{self._gen}.{self.metrics.ticks}"
+        if self.stall is not None:
+            self.stall.begin(sync_name)
+        try:
+            toks = self.pool.tick_sync(pending.handle)
+        finally:
+            if self.stall is not None:
+                self.stall.end(sync_name)
+        self.metrics.count("ticks_overlapped" if overlapped
+                           else "host_syncs")
         if self.abandoned:
-            # Superseded mid-tick: the successor owns these requests
-            # now — appending this tick's tokens would corrupt their
-            # replay-from-prompt.
-            return True
+            # Superseded mid-pipeline: the successor owns these
+            # requests now — appending this tick's tokens would
+            # corrupt their replay-from-prompt.
+            return
         t_tick = time.time()
-        for slot, req in list(self.active.items()):
+        for slot, req in pending.snapshot.items():
+            if self.active.get(slot) is not req:
+                continue   # retired (or slot re-assigned) since dispatch
             tok = int(toks[slot])
             req.tokens.append(tok)
             self.metrics.count("tokens_out")
             self._maybe_retire(slot, req, tok, t_tick)
-        return True
 
-    def _admit(self, now: float) -> bool:
-        """Fill free slots from the queue (prefill-into-slot)."""
-        admitted = False
-        while self.pool.has_free() and not self.abandoned:
-            req = self.queue.pop_ready(now, on_drop=self._queue_drop)
-            if req is None:
-                break
-            # Registration is the handoff-critical line: between
-            # pop_ready above and active[slot]=req the request is in
-            # neither the queue nor `active`, so a watchdog abandon
-            # landing in that window would strand its future. The lock
-            # forces an order: either the registration happens before
-            # the snapshot (the successor requeues it) or the abandon
-            # is visible here (we hand it straight back to the queue).
+    # -- admission / chunked prefill ----------------------------------
+
+    def _advance_prefills(self, now: float) -> bool:
+        """Stream up to ``prefill_chunk_budget`` prompt tokens: first
+        continue the oldest mid-prefill slot, then admit new requests
+        from the queue into free slots. A long prompt therefore
+        spreads across many steps, each step still running a full
+        decode tick for everyone else — the interleaving that keeps
+        TPOT flat through a long-prompt admission."""
+        progressed = False
+        left = (self.prefill_chunk_budget
+                if self.prefill_chunk_budget > 0 else None)
+        while not self.abandoned:
+            job = None
             with self._handoff:
-                if self.abandoned:
-                    self.queue.requeue([req])
+                # Picked under the handoff lock: a watchdog abandon
+                # clears these containers, and an unlocked read could
+                # otherwise KeyError racing it.
+                if not self.abandoned and self._prefill_order:
+                    slot = self._prefill_order[0]
+                    job = self.prefilling[slot]
+            if job is None:
+                if not self.pool.has_free():
                     break
-                slot = self.pool.alloc()
-                # Registered BEFORE prefill so a fault inside it
-                # (compile failure, OOM) leaves the request findable
+                req = self.queue.pop_ready(now, on_drop=self._queue_drop)
+                if req is None:
+                    break
+                # Registration is the handoff-critical line: between
+                # pop_ready above and the prefilling registration the
+                # request is in neither the queue nor a scheduler dict,
+                # so a watchdog abandon landing in that window would
+                # strand its future. The lock forces an order: either
+                # the registration happens before the snapshot (the
+                # successor requeues it) or the abandon is visible here
+                # (we hand it straight back to the queue).
+                with self._handoff:
+                    if self.abandoned:
+                        self.queue.requeue([req])
+                        break
+                    slot = self.pool.alloc()
+                    job = _PrefillJob(req=req, chunks=prefill_schedule(
+                        int(req.prompt.shape[0]), self._max_chunk))
+                    self.prefilling[slot] = job
+                    self._prefill_order.append(slot)
+                req.t_prefill = time.time()
+                _span("end_span", req.id, "QUEUE")
+                _span("begin_span", req.id, "PREFILL")
+                # Registered BEFORE any device work so a fault inside
+                # it (compile failure, OOM) leaves the request findable
                 # by the engine's crash containment — never a future
                 # in limbo.
-                self.active[slot] = req
-            req.t_prefill = time.time()
-            _span("end_span", req.id, "QUEUE")
-            _span("begin_span", req.id, "PREFILL")
-            first = self.pool.prefill(
-                slot, req.prompt, req.sampling.temperature,
-                req.sampling.top_p, req.sampling.seed)
-            req.t_first = time.time()
-            req.tokens.append(first)
-            self.metrics.count("prefill_tokens",
-                               int(req.prompt.shape[0]))
-            self.metrics.count("tokens_out")
-            _span("end_span", req.id, "PREFILL")
-            _span("begin_span", req.id, "DECODE")
-            admitted = True
-            # A request can be over the moment prefill ends: first
-            # token is eos, budget of 1, deadline blown mid-prefill,
-            # cancelled while prefilling.
-            self._maybe_retire(slot, req, first, req.t_first)
-        return admitted
+                self.pool.begin_prefill(slot)
+                progressed = True
+            # Drop dead jobs before paying more device work for them.
+            if job.req.cancelled or job.req.expired(now):
+                self._retire_prefill(
+                    slot, job,
+                    "cancelled" if job.req.cancelled else "timeout")
+                progressed = True
+                continue
+            while job.chunks and (left is None
+                                  or job.chunks[0] <= left):
+                c = job.chunks.pop(0)
+                job.logits = self.pool.prefill_chunk(
+                    slot, job.req.prompt[job.off:job.off + c])
+                job.off += c
+                self.metrics.count("prefill_chunks")
+                self.metrics.count("prefill_tokens", c)
+                if left is not None:
+                    left -= c
+                progressed = True
+            if job.chunks:
+                break    # budget spent mid-prompt; resume next step
+            self._finish_prefill(slot, job)
+            progressed = True
+            if left is not None and left <= 0:
+                break
+        return progressed
+
+    def _finish_prefill(self, slot: int, job: _PrefillJob):
+        """Chunk schedule drained: sample the first token (the one
+        per-request host sync), move the slot prefilling -> active
+        (atomically vs a watchdog abandon), handle instant retirement
+        (first token is eos, budget of 1, expired mid-prefill)."""
+        req = job.req
+        first = self.pool.finish_prefill(
+            slot, job.logits, req.sampling.temperature,
+            req.sampling.top_p, req.sampling.seed)
+        self.metrics.count("host_syncs")
+        with self._handoff:
+            if self.abandoned:
+                return   # successor replays it from the prompt
+            self.prefilling.pop(slot, None)
+            self._prefill_order.remove(slot)
+            self.active[slot] = req
+        req.t_first = time.time()
+        req.tokens.append(first)
+        self.metrics.count("tokens_out")
+        _span("end_span", req.id, "PREFILL")
+        _span("begin_span", req.id, "DECODE")
+        self._maybe_retire(slot, req, first, req.t_first)
 
     def _queue_drop(self, req: Request, kind: str):
         """A queued request died before reaching a slot (cancelled or
@@ -265,6 +447,27 @@ class ContinuousBatchingScheduler:
         self.pool.free(slot)
         self.active.pop(slot, None)
         _span("end_span", req.id, "DECODE")
+        self._finalize(req, reason, now)
+
+    def _retire_prefill(self, slot: int, job: _PrefillJob,
+                        reason: str):
+        """A mid-prefill request died (cancelled / expired / aborted):
+        free the slot before its remaining chunks waste device time.
+        The pop happens under the handoff lock and only while NOT
+        abandoned — popping first would open a window where a
+        concurrent watchdog abandon() snapshots `prefilling` without
+        this request, stranding its future in neither the successor's
+        requeue list nor a _finalize here."""
+        with self._handoff:
+            if self.abandoned:
+                return   # successor owns (and will resolve) the req
+            self.prefilling.pop(slot, None)
+            self._prefill_order.remove(slot)
+        self.pool.free(slot)
+        _span("end_span", job.req.id, "PREFILL")
+        self._finalize(job.req, reason, time.time())
+
+    def _finalize(self, req: Request, reason: str, now: float):
         tl = _timeline()
         if tl is not None:
             tl.mark(f"request:{req.id}", reason.upper())
@@ -296,10 +499,24 @@ class ContinuousBatchingScheduler:
             self.metrics.count("aborted")
             self._resolve(req.future, exc=EngineClosedError(
                 f"engine shut down while request {req.id} was "
-                f"decoding ({len(req.tokens)} tokens in)"))
+                f"in flight ({len(req.tokens)} tokens in)"))
 
     def abort_active(self):
-        """Non-draining shutdown: fail every in-flight request now."""
+        """Non-draining shutdown: fail every in-flight request now —
+        decoding and mid-prefill alike — and drop the pending tick."""
         now = time.time()
+        self._pending = None
         for slot, req in list(self.active.items()):
             self._retire(slot, req, "aborted", now)
+        for slot, job in list(self.prefilling.items()):
+            self._retire_prefill(slot, job, "aborted")
+
+
+def prefill_schedule(length: int, max_chunk: Optional[int]) -> List[int]:
+    """The chunk schedule for one prompt — `prefill_chunks` with the
+    scheduler's budget cap applied (kept as a named seam so the
+    restart replay path and tests share the exact decomposition the
+    dispatch loop uses: same prompt + same budget => same chunks =>
+    same cache states => token-exact replay)."""
+    from horovod_tpu.models.transformer import prefill_chunks
+    return prefill_chunks(length, max_chunk)

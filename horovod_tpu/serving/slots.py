@@ -11,29 +11,34 @@ prefill streams a prompt into ONE slot through the `chunked_prefill`
 cache-wide-mask path, and the decode tick vmaps the B=1 decode step
 over the slot axis. This module wraps those primitives with the
 host-side bookkeeping the scheduler needs: a free list, per-slot
-sampling state (temperature / top_p / RNG stream), and reset-on-retire
-hygiene.
+sampling state (temperature / top_p / RNG stream), per-slot live/done
+occupancy flags, and reset-on-retire hygiene.
 
 Slot lifecycle::
 
-    FREE --alloc()--> prefill() [reset + stream] --> ACTIVE --tick()*
-      ^                                                           |
-      +------------------------- free() --------------------------+
+    FREE --alloc()--> begin_prefill() [reset]
+      ^                 --prefill_chunk()*--> finish_prefill()
+      |                                           |  (live flag set)
+      +------------------- free() <--- ACTIVE --tick_dispatch()*
 
-A slot is zeroed TWICE per recycle, for two different reasons. At
-`prefill()` for correctness: a freed slot keeps riding the shared
-vmapped tick while others decode, so by admission time its fill index
-has crept to garbage — prefilling without a reset would append the
-prompt at that index (shifted RoPE, garbage prefix attended). At
-`free()` for cost: restarting the idle creep from 0 keeps the
-prefix-attention trip count — which every OTHER slot pays through the
-shared vmapped loop — following the ticks-since-free, not the retired
-request's full length.
+Hot-path pipelining (the PR-3 rebuild): the decode tick is split into
+`tick_dispatch()` (enqueue the vmapped tick + start an async
+device->host copy of the token buffer) and `tick_sync(handle)` (the
+blocking read). The scheduler dispatches tick N+1 BEFORE syncing tick
+N, so the host-side bookkeeping and the transfer hide behind the
+device's compute — one exposed host sync per token becomes ~one per
+request. Occupancy is device state too: a ``live`` mask freezes the
+fill index of FREE and mid-prefill lanes (no idle creep, no corruption
+of a half-streamed prompt), and a ``done`` flag implements on-device
+stop detection — a lane that emitted eos keeps emitting eos, so the
+host can retire a pipeline-depth late purely from the async token
+buffer.
 """
 
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -61,15 +66,24 @@ def _first_token(logits, temp, top_p, key):
     return tok.astype(jnp.int32), rng
 
 
-# A FREE slot is re-zeroed after idling this many ticks. Idle lanes
-# ride the shared vmapped tick and creep their fill index; free()'s
-# reset restarts the creep, but a slot that sits in the free list
-# forever (LIFO alloc under partial occupancy) would otherwise creep
-# unboundedly — and the vmapped prefix-attention loop runs to the MAX
-# lane's trip count, so every ACTIVE slot would pay for it. The bound
-# caps the waste at ceil(64/decode_prefix_block) ≈ 1 extra prefix
-# block per lane at the default block size.
+# Historical bound on idle-lane fill-index creep (PR 1/2: a FREE slot
+# rode the shared vmapped tick and crept its fill index, so pools
+# re-zeroed long-idle lanes every this-many ticks). The PR-3 tick
+# freezes non-live lanes' indices on device (`slot_decode_tick`'s
+# ``live`` mask), so idle creep is now 0 and no periodic reset runs;
+# the constant remains the documented ceiling tests pin.
 RESET_IDLE_TICKS = 64
+
+
+class TickHandle:
+    """One in-flight decode tick: the device token buffer (its host
+    copy already started via `copy_to_host_async`). `tick_sync` turns
+    it into the [num_slots] numpy vector."""
+
+    __slots__ = ("toks",)
+
+    def __init__(self, toks):
+        self.toks = toks
 
 
 class SlotPool:
@@ -79,10 +93,15 @@ class SlotPool:
     All device work (prefill chunks, the vmapped tick, slot resets)
     happens on the caller's thread — the engine's dispatch thread —
     so jax never sees concurrent mutation of the pool state.
+
+    ``eos_id`` arms on-device stop detection (None = disabled): the
+    tick itself masks lanes that have emitted eos, so a finished slot
+    can never leak a post-eos token to the host even when retirement
+    lags a pipelined tick behind.
     """
 
     def __init__(self, model: TransformerLM, params, num_slots: int,
-                 *, mesh=None):
+                 *, mesh=None, eos_id: Optional[int] = None):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.model = model
@@ -90,16 +109,19 @@ class SlotPool:
         self.params = params
         self.num_slots = num_slots
         self.mesh = mesh
+        self.eos_id = eos_id
+        self._eos = jnp.int32(-1 if eos_id is None else eos_id)
         self._cache = init_slot_cache(model, num_slots)
         self._toks = jnp.zeros((num_slots,), jnp.int32)
         self._temps = jnp.zeros((num_slots,), jnp.float32)
         self._top_ps = jnp.ones((num_slots,), jnp.float32)
         self._rngs = jnp.stack(
             [jax.random.PRNGKey(i) for i in range(num_slots)])
+        # Device occupancy: live gates fill-index advance (FREE and
+        # mid-prefill lanes frozen), done is the on-device stop flag.
+        self._live = jnp.zeros((num_slots,), bool)
+        self._done = jnp.zeros((num_slots,), bool)
         self._free: List[int] = list(range(num_slots))
-        # Host-side ticks since each slot's last reset (see
-        # RESET_IDLE_TICKS).
-        self._idle_ticks = np.zeros((num_slots,), np.int64)
         # Compile awareness for the engine watchdog: True while a
         # device call whose shape this pool has not executed before is
         # in flight — a first-time XLA compile can take arbitrarily
@@ -108,10 +130,20 @@ class SlotPool:
         # hits, so the flag clears in microseconds for warm calls.
         self.maybe_compiling = False
         self._seen_shapes: set = set()
+        # First-time-shape count for this pool (warmup + hot path);
+        # the engine subtracts its post-warmup baseline to report
+        # hot-path compiles (the "no compile in the timed window"
+        # guarantee ci.sh asserts).
+        self.compiles = 0
 
     def _ctx(self):
         return use(self.mesh) if self.mesh is not None \
             else contextlib.nullcontext()
+
+    def _note_shape(self, key):
+        if key not in self._seen_shapes:
+            self.compiles += 1
+            self._seen_shapes.add(key)
 
     def clone_fresh(self) -> "SlotPool":
         """A brand-new pool over the same model/params/mesh — the
@@ -122,16 +154,18 @@ class SlotPool:
         config and shapes, both unchanged, so the clone recompiles
         nothing."""
         fresh = SlotPool(self.model, self.params, self.num_slots,
-                         mesh=self.mesh)
+                         mesh=self.mesh, eos_id=self.eos_id)
         # The jit cache is process-global: shapes this pool compiled
-        # are warm for the clone too.
+        # are warm for the clone too (and the compile count carries,
+        # so hot-path-compile accounting survives a restart).
         fresh._seen_shapes = set(self._seen_shapes)
+        fresh.compiles = self.compiles
         return fresh
 
     def fill_indices(self) -> np.ndarray:
         """Per-slot cache fill index, maxed across layers (and the
         pos_index at learned-position models) — introspection for
-        tests and debugging (e.g. asserting the idle-creep bound)."""
+        tests and debugging (e.g. asserting idle lanes stay at 0)."""
         from jax.tree_util import tree_flatten_with_path
         flat, _ = tree_flatten_with_path(self._cache)
         idx = [np.asarray(leaf) for path, leaf in flat
@@ -156,99 +190,182 @@ class SlotPool:
 
     def alloc(self) -> Optional[int]:
         """Claim a free slot; None when the pool is full. The slot's
-        device rows are NOT assumed clean — `prefill` re-zeroes them
-        at use time, because a freed slot keeps riding the shared
-        vmapped tick while other slots decode, creeping its fill
-        index past whatever `free` zeroed."""
+        device rows are NOT assumed clean — `begin_prefill` re-zeroes
+        them at use time."""
         if not self._free:
             return None
         return self._free.pop()
 
-    def prefill(self, slot: int, prompt, temperature: float,
-                top_p: Optional[float], seed: int) -> int:
-        """Stream ``prompt`` (1-D int tokens) into ``slot`` and return
-        the request's FIRST generated token.
-
-        Starts with a `slot_reset`: the slot has been ticking while
-        free (see `alloc`), so its fill index is nonzero garbage by
-        now — prefilling without the reset appends the prompt at that
-        index with shifted RoPE offsets and attends the idle-decode
-        garbage as prefix (token corruption, found by staggered-
-        arrival review). Chunks then follow the binary decomposition
-        (`prefill_chunks`), so the set of compiled prefill programs is
-        bounded by log2(max_len) — never one per prompt length.
-        """
-        prompt = np.asarray(prompt)
-        chunks = prefill_chunks(int(prompt.shape[0]))
-        self.maybe_compiling = (
-            ("first_token",) not in self._seen_shapes
-            or any(("prefill", c) not in self._seen_shapes
-                   for c in chunks))
+    def begin_prefill(self, slot: int):
+        """Zero ``slot``'s rows and clear its live/done flags — the
+        mandatory preamble before streaming a prompt in. The reset
+        makes admission self-contained (a slot is correct to prefill
+        whatever its history: clone restarts, crashed predecessors,
+        direct pool use)."""
+        self.maybe_compiling = ("reset",) not in self._seen_shapes
         try:
             with self._ctx():
                 self._cache = slot_reset(self.dec_model, self._cache,
                                          jnp.int32(slot))
-                self._idle_ticks[slot] = 0
-                off = 0
-                for c in chunks:
-                    self._cache, logits = slot_prefill_chunk(
-                        self.dec_model, self.params, self._cache,
-                        jnp.int32(slot),
-                        jnp.asarray(prompt[off:off + c], jnp.int32))
-                    self._seen_shapes.add(("prefill", c))
-                    off += c
+                self._live = self._live.at[slot].set(False)
+                self._done = self._done.at[slot].set(False)
+            self._note_shape(("reset",))
+        finally:
+            self.maybe_compiling = False
+
+    def prefill_chunk(self, slot: int, chunk):
+        """Append one prompt chunk (1-D int tokens, power-of-two
+        length from `prefill_chunks`) into ``slot``'s cache; returns
+        the chunk's last-position logits (a DEVICE array — no host
+        sync). The slot stays non-live, so interleaved decode ticks
+        freeze its fill index and the next chunk lands exactly where
+        this one stopped."""
+        chunk = np.asarray(chunk)
+        c = int(chunk.shape[0])
+        self.maybe_compiling = ("prefill", c) not in self._seen_shapes
+        try:
+            with self._ctx():
+                self._cache, logits = slot_prefill_chunk(
+                    self.dec_model, self.params, self._cache,
+                    jnp.int32(slot), jnp.asarray(chunk, jnp.int32))
+            self._note_shape(("prefill", c))
+            return logits
+        finally:
+            self.maybe_compiling = False
+
+    def finish_prefill(self, slot: int, logits, temperature: float,
+                       top_p: Optional[float], seed: int) -> int:
+        """Close a prefill: sample the request's FIRST token from the
+        final chunk's ``logits``, install the slot's tick-side
+        sampling state, and mark the lane live. The int() readback is
+        the one per-request host sync (TTFT wants the token now)."""
+        self.maybe_compiling = (
+            ("first_token",) not in self._seen_shapes)
+        try:
+            with self._ctx():
                 temp = jnp.float32(temperature)
                 tp = jnp.float32(1.0 if top_p is None else top_p)
                 tok, rng = _first_token(logits, temp, tp,
                                         jax.random.PRNGKey(seed))
-                self._seen_shapes.add(("first_token",))
-                # Install the slot's tick-side sampling state.
+                self._note_shape(("first_token",))
                 self._toks = self._toks.at[slot].set(tok)
                 self._temps = self._temps.at[slot].set(temp)
                 self._top_ps = self._top_ps.at[slot].set(tp)
                 self._rngs = self._rngs.at[slot].set(rng)
+                self._live = self._live.at[slot].set(True)
+                # Mirror generate's done0: a first token that IS eos
+                # arms the on-device stop immediately, so even the
+                # first tick can only re-emit eos for this lane.
+                self._done = self._done.at[slot].set(tok == self._eos)
                 return int(tok)
         finally:
             self.maybe_compiling = False
 
-    def tick(self) -> np.ndarray:
-        """One continuous-batching decode tick over every slot; returns
-        the [num_slots] next-token vector (host). The caller decides
-        which entries belong to live requests. Long-idle FREE slots
-        are re-zeroed afterwards (`RESET_IDLE_TICKS`): a never-
-        allocated lane must not creep its fill index — and with it the
-        shared prefix-attention trip count — for the engine's
-        lifetime."""
+    def prefill(self, slot: int, prompt, temperature: float,
+                top_p: Optional[float], seed: int, *,
+                max_chunk: Optional[int] = None) -> int:
+        """Stream ``prompt`` (1-D int tokens) into ``slot`` in one
+        call and return the request's FIRST generated token — the
+        begin/chunks/finish composition for callers that do not
+        interleave (tests, warmup, simple drivers). Chunks follow the
+        binary decomposition (`prefill_chunks`, optionally capped at
+        ``max_chunk``), so the set of compiled prefill programs is
+        bounded by log2(max_len) — never one per prompt length."""
+        prompt = np.asarray(prompt)
+        self.begin_prefill(slot)
+        logits = None
+        off = 0
+        for c in prefill_chunks(int(prompt.shape[0]), max_chunk):
+            logits = self.prefill_chunk(slot, prompt[off:off + c])
+            off += c
+        return self.finish_prefill(slot, logits, temperature, top_p,
+                                   seed)
+
+    # -- the tick (split for pipelining) ------------------------------
+
+    def tick_dispatch(self) -> TickHandle:
+        """Enqueue one vmapped decode tick over every slot and start
+        the async device->host copy of its token buffer; returns
+        immediately (jax async dispatch). Pair with `tick_sync` —
+        ideally AFTER dispatching the next tick, so the transfer and
+        the host bookkeeping hide behind device compute."""
         self.maybe_compiling = ("tick",) not in self._seen_shapes
-        with self._ctx():
-            try:
-                self._cache, self._toks, self._rngs = slot_decode_tick(
+        try:
+            with self._ctx():
+                (self._cache, self._toks, self._rngs,
+                 self._done) = slot_decode_tick(
                     self.dec_model, self.params, self._cache,
-                    self._toks, self._temps, self._top_ps, self._rngs)
-                self._seen_shapes.add(("tick",))
-            finally:
-                self.maybe_compiling = False
-            toks = np.asarray(self._toks)
-            self._idle_ticks += 1
-            for slot in self._free:
-                if self._idle_ticks[slot] >= RESET_IDLE_TICKS:
-                    self._cache = slot_reset(self.dec_model,
-                                             self._cache,
-                                             jnp.int32(slot))
-                    self._idle_ticks[slot] = 0
-            return toks
+                    self._toks, self._temps, self._top_ps, self._rngs,
+                    self._live, self._done, self._eos)
+            self._note_shape(("tick",))
+        finally:
+            self.maybe_compiling = False
+        toks = self._toks
+        try:
+            toks.copy_to_host_async()
+        except AttributeError:   # older jax.Array without the method
+            pass
+        return TickHandle(toks)
+
+    @staticmethod
+    def tick_sync(handle: TickHandle) -> np.ndarray:
+        """Block for one dispatched tick's [num_slots] token vector."""
+        return np.asarray(handle.toks)
+
+    def tick(self) -> np.ndarray:
+        """Synchronous tick (dispatch + immediate sync) — the
+        non-pipelined flavor tests and simple drivers use; the
+        scheduler's hot path uses the split pair."""
+        return self.tick_sync(self.tick_dispatch())
+
+    # -- warmup -------------------------------------------------------
+
+    def warmup(self, max_chunk: Optional[int] = None) -> dict:
+        """Precompile the serving hot path before the first request:
+        slot reset, every power-of-two prefill chunk a prompt can
+        decompose into (capped at ``max_chunk`` when the scheduler
+        caps chunks), the first-token sample, and the vmapped decode
+        tick. All programs land in the compile-keyed cache this pool
+        already consults (`_seen_shapes`), so the first request of any
+        prompt shape is a jit-cache hit — no XLA compile in the hot
+        path, nothing for the watchdog's `maybe_compiling` exemption
+        to special-case. Runs on the caller's thread; lane 0 is used
+        as scratch and re-zeroed after."""
+        t0 = time.time()
+        before = self.compiles
+        cap = self.model.max_len
+        if max_chunk is not None and max_chunk >= 1:
+            cap = min(cap, int(max_chunk))
+        cap = 1 << (max(1, cap).bit_length() - 1)   # pow2 floor
+        sizes = [1 << b for b in range(cap.bit_length())]
+        logits = None
+        for c in sizes:
+            self.begin_prefill(0)
+            logits = self.prefill_chunk(0, np.zeros((c,), np.int32))
+        self.finish_prefill(0, logits, 0.0, None, 0)
+        self.tick_sync(self.tick_dispatch())
+        # Lane 0 back to pristine FREE state (reset clears live/done).
+        self.begin_prefill(0)
+        with self._ctx():
+            self._toks = self._toks.at[0].set(0)
+            self._temps = self._temps.at[0].set(0.0)
+            self._top_ps = self._top_ps.at[0].set(1.0)
+        return {"compiles": self.compiles - before,
+                "seconds": time.time() - t0,
+                "prefill_sizes": sizes}
 
     def free(self, slot: int):
-        """Retire a slot: zero its rows (cost hygiene — see module
-        doc; `prefill` re-zeroes for correctness) and return it to the
-        free list."""
+        """Retire a slot: zero its rows (cost hygiene + trivially
+        inspectable state), clear its live/done flags (the tick stops
+        advancing it), and return it to the free list."""
         if slot in self._free:
             raise ValueError(f"slot {slot} is already free")
         with self._ctx():
             self._cache = slot_reset(self.dec_model, self._cache,
                                      jnp.int32(slot))
-            self._idle_ticks[slot] = 0
-            # Neutral sampling state so the freed lane's garbage decode
+            self._live = self._live.at[slot].set(False)
+            self._done = self._done.at[slot].set(False)
+            # Neutral sampling state so the freed lane's masked decode
             # stays cheap and deterministic.
             self._toks = self._toks.at[slot].set(0)
             self._temps = self._temps.at[slot].set(0.0)

@@ -499,6 +499,72 @@ class TestGenerate:
             np.testing.assert_array_equal(np.asarray(o),
                                           np.asarray(solo))
 
+    def test_bucketed_early_stop_no_post_eos_tail(self, hvd):
+        """Per-bucket EOS exit contract, pinned directly (not just via
+        parity): in every bucket, once a row emits eos the remainder
+        of its rectangle is EXACTLY pad — a post-eos tail is never
+        emitted by the per-bucket while_loop exit."""
+        from horovod_tpu.models.transformer import generate_bucketed
+        model = _tiny_model()
+        params = unbox(model.init(
+            jax.random.PRNGKey(94),
+            jnp.zeros((2, 16), jnp.int32))["params"])
+        rng = np.random.RandomState(97)
+        prompts = [jnp.asarray(rng.randint(0, 64, (n,)))
+                   for n in (3, 5, 3, 7, 5)]
+        steps, pad = 10, 63
+        probe = generate_bucketed(model, params, prompts, steps=steps)
+        # An eos that fires mid-stream in at least one row per bucket
+        # length would be ideal; picking from one probe row still
+        # exercises every bucket's exit (rows without eos must run the
+        # full budget).
+        eos = int(np.asarray(probe[1])[5 + 4])
+        outs = generate_bucketed(model, params, prompts, steps=steps,
+                                 eos_id=eos, pad_id=pad,
+                                 early_stop=True)
+        stopped = 0
+        for p, o in zip(prompts, outs):
+            gen = np.asarray(o)[p.shape[0]:]
+            assert gen.shape[0] == steps
+            hits = np.where(gen == eos)[0]
+            if hits.size:
+                stopped += 1
+                k = hits[0]
+                # eos is emitted, then NOTHING but pad follows.
+                np.testing.assert_array_equal(
+                    gen[k + 1:], np.full(steps - k - 1, pad))
+        assert stopped >= 1      # the contract was actually exercised
+
+    def test_bucketed_early_stop_cache_keys_stable(self, hvd):
+        """Bucket program cache keys stay stable: re-running the same
+        bucket set (same lengths, same batch split, same eos/early-
+        stop flags) must not grow `_generate_scan`'s jit cache — the
+        serving-bucket trade is one compile per distinct
+        (length, batch) pair, never one per call."""
+        from horovod_tpu.models.transformer import (_generate_scan,
+                                                    generate_bucketed)
+        if not hasattr(_generate_scan, "_cache_size"):
+            pytest.skip("jit cache introspection unavailable")
+        model = _tiny_model()
+        params = unbox(model.init(
+            jax.random.PRNGKey(98),
+            jnp.zeros((2, 16), jnp.int32))["params"])
+        rng = np.random.RandomState(99)
+        prompts = [jnp.asarray(rng.randint(0, 64, (n,)))
+                   for n in (3, 5, 3, 7)]
+        kw = dict(steps=6, eos_id=7, pad_id=63, early_stop=True)
+        generate_bucketed(model, params, prompts, **kw)
+        n0 = _generate_scan._cache_size()
+        for _ in range(2):
+            generate_bucketed(model, params, prompts, **kw)
+        assert _generate_scan._cache_size() == n0
+        # A NEW bucket length legitimately adds (at most) one entry.
+        generate_bucketed(
+            model, params,
+            prompts + [jnp.asarray(rng.randint(0, 64, (9,)))], **kw)
+        n1 = _generate_scan._cache_size()
+        assert n0 < n1 <= n0 + 1
+
     def test_serving_params_cast_rules(self, hvd):
         """serving_params: ndim>=2 float params cast to bf16; 1-D
         (norm scales/biases) stay f32; int8 leaves untouched; and at

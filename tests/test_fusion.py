@@ -43,6 +43,44 @@ def test_plan_buckets_dtype_grouping():
     assert buckets == [[0], [1], [2]]
 
 
+def test_hvd_fusion_mb_env_controls_bucket_plans(monkeypatch):
+    """HVD_FUSION_MB (megabytes, HOROVOD_FUSION_THRESHOLD parity)
+    reaches `plan_buckets` through the runtime config and actually
+    changes the plan; the byte-exact reference variable wins when both
+    are set; fractions of a MB parse."""
+    from horovod_tpu.runtime.config import (DEFAULT_FUSION_THRESHOLD,
+                                            config)
+    leaves = [_Leaf((1 << 18,), np.float32)   # 1 MiB each
+              for _ in range(8)]
+    try:
+        # Default: 64 MiB — everything in one bucket.
+        monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD", raising=False)
+        monkeypatch.delenv("HVD_FUSION_MB", raising=False)
+        config.refresh()
+        assert config.fusion_threshold == DEFAULT_FUSION_THRESHOLD
+        assert [len(b) for b in plan_buckets(leaves)] == [8]
+        # 2 MB buckets -> pairs.
+        monkeypatch.setenv("HVD_FUSION_MB", "2")
+        config.refresh()
+        assert config.fusion_threshold == 2 << 20
+        assert [len(b) for b in plan_buckets(leaves)] == [2] * 4
+        # Fractional MB: 0.5 MB < leaf size -> singletons.
+        monkeypatch.setenv("HVD_FUSION_MB", "0.5")
+        config.refresh()
+        assert config.fusion_threshold == 1 << 19
+        assert [len(b) for b in plan_buckets(leaves)] == [1] * 8
+        # The reference's byte-exact variable takes precedence.
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD",
+                           str(4 << 20))
+        config.refresh()
+        assert config.fusion_threshold == 4 << 20
+        assert [len(b) for b in plan_buckets(leaves)] == [4, 4]
+    finally:
+        monkeypatch.delenv("HOROVOD_FUSION_THRESHOLD", raising=False)
+        monkeypatch.delenv("HVD_FUSION_MB", raising=False)
+        config.refresh()
+
+
 @pytest.mark.parametrize("threshold", [0, 64, 1 << 20])
 def test_fused_allreduce_matches_unfused(hvd, threshold):
     """Fused result == per-tensor psum for any threshold."""

@@ -584,6 +584,44 @@ class TestServingSelfHealing:
         finally:
             eng.shutdown()
 
+    def test_mid_prefill_crash_replays_token_exact(self, lm):
+        """PR-3 regression: a crash while a long prompt is only
+        PARTIALLY prefilled (interleaved chunked prefill, tiny
+        budget) must re-queue the mid-prefill request and replay it
+        from the prompt token-exact — the restart path and the
+        chunked-prefill slot state compose."""
+        from horovod_tpu.serving import ServingEngine
+        model, params = lm
+        long_p = np.arange(1, 15)          # 14 tokens, budget 2
+        short_p = np.array([3, 5])
+        steps = 8
+        with ServingEngine(model, params, num_slots=2,
+                           max_queue=16) as eng:
+            base = [h.result(timeout=300).tokens for h in
+                    [eng.submit(short_p, steps),
+                     eng.submit(long_p, steps)]]
+
+        eng = ServingEngine(model, params, num_slots=2, max_queue=16,
+                            auto_restart=True, max_restarts=2,
+                            prefill_chunk_budget=2)
+        try:
+            h_short = eng.submit(short_p, steps)
+            h_long = eng.submit(long_p, steps)
+            # Crash while the long prompt is demonstrably mid-prefill.
+            _wait(lambda: eng.scheduler.prefilling or h_long.done())
+            with chaos.armed("serving_dispatch_crash:1"):
+                _wait(lambda:
+                      eng.metrics_snapshot()["restarts"] == 1)
+                results = [h.result(timeout=300)
+                           for h in (h_short, h_long)]
+            snap = eng.metrics_snapshot()
+            assert snap["restarts"] == 1
+            assert snap["requeued"] >= 1
+            for b, r in zip(base, results):
+                np.testing.assert_array_equal(b, r.tokens)
+        finally:
+            eng.shutdown()
+
     def test_stuck_tick_watchdog_split_by_deadline(self, lm):
         """Acceptance (b), stuck leg: a hung decode tick trips the
         watchdog; the in-deadline request is re-queued and completes,

@@ -374,7 +374,7 @@ class TestShutdown:
         def boom(*a, **kw):
             raise RuntimeError("injected prefill fault")
 
-        eng.pool.prefill = boom
+        eng.pool.prefill_chunk = boom
         a = eng.submit(np.array([1, 2]), 4)
         b = eng.submit(np.array([3]), 4)
         for h in (a, b):
@@ -390,6 +390,184 @@ class TestShutdown:
                 eng.submit(np.array([1.5, 2.5]), 4)
 
 
+class TestHotPathPipelining:
+    """PR-3 tentpole: async tick ring, interleaved chunked prefill,
+    on-device stop detection, program warmup."""
+
+    def test_pipeline_depths_token_exact_and_syncs_reduced(self, lm):
+        """Depth 0 (sync every tick, the PR-1 shape) and depth 1 (the
+        one-deep in-flight ring) must produce identical tokens; the
+        ring must strictly reduce exposed host syncs per token (the
+        tentpole's metric) by overlapping tick reads with the next
+        tick's compute."""
+        model, params = lm
+        prompts = _prompts(5, seed=11)
+        steps = 10
+
+        def run(depth):
+            with ServingEngine(model, params, num_slots=2,
+                               max_queue=16,
+                               pipeline_depth=depth) as eng:
+                hs = [eng.submit(p, steps) for p in prompts]
+                toks = [h.result(timeout=300).tokens for h in hs]
+            return toks, eng.metrics_snapshot()
+
+        t0, s0 = run(0)
+        t1, s1 = run(1)
+        for a, b in zip(t0, t1):
+            np.testing.assert_array_equal(a, b)
+        assert s0["ticks_overlapped"] == 0
+        assert s1["ticks_overlapped"] > 0
+        assert s1["host_syncs"] < s0["host_syncs"]
+        assert (s1["host_syncs_per_token"]
+                < s0["host_syncs_per_token"])
+        assert s0["pipeline_depth"] == 0 and s1["pipeline_depth"] == 1
+
+    def test_long_prompt_prefill_interleaves_with_decode(self, lm):
+        """A long prompt admitted while another slot decodes must NOT
+        stream all its chunks in one scheduler step: the budget caps
+        prompt tokens per step, the victim gains tokens between the
+        chunks, and both outputs stay token-exact (driven through the
+        scheduler directly so interleaving is observable)."""
+        import horovod_tpu.serving as sv
+        from concurrent.futures import Future
+        from horovod_tpu.serving.admission import (Request,
+                                                   SamplingParams)
+        model, params = lm
+        pool = sv.SlotPool(model, params, 2)
+        queue = sv.AdmissionQueue(4)
+        metrics = sv.EngineMetrics()
+        sched = sv.ContinuousBatchingScheduler(
+            pool, queue, metrics, prefill_chunk_budget=2,
+            pipeline_depth=1)
+        now = time.time()
+        short = np.array([5, 9, 11])
+        long_p = np.arange(1, 15)   # 14 tokens -> 7 budget-2 chunks
+
+        def req(i, prompt, steps):
+            return Request(id=i, prompt=prompt, max_new_tokens=steps,
+                           sampling=SamplingParams(), deadline=None,
+                           future=Future(), t_submit=now)
+
+        a, b = req(0, short, 16), req(1, long_p, 4)
+        queue.offer(a)
+        sched.step()
+        assert sched.has_active()
+        queue.offer(b)
+        interleaved_steps = 0
+        victim_gains = 0
+        while not b.future.done() or not a.future.done():
+            n_before = len(a.tokens)
+            sched.step()
+            if sched.prefilling:
+                interleaved_steps += 1
+                victim_gains += len(a.tokens) - n_before
+        # The 7-chunk prefill spread over >= 3 scheduler steps and the
+        # victim kept decoding through them.
+        assert interleaved_steps >= 3, interleaved_steps
+        assert victim_gains >= 2, victim_gains
+        assert metrics.prefill_chunks >= 7
+        for prompt, r, steps in ((short, a, 16), (long_p, b, 4)):
+            ref = np.asarray(generate(
+                model, params, jnp.asarray(prompt)[None], steps))[0]
+            np.testing.assert_array_equal(
+                np.concatenate([prompt, r.future.result(0).tokens]),
+                ref)
+
+    def test_on_device_stop_masks_post_eos(self, lm):
+        """On-device stop detection: once a lane emits eos, every
+        later tick re-emits eos for it (the done flag masks the lane
+        on device) and its fill index freezes — no second host sync is
+        needed to stop a finished slot from corrupting the stream."""
+        from horovod_tpu.serving.slots import SlotPool
+        model, params = lm
+        prompt = _prompts(1, seed=3)[0]
+        probe = np.asarray(generate(model, params,
+                                    jnp.asarray(prompt)[None], 10))[0]
+        eos = int(probe[prompt.shape[0] + 4])   # occurs mid-stream
+        pool = SlotPool(model, params, 2, eos_id=eos)
+        slot = pool.alloc()
+        seen = [pool.prefill(slot, prompt, 0.0, None, 0)]
+        for _ in range(10):
+            seen.append(int(pool.tick()[slot]))
+        hit = seen.index(eos)
+        assert hit <= 5
+        assert all(t == eos for t in seen[hit:]), seen
+        fills = pool.fill_indices()
+        # Done lane frozen at its stop fill; free lane never crept.
+        assert fills[slot] <= prompt.shape[0] + hit + 1
+        assert fills[1 - slot] == 0
+
+    def test_mid_prefill_cancel_frees_slot(self, lm):
+        """Cancelling a request whose prompt is still streaming in
+        chunks frees its slot without paying the remaining chunks."""
+        import horovod_tpu.serving as sv
+        from concurrent.futures import Future
+        from horovod_tpu.serving.admission import (Request,
+                                                   SamplingParams)
+        model, params = lm
+        pool = sv.SlotPool(model, params, 1)
+        queue = sv.AdmissionQueue(4)
+        metrics = sv.EngineMetrics()
+        sched = sv.ContinuousBatchingScheduler(
+            pool, queue, metrics, prefill_chunk_budget=2)
+        req = Request(id=0, prompt=np.arange(1, 15),
+                      max_new_tokens=8, sampling=SamplingParams(),
+                      deadline=None, future=Future(),
+                      t_submit=time.time())
+        queue.offer(req)
+        sched.step()
+        assert sched.prefilling and not req.future.done()
+        chunks_before = metrics.prefill_chunks
+        req.cancel()
+        sched.step()
+        assert not sched.prefilling and not sched.has_active()
+        assert pool.free_slots == 1
+        assert metrics.prefill_chunks == chunks_before
+        with pytest.raises(CancelledError):
+            req.future.result(timeout=0)
+        assert metrics.cancelled == 1
+
+    def test_warmup_precompiles_hot_path(self, lm):
+        """ServingEngine(warmup=True): the tick + pinned prefill
+        bucket set compile at construction, so the serving window is
+        compile-free (`compiles == 0`) — the guarantee the ci.sh
+        smoke asserts and the PR-2 watchdog no longer needs
+        `maybe_compiling` to paper over."""
+        model, params = lm
+        with ServingEngine(model, params, num_slots=2, max_queue=16,
+                           warmup=True) as eng:
+            assert eng.warmup_info is not None
+            hs = [eng.submit(p, 6) for p in _prompts(4, seed=13)]
+            for h in hs:
+                h.result(timeout=300)
+            snap = eng.metrics_snapshot()
+        assert snap["compiles"] == 0, snap["compiles"]
+        assert snap["warmup_s"] is not None
+        # A pool-level cold run of the same shapes registers them as
+        # first-time (the warmup's own count is >= the tick + chunk
+        # set it pinned).
+        assert snap["warmup_compiles"] >= 3
+
+    def test_prefill_budget_env_default(self, lm, monkeypatch):
+        """HVD_PREFILL_CHUNK_BUDGET reaches the engine through the
+        runtime config when no kwarg is passed."""
+        from horovod_tpu.runtime.config import config
+        monkeypatch.setenv("HVD_PREFILL_CHUNK_BUDGET", "3")
+        config.refresh()
+        try:
+            model, params = lm
+            eng = ServingEngine(model, params, num_slots=1)
+            assert eng.prefill_chunk_budget == 3
+            assert eng.scheduler.prefill_chunk_budget == 3
+            # pow2 floor of the budget caps chunk sizes
+            assert eng.scheduler._max_chunk == 3
+            eng.shutdown()
+        finally:
+            monkeypatch.delenv("HVD_PREFILL_CHUNK_BUDGET")
+            config.refresh()
+
+
 class TestPlumbing:
     def test_prefill_chunks_binary_decomposition(self, hvd):
         assert prefill_chunks(13) == [8, 4, 1]
@@ -401,6 +579,22 @@ class TestPlumbing:
             assert cs == sorted(cs, reverse=True)
         with pytest.raises(ValueError):
             prefill_chunks(0)
+
+    def test_prefill_chunks_budget_cap(self, hvd):
+        """max_chunk caps chunks at its power-of-two floor while the
+        schedule still sums to the prompt length with power-of-two
+        pieces only (the compile-bounded contract)."""
+        assert prefill_chunks(200, 64) == [64, 64, 64, 8]
+        assert prefill_chunks(13, 4) == [4, 4, 4, 1]
+        assert prefill_chunks(13, 5) == [4, 4, 4, 1]   # pow2 floor
+        assert prefill_chunks(3, 8) == [2, 1]
+        assert prefill_chunks(8, 1) == [1] * 8
+        for n in range(1, 70):
+            for cap in (1, 2, 3, 8, 64):
+                cs = prefill_chunks(n, cap)
+                assert sum(cs) == n
+                assert all(c & (c - 1) == 0 for c in cs)
+                assert max(cs) <= cap
 
     def test_metrics_snapshot_shape(self, lm):
         model, params = lm
