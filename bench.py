@@ -1044,7 +1044,8 @@ def main():
     if args.deadline > 0:
         start_deadline_watchdog(metric, unit, args.deadline)
 
-    if "HOROVOD_RANK" in os.environ or os.environ.get("HOROVOD_PLATFORM"):
+    from horovod_tpu.runtime.config import env_raw, env_str
+    if env_raw("HOROVOD_RANK") is not None or env_str("HOROVOD_PLATFORM"):
         # Launched by hvdrun: hvd.init() must own backend bring-up
         # (platform forcing + jax.distributed.initialize are no-ops
         # once a backend exists) — no watchdog probe.
@@ -1069,7 +1070,7 @@ def main():
         # (BENCH_r05 burned 26 min retrying "probe hung > 90s"): with
         # the CPU fallback below, a dead tunnel costs at most this
         # long before real (CPU) numbers start.
-        env_cap = os.environ.get("HVD_BENCH_PROBE_BUDGET_S", "")
+        env_cap = env_str("HVD_BENCH_PROBE_BUDGET_S")
         if env_cap and args.platform != "cpu":
             cap = float(env_cap)
             budget = cap if budget is None else min(budget, cap)

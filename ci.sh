@@ -8,6 +8,22 @@ cd "$(dirname "$0")"
 
 JAX_PLATFORMS=cpu python -m pytest tests/ -q
 
+# hvdlint gate (docs/analysis.md): the JAX-aware static analyzer must
+# be clean against the committed baseline — which this repo ships
+# EMPTY, so ANY finding (a host sync sneaking into the @hot_path tick
+# ring, trace-unsafe control flow, an unregistered env knob, ...)
+# fails CI here. The gate's failure mode is proven by
+# tests/test_analysis.py::TestCIGate with a deliberately-violating
+# temp file, so CI itself stays green-on-clean.
+JAX_PLATFORMS=cpu python -m horovod_tpu.analysis \
+    --baseline .hvdlint-baseline.json
+# Env-knob discipline beyond the package: bench/bench_daemon read
+# HVD_* knobs too — HVD005 (only; bench's exception style is its own)
+# keeps them inside the runtime/config.py registry so the generated
+# troubleshooting table stays complete.
+JAX_PLATFORMS=cpu python -m horovod_tpu.analysis --rules HVD005 \
+    bench.py bench_daemon.py
+
 # Compat matrix (the reference sweeps {py27/34/36} x {TF 1.1/1.4/
 # nightly} x {OpenMPI,MPICH} in .travis.yml; this image pins ONE real
 # generation — TF 2.21 / Keras 3 — so the other Keras generations'

@@ -1,0 +1,18 @@
+"""hvdlint — JAX-aware static analysis for horovod_tpu.
+
+An AST-based analyzer (stdlib only) with a rule framework tuned to
+this repo's bug classes: host syncs in the pipelined serving hot path
+(HVD001), trace-unsafe Python control flow in compiled functions
+(HVD002), recompilation hazards (HVD003), mixed lock discipline
+(HVD004), environment knobs bypassing the config registry (HVD005),
+and swallowed broad excepts (HVD006). See docs/analysis.md for the
+catalog, the ``# hvd: disable=RULE(reason)`` suppression syntax, and
+the baseline workflow; ``ci.sh`` gates on
+``python -m horovod_tpu.analysis --baseline .hvdlint-baseline.json``.
+"""
+
+from horovod_tpu.analysis.core import (  # noqa: F401
+    Finding, Project, RuleMeta, collect_files, run_rules,
+)
+from horovod_tpu.analysis.cli import analyze, main  # noqa: F401
+from horovod_tpu.analysis.rules import ALL_RULES, BY_ID  # noqa: F401

@@ -1,0 +1,78 @@
+"""hvdlint baseline: the committed debt ledger.
+
+A baseline file records findings that existed when a rule landed, so
+the CI gate can fail on NEW findings only while the old ones are paid
+down. Matching is by ``(rule, path, message)`` OCCURRENCE COUNTS —
+line numbers drift with unrelated edits and must not un-baseline a
+finding, but a SECOND violation with an identical message (rule
+messages don't always carry the enclosing function) must still fail
+the gate, so each baselined key absorbs only as many findings as were
+recorded.
+
+This repo ships an EMPTY baseline (`.hvdlint-baseline.json`): every
+true positive in the tree was fixed or suppressed with a reasoned
+comment when the analyzer landed, and the gate keeps it that way. The
+workflow for adopting hvdlint elsewhere::
+
+    python -m horovod_tpu.analysis --write-baseline  # snapshot debt
+    python -m horovod_tpu.analysis                   # exits 0
+    <introduce a regression>                         # exits 1
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Counter, List, Tuple
+
+from horovod_tpu.analysis.core import Finding
+
+VERSION = 1
+
+Key = Tuple[str, str, str]
+
+
+def load(path: str) -> Counter[Key]:
+    """Baselined finding keys with occurrence counts; a missing file
+    is an empty baseline (a malformed one raises — CI must not
+    silently pass)."""
+    if not os.path.exists(path):
+        return collections.Counter()
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != VERSION:
+        raise ValueError(
+            f"{path}: unsupported hvdlint baseline version "
+            f"{data.get('version')!r} (expected {VERSION})")
+    return collections.Counter(
+        (f["rule"], f["path"], f["message"])
+        for f in data["findings"])
+
+
+def save(path: str, findings: List[Finding]):
+    data = {
+        "version": VERSION,
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "message": f.message}
+            for f in findings],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def split(findings: List[Finding], baselined):
+    """(new, old) — each baselined key absorbs at most its recorded
+    occurrence count (in file order); the overflow is new. Accepts any
+    iterable/mapping of keys (a set counts each key once)."""
+    remaining = collections.Counter(baselined)
+    new, old = [], []
+    for f in findings:
+        if remaining[f.key()] > 0:
+            remaining[f.key()] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old

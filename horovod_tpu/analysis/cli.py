@@ -1,0 +1,166 @@
+"""hvdlint command line.
+
+::
+
+    python -m horovod_tpu.analysis [paths...]
+        [--baseline .hvdlint-baseline.json] [--write-baseline]
+        [--json] [--rules HVD001,HVD004] [--list-rules]
+        [--write-env-table [docs/troubleshooting.md]]
+
+Exit codes: 0 clean (all findings baselined), 1 findings, 2 usage or
+analysis error. Default target: the installed ``horovod_tpu`` package
+tree. The baseline defaults to ``.hvdlint-baseline.json`` in the
+current directory for BOTH reading and ``--write-baseline`` (a missing
+file is an empty baseline), so the CI gate is just ``python -m
+horovod_tpu.analysis`` from the repo root.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from horovod_tpu.analysis import baseline as baseline_mod
+from horovod_tpu.analysis.core import Project, collect_files, run_rules
+from horovod_tpu.analysis.rules import ALL_RULES, BY_ID
+
+_ENV_TABLE_BEGIN = "<!-- hvdlint:env-table:begin -->"
+_ENV_TABLE_END = "<!-- hvdlint:env-table:end -->"
+
+
+def _package_root() -> str:
+    import horovod_tpu
+    return os.path.dirname(os.path.abspath(horovod_tpu.__file__))
+
+
+def _repo_root() -> str:
+    return os.path.dirname(_package_root())
+
+
+def analyze(paths, rules=None, root=None):
+    """API twin of the CLI: (active, suppressed) findings for
+    ``paths`` (defaults: whole package, all rules)."""
+    root = root or _repo_root()
+    paths = list(paths) if paths else [_package_root()]
+    files = collect_files(paths, root)
+    project = Project(files)
+    return run_rules(project, rules or ALL_RULES), len(files)
+
+
+def write_env_table(doc_path: str) -> bool:
+    """Regenerate the environment-knob table between the hvdlint
+    markers in ``doc_path`` from the live config registry. Returns
+    True when the file changed."""
+    from horovod_tpu.runtime.config import env_table_md
+    with open(doc_path, "r", encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        head, rest = text.split(_ENV_TABLE_BEGIN, 1)
+        _, tail = rest.split(_ENV_TABLE_END, 1)
+    except ValueError:
+        raise SystemExit(
+            f"{doc_path}: missing {_ENV_TABLE_BEGIN} / "
+            f"{_ENV_TABLE_END} markers")
+    new = (f"{head}{_ENV_TABLE_BEGIN}\n"
+           f"{env_table_md()}"
+           f"{_ENV_TABLE_END}{tail}")
+    if new != text:
+        with open(doc_path, "w", encoding="utf-8") as fh:
+            fh.write(new)
+        return True
+    return False
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description="hvdlint: JAX-aware static analysis for "
+                    "horovod_tpu (docs/analysis.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to analyze (default: the "
+                         "horovod_tpu package)")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="baseline JSON; findings recorded there do "
+                         "not fail the run (default: read "
+                         ".hvdlint-baseline.json in the current "
+                         "directory; missing file = empty baseline)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="snapshot current findings into --baseline "
+                         "(default .hvdlint-baseline.json) and exit 0")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--rules", metavar="IDS",
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--write-env-table", nargs="?", metavar="DOC",
+                    const=os.path.join(_repo_root(), "docs",
+                                       "troubleshooting.md"),
+                    help="regenerate the env-knob table in DOC from "
+                         "the config registry, then exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for mod in ALL_RULES:
+            r = mod.RULE
+            print(f"{r.id}  {r.name:28s} [{r.severity}]  {r.doc}")
+        return 0
+
+    if args.write_env_table:
+        changed = write_env_table(args.write_env_table)
+        print(f"hvdlint: env table "
+              f"{'updated' if changed else 'already current'} in "
+              f"{args.write_env_table}")
+        return 0
+
+    rules = ALL_RULES
+    if args.rules:
+        try:
+            rules = [BY_ID[rid.strip()]
+                     for rid in args.rules.split(",") if rid.strip()]
+        except KeyError as e:
+            ap.error(f"unknown rule id {e.args[0]!r} "
+                     f"(see --list-rules)")
+
+    try:
+        (active, muted), nfiles = analyze(args.paths, rules)
+    except (SyntaxError, OSError, UnicodeDecodeError) as e:
+        # Any unreadable/unparseable input is exit 2 (usage/analysis
+        # error), never a traceback the gate can't tell from findings.
+        print(f"hvdlint: {e}", file=sys.stderr)
+        return 2
+
+    # The default is symmetric: plain runs READ the same cwd ledger
+    # --write-baseline writes, so the documented adopt workflow
+    # (snapshot, then a plain run exits 0) holds without flags.
+    baseline_path = args.baseline or ".hvdlint-baseline.json"
+    if args.write_baseline:
+        baseline_mod.save(baseline_path, active)
+        print(f"hvdlint: wrote {len(active)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    baselined = baseline_mod.load(baseline_path)
+    new, old = baseline_mod.split(active, baselined)
+
+    if args.json:
+        print(json.dumps({
+            "files": nfiles,
+            "findings": [f.to_json() for f in new],
+            "baselined": len(old),
+            "suppressed": [f.to_json() for f in muted],
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        errs = sum(1 for f in new if f.severity == "error")
+        if new:
+            print(f"hvdlint: {len(new)} finding(s) ({errs} error(s), "
+                  f"{len(new) - errs} warning(s)) in {nfiles} files; "
+                  f"{len(old)} baselined, {len(muted)} suppressed")
+        else:
+            print(f"hvdlint: clean ({nfiles} files, {len(old)} "
+                  f"baselined, {len(muted)} suppressed)")
+    return 1 if new else 0

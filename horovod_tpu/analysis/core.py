@@ -1,0 +1,276 @@
+"""hvdlint core: findings, suppressions, source files, the driver.
+
+The analysis itself is stdlib-only (``ast`` + ``tokenize``) — no new
+dependencies, nothing heavier than parsing in CI. (The ``python -m
+horovod_tpu.analysis`` entry still imports the parent package, so the
+CLI needs a working install; the analysis modules themselves do not
+touch jax.) A *rule* is a module under
+`horovod_tpu.analysis.rules` exporting
+
+    RULE = RuleMeta(id="HVD00x", ...)
+    def check(project: Project) -> Iterable[Finding]
+
+Rules see the whole `Project` (every parsed file plus the cross-file
+`SymbolTable`), so per-file visitors and whole-program checks (call
+graphs, registries) share one framework.
+
+Suppressions
+------------
+A finding is suppressed by a ``# hvd: disable=RULE`` comment either on
+the finding's line or on a standalone comment line directly above it::
+
+    x = dev_val.item()       # hvd: disable=HVD001(the designed sync)
+
+    # hvd: disable=HVD006(shutdown must proceed past any fault)
+    except Exception:
+
+Multiple rules separate with commas; the parenthesized reason is
+optional syntax but required culture — the shipped tree carries a
+reason on every suppression (docs/analysis.md).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Tuple
+
+SEVERITIES = ("error", "warning")
+
+_SUPPRESS_RE = re.compile(r"#\s*hvd:\s*disable=([^#]*)")
+_RULE_ID_RE = re.compile(r"[A-Z][A-Z0-9_]*")
+
+
+def _parse_rule_tokens(spec: str) -> Dict[str, str]:
+    """Parse ``RULE(reason), RULE2(reason2), ...`` from a disable
+    comment. The grammar is strict on both sides so prose can never
+    mute a rule by accident: reasons are matched to their CLOSING
+    paren with a depth counter (``HVD004(abandon() is benign)`` stays
+    one suppression with the full reason — a first-')' cut would
+    register the ALL-CAPS words after it as extra muted rules), and
+    rules chain ONLY through a comma (trailing prose like
+    ``HVD005(ok) but HVD001-style ...`` ends the list instead of
+    muting HVD001)."""
+    rules: Dict[str, str] = {}
+    i, n = 0, len(spec)
+    while True:
+        while i < n and spec[i].isspace():
+            i += 1
+        if rules:            # subsequent rules require a ',' joiner
+            if i >= n or spec[i] != ",":
+                break
+            i += 1
+            while i < n and spec[i].isspace():
+                i += 1
+        m = _RULE_ID_RE.match(spec, i)
+        if not m:
+            break
+        rid = m.group(0)
+        i = m.end()
+        while i < n and spec[i].isspace():
+            i += 1
+        reason = ""
+        if i < n and spec[i] == "(":
+            depth, j = 1, i + 1
+            while j < n and depth:
+                if spec[j] == "(":
+                    depth += 1
+                elif spec[j] == ")":
+                    depth -= 1
+                j += 1
+            # Unbalanced open paren: the reason runs to end of comment.
+            reason = spec[i + 1:j - 1] if depth == 0 else spec[i + 1:]
+            i = j
+        rules[rid] = reason
+    return rules
+
+
+@dataclasses.dataclass(frozen=True)
+class RuleMeta:
+    """Static description of one rule (the catalog row)."""
+
+    id: str                  # "HVD001"
+    name: str                # "host-sync-in-hot-path"
+    severity: str            # "error" | "warning"
+    doc: str                 # one-paragraph catalog entry
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str                # repo-relative, '/'-separated
+    line: int
+    col: int
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        """Baseline identity: line numbers drift with unrelated edits,
+        so a baselined finding matches on (rule, path, message)."""
+        return (self.rule, self.path, self.message)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.severity}] {self.message}")
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+class SourceFile:
+    """One parsed file: AST, raw lines, and the suppression map."""
+
+    def __init__(self, abspath: str, relpath: str, text: str):
+        self.abspath = abspath
+        self.path = relpath.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=abspath)
+        # line (1-based) -> {rule_id: reason}
+        self.suppressions: Dict[int, Dict[str, str]] = {}
+        self._scan_suppressions()
+
+    def _scan_suppressions(self):
+        # Real COMMENT tokens only (tokenize, not a raw line regex):
+        # a "# hvd: disable=..." inside a string or docstring is TEXT
+        # — honoring it could silently mute a genuine finding on the
+        # next code line.
+        comments: Dict[int, str] = {}
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.text).readline))
+        except (tokenize.TokenError, IndentationError):
+            tokens = []     # ast parsed, so this is belt-and-braces
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                comments[tok.start[0]] = tok.string
+        pending: Dict[str, str] = {}
+        for i, raw in enumerate(self.lines, start=1):
+            comment = comments.get(i, "")
+            m = _SUPPRESS_RE.search(comment)
+            stripped = raw.strip()
+            if m:
+                rules = _parse_rule_tokens(m.group(1))
+                if stripped.startswith("#"):
+                    # Standalone comment: applies to the next code line
+                    # (accumulating across consecutive comment lines).
+                    pending.update(rules)
+                else:
+                    here = dict(self.suppressions.get(i, {}))
+                    here.update(pending)
+                    here.update(rules)
+                    self.suppressions[i] = here
+                    pending = {}
+            elif stripped.startswith("#"):
+                continue    # a contiguous comment block keeps `pending`
+            elif not stripped:
+                # A blank line severs the "directly above" link: a
+                # suppression whose statement was deleted must die with
+                # it, not silently migrate onto the next code below.
+                pending = {}
+            else:
+                if pending:
+                    self.suppressions[i] = dict(pending)
+                    pending = {}
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        return rule in self.suppressions.get(line, ())
+
+
+class Project:
+    """Everything a rule can see: the file set and the symbol table."""
+
+    def __init__(self, files: List[SourceFile]):
+        self.files = files
+        self.by_path = {f.path: f for f in files}
+        from horovod_tpu.analysis.symbols import SymbolTable
+        self.symbols = SymbolTable(files)
+
+    def file_of(self, relpath: str) -> Optional[SourceFile]:
+        return self.by_path.get(relpath)
+
+
+def collect_files(paths: Iterable[str], root: str) -> List[SourceFile]:
+    """Parse every ``.py`` under ``paths`` (files or directories);
+    relpaths are taken against ``root``. Syntax errors propagate — an
+    unparseable tree must fail the lint run, not silently shrink it."""
+    seen = set()
+    out: List[SourceFile] = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isdir(ap):
+            todo = []
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                todo += [os.path.join(dirpath, fn)
+                         for fn in filenames if fn.endswith(".py")]
+        elif ap.endswith(".py"):
+            todo = [ap]
+        else:
+            raise FileNotFoundError(f"not a python file or dir: {p}")
+        for f in sorted(todo):
+            if f in seen:
+                continue
+            seen.add(f)
+            rel = os.path.relpath(f, root)
+            if rel.startswith(".."):
+                rel = f
+            with open(f, "r", encoding="utf-8") as fh:
+                out.append(SourceFile(f, rel, fh.read()))
+    return out
+
+
+def run_rules(project: Project, rules) -> Tuple[List[Finding],
+                                                List[Finding]]:
+    """Run ``rules`` over ``project``; returns (active, suppressed)
+    findings, both sorted by (path, line, rule)."""
+    active: List[Finding] = []
+    muted: List[Finding] = []
+    for rule_mod in rules:
+        for finding in rule_mod.check(project):
+            src = project.file_of(finding.path)
+            if src is not None and src.suppressed(finding.rule,
+                                                  finding.line):
+                muted.append(finding)
+            else:
+                active.append(finding)
+    keyfn = lambda f: (f.path, f.line, f.col, f.rule)  # noqa: E731
+    return sorted(active, key=keyfn), sorted(muted, key=keyfn)
+
+
+# -- small AST helpers shared by rules --------------------------------------
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_scope(node: ast.AST):
+    """ast.walk that does NOT descend into nested function/class
+    definitions — the per-scope traversal lock/except rules need."""
+    todo = list(ast.iter_child_nodes(node))
+    while todo:
+        n = todo.pop()
+        yield n
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+            todo.extend(ast.iter_child_nodes(n))

@@ -1,0 +1,21 @@
+"""hvdlint rule registry.
+
+Each rule is a sibling module exporting ``RULE`` (a
+`core.RuleMeta`) and ``check(project)``. Order here is catalog order.
+"""
+
+from __future__ import annotations
+
+from horovod_tpu.analysis.rules import (
+    host_sync,
+    trace_safety,
+    recompile,
+    locks,
+    env_registry,
+    broad_except,
+)
+
+ALL_RULES = [host_sync, trace_safety, recompile, locks, env_registry,
+             broad_except]
+
+BY_ID = {mod.RULE.id: mod for mod in ALL_RULES}

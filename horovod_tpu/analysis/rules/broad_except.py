@@ -1,0 +1,63 @@
+"""HVD006: swallowed broad excepts outside marked recovery code.
+
+``except Exception`` that neither re-raises nor raises a typed wrapper
+turns programming errors into silent state corruption — in a codebase
+whose recovery layer (watchdog restarts, chaos drills, checkpoint
+fallbacks) *depends* on faults surfacing, a swallowed broad except is
+a disabled smoke detector. The rule flags ``except``/``except
+Exception``/``except BaseException`` handlers (and tuples containing
+them) whose body contains no ``raise``; intentionally-broad recovery
+handlers stay, marked ``# hvd: disable=HVD006(reason)`` — the reason
+is the documentation reviewers actually read.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from horovod_tpu.analysis.core import Finding, RuleMeta, walk_scope
+
+RULE = RuleMeta(
+    id="HVD006",
+    name="swallowed-broad-except",
+    severity="warning",
+    doc="`except Exception` (or broader) with no raise in the handler "
+        "body swallows programming errors; narrow it or mark recovery "
+        "code with a reasoned suppression.")
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:
+        return True          # bare except:
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Attribute):
+        return type_node.attr in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(el) for el in type_node.elts)
+    return False
+
+
+def check(project):
+    for mi in project.symbols.modules.values():
+        for node in ast.walk(mi.src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node.type):
+                continue
+            raises = any(isinstance(n, ast.Raise)
+                         for stmt in node.body
+                         for n in [stmt, *walk_scope(stmt)])
+            if raises:
+                continue
+            shown = (f"except {ast.unparse(node.type)}"
+                     if node.type is not None else "bare except:")
+            yield Finding(
+                RULE.id, RULE.severity, mi.path, node.lineno,
+                node.col_offset,
+                f"broad `{shown}` swallows the fault (no "
+                f"raise in handler) — narrow to the exceptions this "
+                f"path can actually recover from, or mark recovery "
+                f"code with # hvd: disable=HVD006(reason)")

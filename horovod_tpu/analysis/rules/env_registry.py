@@ -1,0 +1,188 @@
+"""HVD005: HVD_*/HOROVOD_* environment reads outside the registry.
+
+`horovod_tpu/runtime/config.py` is the single source of truth for
+every environment knob: each variable is declared with
+``register_knob(...)`` (name, type, default, consumer, doc — the
+generated docs/troubleshooting.md table) and consumed through the
+``env_str``/``env_int``/``env_float``/``env_raw`` accessors, which
+refuse undeclared names at runtime. A raw ``os.environ`` read of an
+``HVD_*``/``HOROVOD_*`` name anywhere else creates an undocumented,
+untabulated knob that silently drifts — this rule flags:
+
+* ``os.environ.get("HVD_X")`` / ``os.environ["HVD_X"]`` /
+  ``os.getenv("HVD_X")`` / ``"HVD_X" in os.environ`` outside the
+  registry module — including reads through a local ``env =
+  os.environ`` alias and through ``from os import environ, getenv``
+  bindings (any alias). Alias tracking is LEXICALLY SCOPED: an alias
+  is visible in its own scope and nested defs, a parameter shadows
+  it (a mapping argument that merely shares the name ``env`` is not
+  os.environ). Writes/deletes are NOT flagged (arming a knob
+  in-process sets the environment, it doesn't bypass the accessors);
+* ``env_str("HVD_X")``-style accessor calls whose literal name is not
+  declared in the registry (the static twin of the runtime KeyError).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from horovod_tpu.analysis.core import (
+    Finding, RuleMeta, const_str, dotted_name, walk_scope,
+)
+
+RULE = RuleMeta(
+    id="HVD005",
+    name="unregistered-env-knob",
+    severity="error",
+    doc="os.environ read of an HVD_*/HOROVOD_* variable outside the "
+        "runtime/config.py knob registry (or an accessor call with an "
+        "undeclared name).")
+
+_KNOB_RE = re.compile(r"^(HVD_|HOROVOD_)")
+_REGISTRY_MODULE = "runtime/config.py"
+_ACCESSORS = {"env_str", "env_int", "env_float", "env_raw"}
+
+
+def _registered_names(project) -> set:
+    """Knob names harvested from register_knob("NAME", ...) calls in
+    the registry module's AST. When the registry module is not part of
+    the analyzed file set (subtree runs), fall back to the installed
+    live registry so accessor calls against real knobs don't produce
+    phantom findings."""
+    out = set()
+    saw_registry = False
+    for mi in project.symbols.modules.values():
+        if not mi.path.endswith(_REGISTRY_MODULE):
+            continue
+        saw_registry = True
+        for node in ast.walk(mi.src.tree):
+            if (isinstance(node, ast.Call)
+                    and (dotted_name(node.func) or "").split(".")[-1]
+                    == "register_knob" and node.args):
+                name = const_str(node.args[0])
+                if name:
+                    out.add(name)
+    if not saw_registry:
+        try:
+            from horovod_tpu.runtime.config import KNOBS
+            out |= set(KNOBS)
+        except ImportError:  # analyzing a foreign tree — static only
+            pass
+    return out
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+
+
+def _scope_aliases(scope, environs, getenvs) -> tuple:
+    """Aliases visible inside ``scope``: the inherited sets minus
+    names shadowed by the scope's own parameters (a mapping parameter
+    that merely SHARES a name with an alias elsewhere is not
+    os.environ), plus plain-assignment aliases bound in this scope's
+    body (``env = os.environ``, ``g = os.getenv``, chained to a
+    fixpoint)."""
+    if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+        a = scope.args
+        params = ({p.arg for p in a.posonlyargs}
+                  | {p.arg for p in a.args}
+                  | {p.arg for p in a.kwonlyargs}
+                  | ({a.vararg.arg} if a.vararg else set())
+                  | ({a.kwarg.arg} if a.kwarg else set()))
+        environs = environs - params
+        getenvs = getenvs - params
+    else:
+        environs, getenvs = set(environs), set(getenvs)
+    changed = True
+    while changed:
+        changed = False
+        for node in walk_scope(scope):
+            if not isinstance(node, ast.Assign):
+                continue
+            src = dotted_name(node.value) or ""
+            tgts = {t.id for t in node.targets
+                    if isinstance(t, ast.Name)}
+            if src == "os.environ" or src in environs:
+                if tgts - environs:
+                    environs |= tgts
+                    changed = True
+            elif src == "os.getenv" or src in getenvs:
+                if tgts - getenvs:
+                    getenvs |= tgts
+                    changed = True
+    return environs, getenvs
+
+
+def check(project):
+    registered = _registered_names(project)
+    for mi in project.symbols.modules.values():
+        if mi.path.endswith(_REGISTRY_MODULE):
+            continue
+        environs, getenvs = set(), set()
+        for local, (mod, orig) in mi.from_imports.items():
+            if mod == "os" and orig == "environ":
+                environs.add(local)
+            elif mod == "os" and orig == "getenv":
+                getenvs.add(local)
+        yield from _scan_scope(mi, mi.src.tree, environs, getenvs,
+                               registered)
+
+
+def _scan_scope(mi, scope, environs, getenvs, registered):
+    environs, getenvs = _scope_aliases(scope, environs, getenvs)
+    for node in walk_scope(scope):
+            name = None
+            kind = None
+            if isinstance(node, ast.Call):
+                fn = dotted_name(node.func) or ""
+                leaf = fn.split(".")[-1]
+                base = fn.rsplit(".", 1)[0] if "." in fn else ""
+                if ((fn in ("os.environ.get", "os.getenv")
+                     or (leaf == "get" and base in environs)
+                     or fn in getenvs)
+                        and node.args):
+                    name = const_str(node.args[0])
+                    kind = "raw os.environ read"
+                elif leaf in _ACCESSORS and node.args:
+                    nm = const_str(node.args[0])
+                    if nm and nm not in registered:
+                        yield Finding(
+                            RULE.id, RULE.severity, mi.path,
+                            node.lineno, node.col_offset,
+                            f"env knob {nm!r} read via {leaf}() but "
+                            f"never declared with register_knob() in "
+                            f"horovod_tpu/runtime/config.py")
+                    continue
+            elif isinstance(node, ast.Compare):
+                # `"HVD_X" in os.environ` — the presence-flag read
+                # pattern; use env_raw(...) is not None instead.
+                if (len(node.ops) == 1
+                        and isinstance(node.ops[0], (ast.In, ast.NotIn))):
+                    base = dotted_name(node.comparators[0]) or ""
+                    if base == "os.environ" or base in environs:
+                        name = const_str(node.left)
+                        kind = "os.environ membership test"
+            elif (isinstance(node, ast.Subscript)
+                  and isinstance(node.ctx, ast.Load)):
+                # Load context only: writes/deletes (arming a knob
+                # in-process) SET the environment, they don't bypass
+                # the registry's read accessors.
+                base = dotted_name(node.value) or ""
+                if (base == "os.environ"
+                        or base in environs):
+                    name = const_str(node.slice)
+                    kind = "raw os.environ read"
+            if name and _KNOB_RE.match(name):
+                yield Finding(
+                    RULE.id, RULE.severity, mi.path, node.lineno,
+                    node.col_offset,
+                    f"{kind} of {name!r} outside the "
+                    f"runtime/config.py knob registry — declare it "
+                    f"with register_knob() and read it via "
+                    f"env_str/env_int/env_float")
+    for node in walk_scope(scope):
+        if isinstance(node, _SCOPE_NODES):
+            yield from _scan_scope(mi, node, environs, getenvs,
+                                   registered)

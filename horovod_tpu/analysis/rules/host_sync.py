@@ -1,0 +1,138 @@
+"""HVD001: host synchronization inside the serving/decode hot path.
+
+The PR-3 pipelining win (host_syncs_per_token 0.279 -> 0.034) rests on
+ONE exposed device->host sync per request: the dispatch thread queues
+tick N+1 before reading tick N. A single stray ``.item()`` /
+``np.asarray`` / ``block_until_ready`` on a device value anywhere in
+that path silently re-serializes the ring — the device idles while the
+host blocks, every tick. This rule walks the call graph from every
+``@hot_path``-annotated entry (`horovod_tpu.annotations.hot_path`) and
+flags the sync patterns inside the reachable set:
+
+* ``x.item()`` / ``x.tolist()`` / ``x.block_until_ready()``
+* ``np.asarray(x)`` / ``np.array(x)`` / ``jax.device_get(x)`` —
+  through module aliases AND bare-name from-imports (any alias)
+* ``int(x)`` / ``float(x)`` / ``bool(x)`` where ``x`` was produced by
+  a known ``jax.jit``-compiled callee (local value taint)
+
+Designed sync points (e.g. the pipelined ``tick_sync`` read itself)
+carry a reasoned ``# hvd: disable=HVD001(...)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from horovod_tpu.analysis.core import Finding, RuleMeta, dotted_name
+
+RULE = RuleMeta(
+    id="HVD001",
+    name="host-sync-in-hot-path",
+    severity="error",
+    doc="Device->host synchronization reachable from a @hot_path "
+        "entry point re-serializes the pipelined decode ring.")
+
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_NUMPY_MODULES = {"numpy"}
+_NUMPY_FUNCS = {"asarray", "array", "copy"}
+_CASTS = {"int", "float", "bool"}
+
+
+def _numpy_alias_map(mi):
+    """Local aliases of the numpy module in this file ('np', ...)."""
+    return {alias for alias, dotted in mi.module_aliases.items()
+            if dotted in _NUMPY_MODULES}
+
+
+def _from_import_syncs(mi) -> dict:
+    """{local name: message} for host-sync functions bound as bare
+    names — ``from numpy import asarray``, ``from jax import
+    device_get`` (any alias)."""
+    out = {}
+    for local, (mod, orig) in mi.from_imports.items():
+        if mod in _NUMPY_MODULES and orig in _NUMPY_FUNCS:
+            out[local] = (f"{mod}.{orig}() copies device memory to "
+                          f"host")
+        elif mod == "jax" and orig == "device_get":
+            out[local] = "jax.device_get() blocks on a device value"
+    return out
+
+
+def _jit_tainted_locals(fi, table, mi, ci) -> set:
+    """Names assigned (incl. tuple-unpacked) from calls to known
+    jit-compiled callees within this function."""
+    tainted = set()
+    for node in ast.walk(fi.node):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        callees = table.resolve_call(mi, ci, node.value)
+        fi0 = callees[0] if callees else None
+        if not table.is_jit_callee(fi0, mi, node.value):
+            continue
+        for tgt in node.targets:
+            elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+            for el in elts:
+                if isinstance(el, ast.Name):
+                    tainted.add(el.id)
+    return tainted
+
+
+def _root_name(node: ast.AST):
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def check(project):
+    table = project.symbols
+    reach = table.hot_reachable()
+    for qname in sorted(reach):
+        fi, entry = reach[qname]
+        mi = table.modules[fi.module]
+        ci = mi.classes.get(fi.cls) if fi.cls else None
+        np_aliases = _numpy_alias_map(mi)
+        import_syncs = _from_import_syncs(mi)
+        tainted = _jit_tainted_locals(fi, table, mi, ci)
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            msg = None
+            fn = node.func
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in _SYNC_METHODS):
+                msg = f".{fn.attr}() blocks on a device value"
+            elif isinstance(fn, ast.Attribute):
+                base = fn.value
+                if (isinstance(base, ast.Name)
+                        and base.id in np_aliases
+                        and fn.attr in _NUMPY_FUNCS):
+                    msg = (f"{base.id}.{fn.attr}() copies device "
+                           f"memory to host")
+                elif (fn.attr == "device_get"
+                      and isinstance(base, ast.Name)
+                      and (base.id == "jax"
+                           or mi.module_aliases.get(base.id)
+                           == "jax")):
+                    msg = "jax.device_get() blocks on a device value"
+            elif isinstance(fn, ast.Name) and fn.id in import_syncs:
+                msg = import_syncs[fn.id]
+            elif (isinstance(fn, ast.Name) and fn.id in _CASTS
+                  and node.args):
+                arg = node.args[0]
+                root = _root_name(arg)
+                if (root in tainted
+                        or (isinstance(arg, ast.Call)
+                            and table.is_jit_callee(
+                                (table.resolve_call(mi, ci, arg)
+                                 or [None])[0], mi, arg))):
+                    msg = (f"{fn.id}() forces a device->host read "
+                           f"of a jit-produced value")
+            if msg is not None:
+                yield Finding(
+                    RULE.id, RULE.severity, fi.src.path, node.lineno,
+                    node.col_offset,
+                    f"host sync in hot path: {msg} inside "
+                    f"{fi.qname.split(':')[1]} (reachable from "
+                    f"@hot_path {entry})")

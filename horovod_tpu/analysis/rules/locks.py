@@ -1,0 +1,161 @@
+"""HVD004: inconsistent lock discipline on shared attributes.
+
+The serving engine, watchdog, and stall monitor synchronize by hand
+(`threading.Lock` attributes + ``with self._lock:`` blocks). The bug
+class that survives review is *mixed* discipline: an attribute
+mutated under the lock in one method and bare in another — the bare
+write races every reader that trusted the lock. For each class that
+owns a lock attribute, this rule collects every mutation of every
+``self.<attr>`` (assignments, augmented assignments, and mutating
+container calls like ``.append()``/``.pop()``/``.clear()``), classes
+them guarded/unguarded by lexical ``with self.<lock>`` enclosure, and
+flags the unguarded sites of any attribute that is ALSO mutated under
+the lock. ``__init__`` is exempt (construction happens-before
+publication).
+
+Single-owner attributes that a lock only brackets for a handoff
+window (the scheduler's dispatch-thread containers) carry reasoned
+suppressions — see docs/analysis.md.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from horovod_tpu.analysis.core import Finding, RuleMeta, dotted_name
+
+RULE = RuleMeta(
+    id="HVD004",
+    name="lock-discipline",
+    severity="warning",
+    doc="Attribute mutated both inside and outside `with self.<lock>` "
+        "blocks across a class's methods — the unguarded writes race "
+        "readers that trust the lock.")
+
+_LOCK_TYPES = {"Lock", "RLock", "Condition"}
+_MUTATORS = {"append", "appendleft", "extend", "insert", "add",
+             "remove", "discard", "clear", "pop", "popleft", "popitem",
+             "update", "setdefault", "sort", "reverse"}
+
+
+def _lock_attrs(ci) -> set:
+    """self attributes assigned a threading.Lock/RLock/Condition in
+    __init__."""
+    init = ci.methods.get("__init__")
+    if init is None:
+        return set()
+    out = set()
+    for node in ast.walk(init.node):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        fn = dotted_name(node.value.func) or ""
+        if fn.split(".")[-1] not in _LOCK_TYPES:
+            continue
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                out.add(tgt.attr)
+    return out
+
+
+def _self_attr_of(node: ast.AST):
+    """The X of a self.X[...]... target/receiver chain, else None."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _mutations(method, locks) -> List[Tuple[str, bool, ast.AST, str]]:
+    """[(attr, guarded, node, how)] for one method body; `guarded` is
+    lexical enclosure in `with self.<lock>:` for any class lock —
+    including locks first bound to a local (``lock = self._lock;
+    with lock:``)."""
+    out = []
+    aliases = set()
+    for node in ast.walk(method.node):
+        if (isinstance(node, ast.Assign)
+                and _self_attr_of(node.value) in locks):
+            aliases |= {t.id for t in node.targets
+                        if isinstance(t, ast.Name)}
+
+    def _holds_lock(expr) -> bool:
+        if _self_attr_of(expr) in locks:
+            return True
+        return isinstance(expr, ast.Name) and expr.id in aliases
+
+    def visit(node, guarded):
+        if isinstance(node, ast.With):
+            holds = any(
+                _holds_lock(item.context_expr)
+                for item in node.items)
+            for child in node.body:
+                visit(child, guarded or holds)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            tgts = (node.targets if isinstance(node, ast.Assign)
+                    else [node.target])
+            for tgt in tgts:
+                elts = (tgt.elts if isinstance(tgt, ast.Tuple)
+                        else [tgt])
+                for el in elts:
+                    attr = _self_attr_of(el)
+                    if attr is not None and attr not in locks:
+                        out.append((attr, guarded, el, "assignment"))
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in _MUTATORS):
+                attr = _self_attr_of(fn.value)
+                if attr is not None and attr not in locks:
+                    out.append((attr, guarded, node,
+                                f".{fn.attr}() call"))
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                visit(child, guarded)
+
+    for stmt in method.node.body:
+        visit(stmt, False)
+    return out
+
+
+def check(project):
+    table = project.symbols
+    for mi in table.modules.values():
+        for ci in mi.classes.values():
+            locks = _lock_attrs(ci)
+            if not locks:
+                continue
+            per_attr: Dict[str, Dict[bool, list]] = {}
+            for mname, method in ci.methods.items():
+                if mname == "__init__":
+                    continue
+                for attr, guarded, node, how in _mutations(method,
+                                                           locks):
+                    per_attr.setdefault(attr, {True: [], False: []})[
+                        guarded].append((node, how, mname))
+            for attr in sorted(per_attr):
+                sites = per_attr[attr]
+                if not sites[True] or not sites[False]:
+                    continue   # consistent discipline (or lock-free)
+                for node, how, mname in sites[False]:
+                    yield Finding(
+                        RULE.id, RULE.severity, ci.src.path,
+                        node.lineno, node.col_offset,
+                        f"self.{attr} mutated without the lock in "
+                        f"{ci.name}.{mname} ({how}) but under "
+                        f"`with self.<lock>` elsewhere in the class "
+                        f"— unguarded writes race lock-trusting "
+                        f"readers")

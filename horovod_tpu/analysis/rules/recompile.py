@@ -1,0 +1,137 @@
+"""HVD003: recompilation hazards at jit call sites.
+
+XLA compiles are the silent regression TPU-pod papers keep
+rediscovering (Scale MLPerf-0.6, arXiv:1909.09756): a program that
+retraces per step is 10-100x slower and looks healthy. Three
+statically visible hazard shapes:
+
+* **jit-and-discard** — ``jax.jit(f)(x)`` inside a function body: the
+  wrapper (and its compile cache entry's home) dies with the call, so
+  every invocation of the enclosing function retraces. Hoist the
+  wrapper to module scope or cache it. (One-shot setup/probe sites
+  carry a reasoned suppression.)
+* **varying Python scalar** — a loop-variable (or arithmetic on one)
+  passed as a traced argument to a known jit-compiled function: every
+  distinct Python scalar is a new constant in the trace => a new
+  compile per iteration. Pass it as a device array (``jnp.int32(i)``)
+  or mark it static deliberately.
+* **non-hashable static** — a list/dict/set literal passed for a
+  ``static_argnames``/``static_argnums`` parameter raises
+  ``TypeError: unhashable`` at call time.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from horovod_tpu.analysis.core import Finding, RuleMeta, dotted_name
+from horovod_tpu.analysis.symbols import JIT_NAMES
+
+RULE = RuleMeta(
+    id="HVD003",
+    name="recompilation-hazard",
+    severity="warning",
+    doc="jit call sites that retrace per call: discarded jit "
+        "wrappers, loop-varying Python scalars, non-hashable static "
+        "arguments.")
+
+def _loop_vars(fn_node) -> dict:
+    """{name: for-node} for loop targets iterating range/enumerate
+    within this function scope."""
+    out = {}
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.For):
+            it = node.iter
+            fn = (dotted_name(it.func)
+                  if isinstance(it, ast.Call) else None)
+            if fn not in ("range", "enumerate"):
+                continue
+            tgts = (node.target.elts
+                    if isinstance(node.target, ast.Tuple)
+                    else [node.target])
+            for t in tgts:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node
+    return out
+
+
+def _names_in(node) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _inside(loop: ast.For, node) -> bool:
+    """Lexically within the loop body — a use AFTER the loop sees one
+    final value and compiles once, which is not a hazard."""
+    return (loop.lineno <= node.lineno
+            <= (loop.end_lineno or loop.lineno))
+
+
+def _is_scalar_expr(node) -> bool:
+    """Bare name or arithmetic over names/constants — the shapes that
+    smuggle a varying Python scalar into a trace. A Call (e.g.
+    ``jnp.int32(i)``) is a conversion and passes."""
+    if isinstance(node, ast.Name):
+        return True
+    if isinstance(node, ast.BinOp):
+        return _is_scalar_expr(node.left) and _is_scalar_expr(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _is_scalar_expr(node.operand)
+    if isinstance(node, ast.Constant):
+        return True
+    return False
+
+
+def check(project):
+    table = project.symbols
+    for fi in table.all_functions():
+        mi = table.modules[fi.module]
+        ci = mi.classes.get(fi.cls) if fi.cls else None
+        loops = _loop_vars(fi.node)
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            # (a) jit-and-discard: jax.jit(f)(...) in a function body.
+            if (isinstance(node.func, ast.Call)
+                    and dotted_name(node.func.func) in JIT_NAMES):
+                yield Finding(
+                    RULE.id, RULE.severity, fi.src.path, node.lineno,
+                    node.col_offset,
+                    f"jit wrapper created and discarded per call of "
+                    f"{fi.qname.split(':')[1]} — every invocation "
+                    f"retraces; hoist jax.jit to module scope or "
+                    f"cache the wrapper")
+                continue
+            callees = table.resolve_call(mi, ci, node)
+            callee = callees[0] if callees else None
+            if not table.is_jit_callee(callee, mi, node):
+                continue
+            static = callee.static_params if callee else set()
+            params = callee.param_names() if callee else []
+            for idx, arg in enumerate(node.args):
+                pname = params[idx] if idx < len(params) else None
+                # (c) non-hashable static argument.
+                if pname in static and isinstance(
+                        arg, (ast.List, ast.Dict, ast.Set)):
+                    yield Finding(
+                        RULE.id, RULE.severity, fi.src.path,
+                        arg.lineno, arg.col_offset,
+                        f"non-hashable {type(arg).__name__.lower()} "
+                        f"literal passed for static parameter "
+                        f"{pname!r} of {callee.name} — jit static "
+                        f"args must be hashable")
+                    continue
+                if pname in static:
+                    continue
+                # (b) loop-varying Python scalar as traced arg.
+                hot = {v for v in _names_in(arg) & loops.keys()
+                       if _inside(loops[v], node)}
+                if _is_scalar_expr(arg) and hot:
+                    var = sorted(hot)[0]
+                    yield Finding(
+                        RULE.id, RULE.severity, fi.src.path,
+                        arg.lineno, arg.col_offset,
+                        f"loop variable {var!r} passed as a traced "
+                        f"Python scalar to jit-compiled "
+                        f"{getattr(callee, 'name', dotted_name(node.func))}"
+                        f" — each iteration recompiles; wrap it "
+                        f"(jnp.int32(...)) or mark it static")

@@ -1,0 +1,212 @@
+"""HVD002: Python control flow on traced values inside compiled code.
+
+Inside a ``jax.jit`` / ``vmap`` / ``shard_map``-compiled function the
+arguments are tracers: a Python ``if``/``while``/``assert`` (or a
+ternary) on a value *derived from a traced parameter* either raises
+``TracerBoolConversionError`` at trace time or — worse, when the
+branch happens to be constant-foldable — silently bakes one branch
+into the compiled program. The repo's compiled functions keep control
+flow in ``lax.cond`` / ``jnp.where`` / masks; this rule keeps it that
+way.
+
+Static structure is fine and NOT flagged: tests on ``x.shape`` /
+``x.ndim`` / ``x.dtype`` / ``len(x)``, ``is None`` / ``is not None``
+comparisons, ``isinstance``, and parameters named in
+``static_argnames`` / ``static_argnums``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from horovod_tpu.analysis.core import (Finding, RuleMeta, dotted_name,
+                                       walk_scope)
+from horovod_tpu.analysis.symbols import (JIT_NAMES, FunctionInfo,
+                                          _static_params)
+
+RULE = RuleMeta(
+    id="HVD002",
+    name="trace-unsafe-control-flow",
+    severity="error",
+    doc="Python if/while/assert on a traced value inside a "
+        "jit/vmap/shard_map-compiled function fails (or silently "
+        "specializes) at trace time.")
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_STATIC_CALLS = {"len", "isinstance", "callable", "hasattr", "getattr",
+                 "type"}
+
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _taint(scope_node, names: set) -> set:
+    """Extend ``names`` with locals derived from them by plain
+    assignment within this scope — but an assignment that touches
+    traced names only through static structure (``n = x.shape[0]``,
+    ``d = x.dtype``) binds a PYTHON value, not a tracer, and must not
+    taint (same benign set the test check below uses)."""
+    names = set(names)
+    changed = True
+    while changed:
+        changed = False
+        for node in walk_scope(scope_node):
+            if isinstance(node, ast.Assign):
+                if not _offending_names(node.value, names):
+                    continue
+                for tgt in node.targets:
+                    elts = (tgt.elts if isinstance(tgt, ast.Tuple)
+                            else [tgt])
+                    for el in elts:
+                        if (isinstance(el, ast.Name)
+                                and el.id not in names):
+                            names.add(el.id)
+                            changed = True
+    return names
+
+
+def _offending_names(test: ast.AST, traced: set) -> set:
+    """Traced names referenced by ``test`` in a value position (not
+    under a static attribute / len / is-None comparison)."""
+    bad = set()
+
+    def visit(node, benign=False):
+        if isinstance(node, ast.Name):
+            if node.id in traced and not benign:
+                bad.add(node.id)
+            return
+        if isinstance(node, ast.Attribute):
+            # x.shape / x.ndim / ... : the whole chain is static.
+            visit(node.value, benign=benign
+                  or node.attr in _STATIC_ATTRS)
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            static_call = (isinstance(fn, ast.Name)
+                           and fn.id in _STATIC_CALLS)
+            for child in ast.iter_child_nodes(node):
+                visit(child, benign=benign or static_call)
+            return
+        if isinstance(node, ast.Compare):
+            ops_none = all(
+                isinstance(op, (ast.Is, ast.IsNot))
+                for op in node.ops) and all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators)
+            for child in ast.iter_child_nodes(node):
+                visit(child, benign=benign or ops_none)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, benign=benign)
+
+    visit(test)
+    return bad
+
+
+def _nested_traced_params(fi, nested, traced) -> set:
+    """Which of a nested def's params are tracers. A nested def handed
+    to a combinator (``lax.scan(body, ...)`` — its NAME referenced as
+    an argument) receives tracers on every param; one only ever CALLED
+    directly (``helper(3)``) receives whatever each call site passes,
+    so taint params positionally from the direct calls instead of
+    blanket-marking them (a static ``helper(n)`` branch is
+    trace-safe). Lambdas and un-referenced defs stay conservative."""
+    if isinstance(nested, ast.Lambda):
+        return {p.arg for p in nested.args.args}
+    params = [p.arg for p in nested.args.args]
+    direct_calls = []
+    for node in ast.walk(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        if (isinstance(node.func, ast.Name)
+                and node.func.id == nested.name):
+            direct_calls.append(node)
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if any(isinstance(n, ast.Name) and n.id == nested.name
+                   for n in ast.walk(arg)):
+                return set(params)      # passed as a callback
+    if not direct_calls:
+        return set(params)              # never referenced: stay safe
+    out = set()
+    for call in direct_calls:
+        for idx, arg in enumerate(call.args):
+            if idx < len(params) and _offending_names(arg, traced):
+                out.add(params[idx])
+        for kw in call.keywords:
+            if kw.arg in params and _offending_names(kw.value, traced):
+                out.add(kw.arg)
+    return out
+
+
+def _scan_scope(fi, scope_node, traced):
+    """Flag control flow on ``traced`` within one scope, then recurse
+    into nested defs/lambdas with THEIR traced params added — a nested
+    body closes over tracers and runs under the trace (vmapped/scanned
+    bodies), but its param names must NOT leak into the enclosing
+    scope, where an unrelated static local may share the name."""
+    traced = _taint(scope_node, traced)
+    for node in walk_scope(scope_node):
+        if isinstance(node, (ast.If, ast.While)):
+            test, kind = node.test, type(node).__name__.lower()
+        elif isinstance(node, ast.Assert):
+            test, kind = node.test, "assert"
+        elif isinstance(node, ast.IfExp):
+            test, kind = node.test, "ternary"
+        else:
+            continue
+        bad = _offending_names(test, traced)
+        if bad:
+            yield Finding(
+                RULE.id, RULE.severity, fi.src.path, node.lineno,
+                node.col_offset,
+                f"Python {kind} on traced value(s) "
+                f"{', '.join(sorted(bad))} inside "
+                f"{fi.jit_kind}-compiled "
+                f"{fi.qname.split(':')[1]} — use lax.cond / "
+                f"jnp.where or mark the argument static")
+    for node in walk_scope(scope_node):
+        if isinstance(node, _SCOPES):
+            # Params SHADOW closure names: drop them from the outer
+            # set before adding the ones that actually carry tracers.
+            params = {p.arg for p in node.args.args}
+            inner = ((traced - params)
+                     | _nested_traced_params(fi, node, traced))
+            yield from _scan_scope(fi, node, inner)
+
+
+def _local_jit_defs(fi):
+    """Nested defs jit-compiled inside a NON-jit function body —
+    ``step = jax.jit(step)`` / ``jax.jit(step, ...)(x)`` — run traced
+    exactly like decorated ones (the repo's factory functions build
+    their train/eval steps this way). Yields a FunctionInfo view per
+    wrapped def, with statics taken from the jit call's keywords."""
+    defs = {n.name: n for n in ast.walk(fi.node)
+            if isinstance(n, ast.FunctionDef) and n is not fi.node}
+    seen = set()
+    for node in ast.walk(fi.node):
+        if (isinstance(node, ast.Call)
+                and dotted_name(node.func) in JIT_NAMES
+                and node.args and isinstance(node.args[0], ast.Name)
+                and node.args[0].id in defs
+                and node.args[0].id not in seen):
+            seen.add(node.args[0].id)
+            inner = defs[node.args[0].id]
+            pseudo = FunctionInfo(fi.module, inner.name, fi.cls,
+                                  inner, fi.src)
+            pseudo.jit_kind = pseudo.jit_kind or "jit"
+            kwargs = {kw.arg: kw.value for kw in node.keywords
+                      if kw.arg}
+            pseudo.static_params |= _static_params(inner, kwargs)
+            yield pseudo
+
+
+def check(project):
+    for fi in project.symbols.all_functions():
+        # The symbol table marks module-level `f = jax.jit(g)` targets
+        # with jit_kind, so alias-wrapped functions land here too.
+        targets = ([fi] if fi.jit_kind is not None
+                   else _local_jit_defs(fi))
+        for t in targets:
+            seed = set(t.param_names()) - t.static_params - {"self"}
+            yield from _scan_scope(t, t.node, seed)

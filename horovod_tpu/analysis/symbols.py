@@ -1,0 +1,315 @@
+"""hvdlint cross-file symbol table.
+
+Indexes every module-level function, class, and method across the
+analyzed file set, resolves decorators (``@hot_path`` markers,
+``jax.jit`` / ``functools.partial(jax.jit, ...)`` / ``vmap`` /
+``shard_map`` wrappers plus their static argument sets), records each
+module's import aliases, and builds a conservative call graph:
+
+* ``name(...)``            -> same-module function, else a
+  ``from m import name`` target resolved into the analyzed set;
+* ``alias.attr(...)``      -> module-alias resolution (``import m as
+  alias`` / ``from pkg import m``);
+* ``self.attr(...)``       -> the enclosing class's method;
+* ``anything.attr(...)``   -> the UNION of every analyzed class's
+  method named ``attr`` (receiver types are not inferred — for
+  reachability analysis over-approximation is the safe direction).
+
+`hot_reachable()` runs BFS from every ``@hot_path``-annotated function
+— the HVD001 universe.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from horovod_tpu.analysis.core import SourceFile, dotted_name
+
+JIT_NAMES = {"jax.jit", "jit"}
+_VMAP_NAMES = {"jax.vmap", "vmap"}
+_SHARD_MAP_NAMES = {"jax.shard_map", "shard_map",
+                    "jax.experimental.shard_map.shard_map"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+class FunctionInfo:
+    """One function or method definition."""
+
+    def __init__(self, module: str, name: str, cls: Optional[str],
+                 node: ast.FunctionDef, src: SourceFile):
+        self.module = module          # relpath of the defining file
+        self.name = name
+        self.cls = cls                # class name or None
+        self.node = node
+        self.src = src
+        self.qname = (f"{module}:{cls}.{name}" if cls
+                      else f"{module}:{name}")
+        self.hot_entry = False
+        self.jit_kind: Optional[str] = None   # "jit"|"vmap"|"shard_map"
+        self.static_params: Set[str] = set()
+        self._analyze_decorators()
+
+    def _analyze_decorators(self):
+        for dec in self.node.decorator_list:
+            target, kwargs = _unwrap_decorator(dec)
+            if target is None:
+                continue
+            if target.split(".")[-1] == "hot_path":
+                self.hot_entry = True
+            elif target in JIT_NAMES:
+                self.jit_kind = "jit"
+                self.static_params |= _static_params(self.node, kwargs)
+            elif target in _VMAP_NAMES:
+                self.jit_kind = self.jit_kind or "vmap"
+            elif target in _SHARD_MAP_NAMES:
+                self.jit_kind = self.jit_kind or "shard_map"
+
+    def param_names(self) -> List[str]:
+        a = self.node.args
+        return ([p.arg for p in a.posonlyargs] +
+                [p.arg for p in a.args] +
+                [p.arg for p in a.kwonlyargs])
+
+
+def _unwrap_decorator(dec: ast.AST) -> Tuple[Optional[str], dict]:
+    """(dotted target, keyword dict) for a decorator expression.
+    ``@functools.partial(jax.jit, static_argnames=..)`` unwraps to
+    ``jax.jit`` with partial's keywords; ``@jax.jit(donate..=..)``
+    keeps its own keywords."""
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func)
+        kwargs = {kw.arg: kw.value for kw in dec.keywords if kw.arg}
+        if fn in _PARTIAL_NAMES and dec.args:
+            inner = dotted_name(dec.args[0])
+            return inner, kwargs
+        return fn, kwargs
+    return dotted_name(dec), {}
+
+
+def _static_params(node: ast.FunctionDef, kwargs: dict) -> Set[str]:
+    """Parameter names named static by static_argnames/static_argnums
+    keywords (literal strings / ints / tuples thereof only)."""
+    out: Set[str] = set()
+    params = ([p.arg for p in node.args.posonlyargs] +
+              [p.arg for p in node.args.args])
+    names = kwargs.get("static_argnames")
+    if names is not None:
+        for el in (names.elts if isinstance(names, (ast.Tuple, ast.List))
+                   else [names]):
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.add(el.value)
+    nums = kwargs.get("static_argnums")
+    if nums is not None:
+        for el in (nums.elts if isinstance(nums, (ast.Tuple, ast.List))
+                   else [nums]):
+            if (isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)
+                    and 0 <= el.value < len(params)):
+                out.add(params[el.value])
+    return out
+
+
+class ClassInfo:
+    def __init__(self, module: str, name: str, node: ast.ClassDef,
+                 src: SourceFile):
+        self.module = module
+        self.name = name
+        self.node = node
+        self.src = src
+        self.methods: Dict[str, FunctionInfo] = {}
+        self.bases = [dotted_name(b) for b in node.bases]
+
+
+class ModuleInfo:
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.path = src.path
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        # local alias -> imported module relpath-ish dotted name
+        self.module_aliases: Dict[str, str] = {}
+        # local name -> (module dotted name, original name)
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        # module-level names bound to jit wrappers: f = jax.jit(g)
+        self.jit_aliases: Dict[str, Optional[str]] = {}
+        # alias -> the jax.jit(...) Call node (for its static_arg* kws)
+        self._jit_alias_calls: Dict[str, ast.Call] = {}
+        self._index()
+
+    def _index(self):
+        for node in self.src.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self.functions[node.name] = FunctionInfo(
+                    self.path, node.name, None, node, self.src)
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(self.path, node.name, node, self.src)
+                for sub in node.body:
+                    if isinstance(sub, ast.FunctionDef):
+                        ci.methods[sub.name] = FunctionInfo(
+                            self.path, sub.name, node.name, sub,
+                            self.src)
+                self.classes[node.name] = ci
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._index_import(node)
+            elif isinstance(node, ast.Assign):
+                self._index_assign(node)
+
+    def _index_import(self, node):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                self.module_aliases[local] = (alias.name if alias.asname
+                                              else alias.name.split(".")[0])
+        else:
+            mod = node.module or ""
+            for alias in node.names:
+                local = alias.asname or alias.name
+                # `from pkg import mod` can bind a module; record both
+                # interpretations — resolution tries module-alias
+                # first, then function import.
+                self.module_aliases.setdefault(
+                    local, f"{mod}.{alias.name}" if mod else alias.name)
+                self.from_imports[local] = (mod, alias.name)
+
+    def _index_assign(self, node: ast.Assign):
+        if (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and dotted_name(node.value.func) in JIT_NAMES):
+            inner = (dotted_name(node.value.args[0])
+                     if node.value.args else None)
+            self.jit_aliases[node.targets[0].id] = inner
+            if inner is not None:
+                self._jit_alias_calls[node.targets[0].id] = node.value
+
+
+class SymbolTable:
+    def __init__(self, files: List[SourceFile]):
+        self.modules: Dict[str, ModuleInfo] = {
+            f.path: ModuleInfo(f) for f in files}
+        # dotted module name (horovod_tpu.serving.slots) -> relpath
+        self.dotted_to_path: Dict[str, str] = {}
+        for path in self.modules:
+            dotted = path[:-3].replace("/", ".")
+            if dotted.endswith(".__init__"):
+                dotted = dotted[:-len(".__init__")]
+            self.dotted_to_path[dotted] = path
+        self.methods_by_name: Dict[str, List[FunctionInfo]] = {}
+        for mi in self.modules.values():
+            for ci in mi.classes.values():
+                for m in ci.methods.values():
+                    self.methods_by_name.setdefault(m.name, []).append(m)
+        # A module-level `step = jax.jit(_step, ...)` compiles _step
+        # exactly as the decorator form would: mark the wrapped def so
+        # HVD002 traces its params (HVD001/HVD003 already resolve
+        # these call sites via is_jit_callee).
+        for mi in self.modules.values():
+            for alias, call in mi._jit_alias_calls.items():
+                f = mi.functions.get(mi.jit_aliases[alias] or "")
+                if f is not None and f.jit_kind is None:
+                    f.jit_kind = "jit"
+                    kwargs = {kw.arg: kw.value
+                              for kw in call.keywords if kw.arg}
+                    f.static_params |= _static_params(f.node, kwargs)
+
+    # -- lookups ------------------------------------------------------
+
+    def all_functions(self):
+        for mi in self.modules.values():
+            yield from mi.functions.values()
+            for ci in mi.classes.values():
+                yield from ci.methods.values()
+
+    def module_by_dotted(self, dotted: str) -> Optional[ModuleInfo]:
+        path = self.dotted_to_path.get(dotted)
+        # Tolerate absolute dotted names whose prefix isn't in the
+        # analyzed set (e.g. analyzing a subtree).
+        if path is None:
+            for cand, p in self.dotted_to_path.items():
+                if cand.endswith("." + dotted) or cand == dotted:
+                    path = p
+                    break
+        return self.modules.get(path) if path else None
+
+    def is_jit_callee(self, fi_or_none, mi: ModuleInfo,
+                      call: ast.Call) -> bool:
+        """Is this call site invoking a known jit-compiled function —
+        a resolved @jit def, or a module-level ``f = jax.jit(g)``
+        alias?"""
+        if fi_or_none is not None and fi_or_none.jit_kind == "jit":
+            return True
+        name = dotted_name(call.func)
+        return bool(name and name in mi.jit_aliases)
+
+    def resolve_call(self, mi: ModuleInfo, cls: Optional[ClassInfo],
+                     call: ast.Call) -> List[FunctionInfo]:
+        fn = call.func
+        out: List[FunctionInfo] = []
+        if isinstance(fn, ast.Name):
+            f = mi.functions.get(fn.id)
+            if f is not None:
+                return [f]
+            if fn.id in mi.from_imports:
+                mod_dotted, orig = mi.from_imports[fn.id]
+                target = self.module_by_dotted(mod_dotted)
+                if target is not None:
+                    f = target.functions.get(orig)
+                    if f is not None:
+                        return [f]
+                    c = target.classes.get(orig)
+                    if c is not None and "__init__" in c.methods:
+                        return [c.methods["__init__"]]
+            c = mi.classes.get(fn.id)
+            if c is not None and "__init__" in c.methods:
+                return [c.methods["__init__"]]
+            return out
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            # self.method(...)
+            if (isinstance(base, ast.Name) and base.id == "self"
+                    and cls is not None):
+                m = cls.methods.get(fn.attr)
+                if m is not None:
+                    return [m]
+            # module_alias.func(...)
+            if isinstance(base, ast.Name):
+                dotted = mi.module_aliases.get(base.id)
+                if dotted is not None:
+                    target = self.module_by_dotted(dotted)
+                    if target is not None:
+                        f = target.functions.get(fn.attr)
+                        if f is not None:
+                            return [f]
+                        c = target.classes.get(fn.attr)
+                        if c is not None and "__init__" in c.methods:
+                            return [c.methods["__init__"]]
+            # anything.method(...): union over analyzed classes.
+            return list(self.methods_by_name.get(fn.attr, ()))
+        return out
+
+    # -- hot-path reachability ---------------------------------------
+
+    def hot_entries(self) -> List[FunctionInfo]:
+        return [f for f in self.all_functions() if f.hot_entry]
+
+    def hot_reachable(self) -> Dict[str, Tuple[FunctionInfo, str]]:
+        """{qname: (function, entry qname it is reachable from)} via
+        BFS over the call graph from every @hot_path entry. The entry
+        recorded is the lexicographically first one that reaches the
+        function (deterministic messages)."""
+        reach: Dict[str, Tuple[FunctionInfo, str]] = {}
+        for entry in sorted(self.hot_entries(),
+                            key=lambda f: f.qname):
+            todo = [entry]
+            while todo:
+                fi = todo.pop()
+                if fi.qname in reach:
+                    continue
+                reach[fi.qname] = (fi, entry.qname)
+                mi = self.modules[fi.module]
+                ci = mi.classes.get(fi.cls) if fi.cls else None
+                for node in ast.walk(fi.node):
+                    if isinstance(node, ast.Call):
+                        todo.extend(self.resolve_call(mi, ci, node))
+        return reach

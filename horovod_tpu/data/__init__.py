@@ -248,6 +248,7 @@ class ShardedDataset:
                     build_data_loader(), files, self._rb, batch_size,
                     capacity, shuffle, seed, rank, world,
                     drop_remainder)
+            # hvd: disable=HVD006(native loader probe: any build/load fault degrades to the Python reader, loudly via the warning below)
             except Exception as e:
                 # Degrading silently would hide real misconfiguration
                 # behind a slow single-threaded path.

@@ -144,6 +144,7 @@ def init_cnn_state(model, tx: optax.GradientTransformation, rng,
 
     init is jitted: eager tracing dispatches every initializer op
     individually, which takes minutes for Inception-sized models."""
+    # hvd: disable=HVD003(one-shot model init at setup — jitted for tracing speed, not reused)
     variables = jax.jit(lambda r, x: model.init(r, x, train=False))(
         rng, sample_input)
     # Strip nn.Partitioned boxes (TP-annotated models like ViT): the

@@ -39,6 +39,7 @@ from jax.sharding import PartitionSpec as P
 
 import flax.linen as nn
 
+from horovod_tpu.annotations import hot_path
 from horovod_tpu.parallel.expert import MoELayer
 from horovod_tpu.parallel.mesh import (
     AXIS_DATA, AXIS_MODEL, AXIS_SEQ, constrain, use,
@@ -707,6 +708,7 @@ def init_lm_state(model: TransformerLM, tx: optax.GradientTransformation,
         return unbox(model.init(r, toks)["params"])
 
     with use(mesh):
+        # hvd: disable=HVD003(one-shot sharded param init at setup; out_shardings depends on the call's mesh)
         params = jax.jit(init_fn,
                          out_shardings=out_shardings)(rng)
         opt_state = init_opt_state_sharded(tx, params)
@@ -1023,6 +1025,7 @@ def slot_reset(dec_model, cache, slot):
         cache)
 
 
+@hot_path
 @functools.partial(jax.jit, static_argnames=("dec_model",),
                    donate_argnums=(2,))
 def slot_prefill_chunk(dec_model, params, cache, slot, chunk):
@@ -1119,6 +1122,7 @@ def _freeze_cache_indices(new_cache, old_cache, advance):
     return tree_unflatten(treedef, out)
 
 
+@hot_path
 @functools.partial(jax.jit, static_argnames=("dec_model",),
                    donate_argnums=(2,))
 def slot_decode_tick(dec_model, params, cache, toks, temps, top_ps,

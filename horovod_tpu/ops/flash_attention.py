@@ -45,7 +45,10 @@ try:  # pallas TPU backend (absent on some CPU-only builds)
     _compiler_params = lambda: pltpu.CompilerParams(  # noqa: E731
         dimension_semantics=("parallel", "parallel", "parallel",
                              "arbitrary"))
-except Exception:  # pragma: no cover
+except (ImportError, AttributeError):  # pragma: no cover
+    # ImportError: no pallas TPU backend in this build; AttributeError:
+    # a build old enough to lack VMEM/CompilerParams. Anything else is
+    # a real bug and must surface.
     pltpu = None
     _VMEM = None
     _compiler_params = lambda: None  # noqa: E731
@@ -317,8 +320,8 @@ def _sds(shape, dtype, *like):
     for x in like:
         try:
             vma |= jax.typeof(x).vma
-        except Exception:  # older jax / non-shard_map tracer
-            pass
+        except (AttributeError, TypeError):
+            pass   # older jax (no typeof/vma) / non-shard_map tracer
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)
@@ -869,8 +872,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         raise ValueError(
             f"bwd_impl must be auto|pallas|recompute, got {bwd_impl!r}")
     if bwd_impl == "auto":
-        import os
-        env = os.environ.get("HOROVOD_FLASH_BWD")
+        from horovod_tpu.runtime.config import env_raw
+        env = env_raw("HOROVOD_FLASH_BWD")
         if env is not None and env not in ("pallas", "recompute"):
             # The escape hatch must never silently select the kernel
             # being escaped (e.g. a typo'd "recompue").

@@ -54,11 +54,13 @@ def _combiner_override_supported() -> bool:
     global _COMBINER_OVERRIDE_OK
     if _COMBINER_OVERRIDE_OK is None:
         try:
+            # hvd: disable=HVD003(one-shot capability probe, cached in _COMBINER_OVERRIDE_OK for the process lifetime)
             jax.jit(lambda x: x + 0,
                     compiler_options={
                         "xla_disable_hlo_passes": _COMBINER_PASSES,
                     })(jnp.zeros(()))
             _COMBINER_OVERRIDE_OK = True
+        # hvd: disable=HVD006(capability probe: any failure shape — TypeError, XlaRuntimeError, repeated-field crash — means no override; warned below)
         except Exception:  # noqa: BLE001 — any failure means no override
             import sys
             sys.stderr.write(

@@ -150,6 +150,7 @@ def init_opt_state_sharded(tx, params: Any) -> Any:
             tx, jax.lax.with_sharding_constraint, state, shardings)
 
     try:
+        # hvd: disable=HVD003(one-shot optimizer-state init at setup; _init closes over this call's shardings)
         return jax.jit(_init)(params)
     except (ValueError, TypeError) as e:
         # Wrapper transforms whose state optax.tree_map_params cannot
@@ -165,6 +166,7 @@ def init_opt_state_sharded(tx, params: Any) -> Any:
             "be sharding-pinned (%s); falling back to bare tx.init — "
             "param-shaped optimizer slots (if any are unmasked) may "
             "materialize replicated", type(tx).__name__, e)
+        # hvd: disable=HVD003(one-shot fallback init for unsharddable optimizer states)
         return jax.jit(tx.init)(params)
 
 

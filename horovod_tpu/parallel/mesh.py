@@ -170,7 +170,7 @@ def auto_axis_names(mesh) -> set:
     from jax._src import core as _core
     try:
         manual = set(_core.get_axis_env().axis_sizes)
-    except Exception:  # pragma: no cover — axis env API drift
+    except (AttributeError, TypeError):  # pragma: no cover — API drift
         manual = set()
     return set(mesh.axis_names) - manual
 

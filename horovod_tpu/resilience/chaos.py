@@ -49,11 +49,12 @@ serving_deadline_storm  `serving/scheduler.py` — expires every queued
 from __future__ import annotations
 
 import contextlib
-import os
 import random
 import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional
+
+from horovod_tpu.runtime.config import env_int, env_str
 
 
 class ChaosError(RuntimeError):
@@ -241,10 +242,7 @@ def armed(spec: str, *, seed: int = 0):
 
 
 def _env_seed() -> int:
-    try:
-        return int(os.environ.get("HVD_CHAOS_SEED", "0"))
-    except ValueError:
-        return 0
+    return env_int("HVD_CHAOS_SEED", 0)
 
 
 def _init_from_env():
@@ -253,7 +251,7 @@ def _init_from_env():
     fails the import loudly with the offending field named (chaos
     that silently fails to arm would let a broken resilience drill
     pass green)."""
-    spec = os.environ.get("HVD_CHAOS", "")
+    spec = env_str("HVD_CHAOS")
     if spec:
         try:
             install(ChaosMonkey(spec, seed=_env_seed()))

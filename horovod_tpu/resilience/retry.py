@@ -98,9 +98,5 @@ class RetryPolicy:
 def default_io_policy() -> RetryPolicy:
     """The shared checkpoint/data-loading policy. ``HVD_IO_RETRIES``
     overrides the attempt count (0 disables retries entirely)."""
-    import os
-    try:
-        attempts = int(os.environ.get("HVD_IO_RETRIES", "3"))
-    except ValueError:
-        attempts = 3
-    return RetryPolicy(max_attempts=max(1, attempts))
+    from horovod_tpu.runtime.config import env_int
+    return RetryPolicy(max_attempts=max(1, env_int("HVD_IO_RETRIES", 3)))

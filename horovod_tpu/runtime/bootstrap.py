@@ -22,6 +22,7 @@ from __future__ import annotations
 import os
 from typing import Optional, Sequence
 
+from horovod_tpu.runtime import config as _config
 from horovod_tpu.runtime import state as _state
 from horovod_tpu.runtime.config import config
 
@@ -33,21 +34,32 @@ def _detect_process_env():
     coordinator) or None when not launched multi-process.
     """
     env = os.environ
-    for rank_var, size_var in (
-        ("HOROVOD_RANK", "HOROVOD_SIZE"),
-        ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
-        ("PMI_RANK", "PMI_SIZE"),
-    ):
-        if rank_var in env and size_var in env:
-            prank = int(env[rank_var])
-            psize = int(env[size_var])
-            lrank = int(env.get("HOROVOD_LOCAL_RANK",
-                                env.get("OMPI_COMM_WORLD_LOCAL_RANK", prank)))
-            lsize = int(env.get("HOROVOD_LOCAL_SIZE",
-                                env.get("OMPI_COMM_WORLD_LOCAL_SIZE", psize)))
-            coord = env.get("HOROVOD_COORDINATOR", "")
-            return prank, psize, lrank, lsize, coord
-    return None
+    # The HOROVOD_* pair reads through the registry accessors like
+    # every other knob; the OMPI/PMI names are foreign launcher
+    # fallbacks outside the registry's HVD_*/HOROVOD_* namespace and
+    # stay raw.
+    prank_s = _config.env_raw("HOROVOD_RANK")
+    psize_s = _config.env_raw("HOROVOD_SIZE")
+    if prank_s is None or psize_s is None:
+        for rank_var, size_var in (
+            ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
+            ("PMI_RANK", "PMI_SIZE"),
+        ):
+            if rank_var in env and size_var in env:
+                prank_s, psize_s = env[rank_var], env[size_var]
+                break
+        else:
+            return None
+    prank = int(prank_s)
+    psize = int(psize_s)
+    lrank = int(_config.env_str(
+        "HOROVOD_LOCAL_RANK",
+        env.get("OMPI_COMM_WORLD_LOCAL_RANK", str(prank))))
+    lsize = int(_config.env_str(
+        "HOROVOD_LOCAL_SIZE",
+        env.get("OMPI_COMM_WORLD_LOCAL_SIZE", str(psize))))
+    coord = _config.env_str("HOROVOD_COORDINATOR")
+    return prank, psize, lrank, lsize, coord
 
 
 def init(devices: Optional[Sequence] = None,
@@ -77,7 +89,7 @@ def init(devices: Optional[Sequence] = None,
         # hvdrun may force the platform (e.g. cpu workers on a box whose
         # plugin pins JAX_PLATFORMS to the single real TPU); must happen
         # before the backend initializes.
-        forced_platform = os.environ.get("HOROVOD_PLATFORM", "")
+        forced_platform = _config.env_str("HOROVOD_PLATFORM")
         if forced_platform and forced_platform != "auto":
             jax.config.update("jax_platforms", forced_platform)
 
@@ -134,13 +146,14 @@ def init(devices: Optional[Sequence] = None,
                 st.native = load_native()
                 st.native.init(st.rank, st.size, st.local_rank,
                                st.local_size)
+            # hvd: disable=HVD006(native build/load can fail a dozen ways — g++ missing, bad toolchain, sandbox; all degrade to pure Python)
             except Exception:
                 st.native = None  # graceful pure-Python degradation
 
         # Multi-controller: connect to the launcher's rendezvous server
         # (the control-message channel replacing MPI TAG_NOTIFY,
         # mpi_ops.cc:225) and synchronize startup.
-        kv_addr = os.environ.get("HOROVOD_KV", "")
+        kv_addr = _config.env_str("HOROVOD_KV")
         if kv_addr and st.num_processes > 1:
             if st.native is None:
                 raise RuntimeError(

@@ -10,12 +10,23 @@ carry over, and adds TPU-specific knobs: `HVD_FUSION_MB` (megabyte alias
 of the fusion threshold), `HVD_PREFILL_CHUNK_BUDGET` (serving: prompt
 tokens streamed per dispatch step — docs/serving.md "Performance
 tuning").
+
+This module is additionally the SINGLE SOURCE OF TRUTH for every
+``HVD_*`` / ``HOROVOD_*`` environment knob the codebase reads: each
+knob is declared in the `KNOBS` registry below, other modules read the
+environment only through the `env_str` / `env_int` / `env_float`
+accessors (which refuse unregistered names), and `hvdlint`'s HVD005
+rule flags any raw ``os.environ`` read of a knob outside this file.
+The registry also generates the environment-knob table in
+`docs/troubleshooting.md` (``python -m horovod_tpu.analysis
+--write-env-table``), so the docs cannot drift from the code.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
+from typing import Dict, Optional
 
 DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024  # bytes, mpi_ops.cc:165
 DEFAULT_STALL_WARNING_TIME = 60.0            # seconds, mpi_ops.cc:228
@@ -26,7 +37,66 @@ DEFAULT_CYCLE_TIME_MS = 5.0                  # mpi_ops.cc:1292 (latency floor)
 DEFAULT_PREFILL_CHUNK_BUDGET = 128
 
 
-def _env_int(name: str, default: int) -> int:
+# ---------------------------------------------------------------------------
+# The knob registry.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One declared environment variable: its type, default, the
+    module that consumes it, and a one-line doc (the troubleshooting
+    table row)."""
+
+    name: str
+    kind: str          # "int" | "float" | "str" | "flag"
+    default: str       # rendered default (documentation, not parsing)
+    consumer: str      # module that reads it
+    doc: str
+
+
+KNOBS: Dict[str, Knob] = {}
+
+
+def register_knob(name: str, kind: str, default: str, consumer: str,
+                  doc: str) -> Knob:
+    """Declare one environment knob. Every ``HVD_*``/``HOROVOD_*``
+    variable the codebase reads must be declared here (hvdlint HVD005
+    enforces it); re-registration with identical fields is a no-op."""
+    knob = Knob(name, kind, default, consumer, doc)
+    prev = KNOBS.get(name)
+    if prev is not None and prev != knob:
+        raise ValueError(
+            f"environment knob {name!r} registered twice with "
+            f"conflicting declarations:\n  {prev}\n  {knob}")
+    KNOBS[name] = knob
+    return knob
+
+
+def _require_registered(name: str):
+    if name not in KNOBS:
+        raise KeyError(
+            f"environment variable {name!r} is not in the "
+            f"horovod_tpu.runtime.config knob registry; declare it "
+            f"with register_knob() so docs and hvdlint (HVD005) see "
+            f"it")
+
+
+def env_str(name: str, default: str = "") -> str:
+    """Read a REGISTERED env knob as a string (raises KeyError for
+    undeclared names — the registry is the single source of truth)."""
+    _require_registered(name)
+    return os.environ.get(name, default)
+
+
+def env_raw(name: str) -> Optional[str]:
+    """Like `env_str` but preserves unset-vs-empty (returns None when
+    the variable is absent)."""
+    _require_registered(name)
+    return os.environ.get(name)
+
+
+def env_int(name: str, default: int) -> int:
+    _require_registered(name)
     v = os.environ.get(name, "")
     try:
         return int(v) if v else default
@@ -34,13 +104,122 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
-def _env_float(name: str, default: float) -> float:
+def env_float(name: str, default: float) -> float:
+    _require_registered(name)
     v = os.environ.get(name, "")
     try:
         return float(v) if v else default
     except ValueError:
         return default
 
+
+def env_table_md() -> str:
+    """The environment-knob table, rendered as GitHub markdown — the
+    generated section of docs/troubleshooting.md (tests pin the doc to
+    this exact output so the table cannot drift from the registry)."""
+    rows = ["| Variable | Type | Default | Read by | Meaning |",
+            "| --- | --- | --- | --- | --- |"]
+    for name in sorted(KNOBS):
+        k = KNOBS[name]
+        rows.append(f"| `{k.name}` | {k.kind} | {k.default} | "
+                    f"`{k.consumer}` | {k.doc} |")
+    return "\n".join(rows) + "\n"
+
+
+# -- the declarations -------------------------------------------------------
+# (kept in one block so the table reads as documentation; consumers
+# outside this file fetch values via the env_* accessors above)
+
+register_knob(
+    "HOROVOD_FUSION_THRESHOLD", "int", str(DEFAULT_FUSION_THRESHOLD),
+    "runtime/config.py",
+    "Tensor-fusion bucket size in bytes (0 disables fusion); the "
+    "reference's knob, docs/tensor-fusion.md")
+register_knob(
+    "HVD_FUSION_MB", "float", "64", "runtime/config.py",
+    "Megabyte alias of the fusion threshold (accepts fractions); "
+    "HOROVOD_FUSION_THRESHOLD wins when both are set")
+register_knob(
+    "HVD_PREFILL_CHUNK_BUDGET", "int", str(DEFAULT_PREFILL_CHUNK_BUDGET),
+    "runtime/config.py",
+    "Serving: max prompt tokens streamed per dispatch step "
+    "(interleaved chunked prefill; <= 0 streams whole prompts), "
+    "docs/serving.md")
+register_knob(
+    "HOROVOD_TIMELINE", "str", "(unset)", "runtime/config.py",
+    "Write a Chrome-trace timeline to this path, docs/timeline.md")
+register_knob(
+    "HOROVOD_STALL_CHECK_TIME", "float", str(DEFAULT_STALL_WARNING_TIME),
+    "runtime/config.py",
+    "Seconds before a pending collective / serving tick warns as "
+    "stalled (utils/stall.py)")
+register_knob(
+    "HOROVOD_CYCLE_TIME", "float", str(DEFAULT_CYCLE_TIME_MS),
+    "runtime/config.py",
+    "Background dispatch tick in milliseconds (fusion latency floor)")
+register_knob(
+    "HOROVOD_ALLREDUCE_DTYPE", "str", "(unset)", "runtime/config.py",
+    "Reduce gradients in this dtype (e.g. bfloat16) before casting "
+    "back")
+register_knob(
+    "HOROVOD_MESH_AXIS", "str", "data", "runtime/config.py",
+    "Name of the default data-parallel mesh axis")
+register_knob(
+    "HOROVOD_NO_NATIVE", "flag", "(unset)", "runtime/config.py",
+    "Non-empty disables the C++ control plane (pure-Python fallback)")
+register_knob(
+    "HOROVOD_XLA_COMBINER", "str", "pin", "runtime/config.py",
+    "'pin' disables XLA's collective combiner so fusion buckets "
+    "survive compilation; 'xla' lets the backend re-merge "
+    "(ops/fusion.py)")
+register_knob(
+    "HOROVOD_FLASH_BWD", "str", "pallas", "ops/flash_attention.py",
+    "Flash-attention backward kernel override: 'pallas' (fused) or "
+    "'recompute' (escape hatch if the fused backward misbehaves)")
+register_knob(
+    "HVD_IO_RETRIES", "int", "3", "resilience/retry.py",
+    "Checkpoint/data I/O retry attempts under the shared RetryPolicy "
+    "(0 disables retries)")
+register_knob(
+    "HVD_CHAOS", "str", "(unset)", "resilience/chaos.py",
+    "Arm chaos-injection sites: 'site:count[:p=..][:delay=..],...' "
+    "(docs/resilience.md)")
+register_knob(
+    "HVD_CHAOS_SEED", "int", "0", "resilience/chaos.py",
+    "Seed for the deterministic per-site chaos fault schedule")
+register_knob(
+    "HOROVOD_PLATFORM", "str", "auto", "runtime/bootstrap.py",
+    "Force the jax platform before backend init (e.g. 'cpu' workers "
+    "on a TPU box); hvdrun sets it for workers")
+register_knob(
+    "HOROVOD_KV", "str", "(unset)", "runtime/bootstrap.py",
+    "host:port of the launcher's rendezvous KV server "
+    "(multi-controller bootstrap); set by hvdrun")
+register_knob(
+    "HOROVOD_RANK", "int", "(launcher)", "runtime/bootstrap.py",
+    "Process rank, set by hvdrun (OMPI_COMM_WORLD_RANK / PMI_RANK "
+    "are honored as fallbacks)")
+register_knob(
+    "HOROVOD_SIZE", "int", "(launcher)", "runtime/bootstrap.py",
+    "World size, set by hvdrun")
+register_knob(
+    "HOROVOD_LOCAL_RANK", "int", "(launcher)", "runtime/bootstrap.py",
+    "Rank within the host, set by hvdrun")
+register_knob(
+    "HOROVOD_LOCAL_SIZE", "int", "(launcher)", "runtime/bootstrap.py",
+    "Processes on this host, set by hvdrun")
+register_knob(
+    "HOROVOD_COORDINATOR", "str", "(launcher)", "runtime/bootstrap.py",
+    "jax.distributed coordinator address, set by hvdrun")
+register_knob(
+    "HVD_BENCH_PROBE_BUDGET_S", "float", "(unset)", "bench.py",
+    "Caps the benchmark's backend probe loop (seconds) before the "
+    "CPU fallback engages")
+
+
+# ---------------------------------------------------------------------------
+# The resolved runtime config.
+# ---------------------------------------------------------------------------
 
 @dataclasses.dataclass
 class Config:
@@ -69,10 +248,10 @@ class Config:
         # HOROVOD_FUSION_THRESHOLD (exact bytes, the reference's knob)
         # wins; HVD_FUSION_MB (megabytes, accepts fractions) is the
         # ergonomic alias — "HVD_FUSION_MB=8" == threshold 8 MiB.
-        if os.environ.get("HOROVOD_FUSION_THRESHOLD", ""):
+        if env_str("HOROVOD_FUSION_THRESHOLD"):
             self.fusion_threshold = _env_int(
                 "HOROVOD_FUSION_THRESHOLD", DEFAULT_FUSION_THRESHOLD)
-        elif os.environ.get("HVD_FUSION_MB", ""):
+        elif env_str("HVD_FUSION_MB"):
             self.fusion_threshold = int(
                 _env_float("HVD_FUSION_MB",
                            DEFAULT_FUSION_THRESHOLD / (1 << 20))
@@ -81,17 +260,21 @@ class Config:
             self.fusion_threshold = DEFAULT_FUSION_THRESHOLD
         self.prefill_chunk_budget = _env_int(
             "HVD_PREFILL_CHUNK_BUDGET", DEFAULT_PREFILL_CHUNK_BUDGET)
-        self.timeline_path = os.environ.get("HOROVOD_TIMELINE", "")
+        self.timeline_path = env_str("HOROVOD_TIMELINE")
         self.stall_warning_time = _env_float(
             "HOROVOD_STALL_CHECK_TIME", DEFAULT_STALL_WARNING_TIME)
         self.cycle_time_ms = _env_float(
             "HOROVOD_CYCLE_TIME", DEFAULT_CYCLE_TIME_MS)
-        self.allreduce_dtype = os.environ.get("HOROVOD_ALLREDUCE_DTYPE", "")
-        self.mesh_axis_name = os.environ.get("HOROVOD_MESH_AXIS", "data")
-        self.use_native = os.environ.get("HOROVOD_NO_NATIVE", "") == ""
-        self.xla_combiner = os.environ.get("HOROVOD_XLA_COMBINER", "pin")
+        self.allreduce_dtype = env_str("HOROVOD_ALLREDUCE_DTYPE")
+        self.mesh_axis_name = env_str("HOROVOD_MESH_AXIS", "data")
+        self.use_native = env_str("HOROVOD_NO_NATIVE") == ""
+        self.xla_combiner = env_str("HOROVOD_XLA_COMBINER", "pin")
         return self
 
+
+# Backwards-compatible aliases (pre-registry internal helpers).
+_env_int = env_int
+_env_float = env_float
 
 config = Config()
 config.refresh()

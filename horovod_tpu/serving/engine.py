@@ -314,10 +314,12 @@ class ServingEngine:
                     if self._epoch != epoch:
                         return   # superseded by a watchdog restart
                     closing, drain = self._closing, self._drain
-                # Heartbeat only AFTER the epoch check: a superseded
-                # thread limping out of a hung call must not refresh
-                # the live generation's stuck timer.
-                self._heartbeat = time.time()
+                    # Heartbeat only AFTER the epoch check (a
+                    # superseded thread limping out of a hung call
+                    # must not refresh the live generation's stuck
+                    # timer), and under the lock — the watchdog reads
+                    # it against tick_deadline_s (hvdlint HVD004).
+                    self._heartbeat = time.time()
                 self.metrics.observe_gauges(
                     len(queue), scheduler.pool.busy_slots,
                     scheduler.pool.num_slots)
@@ -331,6 +333,7 @@ class ServingEngine:
                     continue
                 if not progressed and not scheduler.has_active():
                     queue.wait(_IDLE_WAIT_S)
+        # hvd: disable=HVD006(THE containment boundary: any dispatch-thread fault must fail the in-flight futures, never leave callers hanging)
         except BaseException as e:  # noqa: BLE001 — fail futures, not hang
             # A dispatch-thread fault (a poison request, a compile
             # failure, device OOM, an injected crash). With the

@@ -53,6 +53,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from horovod_tpu.annotations import hot_path
 from horovod_tpu.resilience import chaos
 from horovod_tpu.serving.admission import (
     AdmissionQueue, DeadlineExceededError, EngineClosedError, Request,
@@ -108,8 +109,8 @@ def _timeline():
     try:
         from horovod_tpu.runtime import state as _state
         return _state.global_state().timeline
-    except Exception:
-        return None
+    except (ImportError, AttributeError):
+        return None   # interpreter teardown / pre-init introspection
 
 
 def _span(method: str, request_id: int, name: str):
@@ -212,9 +213,12 @@ class ContinuousBatchingScheduler:
 
     # -- the tick -----------------------------------------------------
 
+    @hot_path
     def step(self, now: Optional[float] = None) -> bool:
         """One scheduling iteration; True when any device work ran
-        (the engine parks the thread on False)."""
+        (the engine parks the thread on False). ``@hot_path``: this is
+        the tick ring — everything reachable from here is checked by
+        hvdlint HVD001 for stray host syncs (docs/analysis.md)."""
         if self.abandoned:
             return False
         now = time.time() if now is None else now
@@ -265,6 +269,7 @@ class ContinuousBatchingScheduler:
             self._sync_pending(overlapped=handle is not None)
             progressed = True
         if handle is not None:
+            # hvd: disable=HVD004(_pending is dispatch-thread-owned; the handoff lock only orders the container handoff, and abandon() drops the ring wholesale)
             self._pending = _PendingTick(handle, snapshot)
             if self.pipeline_depth < 1:
                 self._sync_pending(overlapped=False)
@@ -276,6 +281,7 @@ class ContinuousBatchingScheduler:
         finished. ``overlapped`` records whether newer device work was
         already queued behind the read (the metric the tentpole
         moves: exposed host syncs per token)."""
+        # hvd: disable=HVD004(dispatch-thread-owned ring slot; a racing abandon() clears it too, and the snapshot re-check below tolerates that)
         pending, self._pending = self._pending, None
         sync_name = f"serving_sync_{self._gen}.{self.metrics.ticks}"
         if self.stall is not None:
@@ -442,9 +448,11 @@ class ContinuousBatchingScheduler:
                 now: float):
         """Free the slot and resolve the request's future."""
         if self.abandoned:
+            # hvd: disable=HVD004(post-abandon bookkeeping on the superseded thread; the successor owns the live dict, and pop(slot, None) on the cleared one is a no-op)
             self.active.pop(slot, None)
             return
         self.pool.free(slot)
+        # hvd: disable=HVD004(dispatch-thread-owned retire; abandon() clearing concurrently makes this a benign no-op, tolerated by _resolve)
         self.active.pop(slot, None)
         _span("end_span", req.id, "DECODE")
         self._finalize(req, reason, now)
@@ -479,7 +487,9 @@ class ContinuousBatchingScheduler:
                 t_first=req.t_first, t_done=now, n_tokens=n)
             self._resolve(req.future, result=CompletedRequest(
                 request_id=req.id,
+                # hvd: disable=HVD001(req.prompt is the submitted numpy array, req.tokens a host list — retire-time packaging, no device read)
                 prompt=np.asarray(req.prompt),
+                # hvd: disable=HVD001(host list of already-synced ints)
                 tokens=np.asarray(req.tokens, np.int64),
                 finish_reason=reason,
                 ttft_s=req.t_first - req.t_submit,
@@ -505,6 +515,7 @@ class ContinuousBatchingScheduler:
         """Non-draining shutdown: fail every in-flight request now —
         decoding and mid-prefill alike — and drop the pending tick."""
         now = time.time()
+        # hvd: disable=HVD004(shutdown path on the dispatch thread — the watchdog is already joined by the time the engine aborts)
         self._pending = None
         for slot, req in list(self.active.items()):
             self._retire(slot, req, "aborted", now)

@@ -46,6 +46,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from horovod_tpu.annotations import hot_path
 from horovod_tpu.models.transformer import (
     TransformerLM, init_slot_cache, prefill_chunks, sample_token,
     slot_decode_model, slot_decode_tick, slot_prefill_chunk,
@@ -220,6 +221,7 @@ class SlotPool:
         sync). The slot stays non-live, so interleaved decode ticks
         freeze its fill index and the next chunk lands exactly where
         this one stopped."""
+        # hvd: disable=HVD001(chunk is host-side prompt tokens from the admission queue, never a device array — no sync)
         chunk = np.asarray(chunk)
         c = int(chunk.shape[0])
         self.maybe_compiling = ("prefill", c) not in self._seen_shapes
@@ -257,6 +259,7 @@ class SlotPool:
                 # arms the on-device stop immediately, so even the
                 # first tick can only re-emit eos for this lane.
                 self._done = self._done.at[slot].set(tok == self._eos)
+                # hvd: disable=HVD001(the ONE designed per-request sync — TTFT wants the first token now; docs/serving.md)
                 return int(tok)
         finally:
             self.maybe_compiling = False
@@ -283,6 +286,7 @@ class SlotPool:
 
     # -- the tick (split for pipelining) ------------------------------
 
+    @hot_path
     def tick_dispatch(self) -> TickHandle:
         """Enqueue one vmapped decode tick over every slot and start
         the async device->host copy of its token buffer; returns
@@ -308,9 +312,13 @@ class SlotPool:
         return TickHandle(toks)
 
     @staticmethod
+    @hot_path
     def tick_sync(handle: TickHandle) -> np.ndarray:
         """Block for one dispatched tick's [num_slots] token vector."""
-        return np.asarray(handle.toks)
+        # The pipelined ring's DESIGNED sync point: the scheduler calls
+        # this only after dispatching the next tick, so the read hides
+        # behind device compute (metrics: ticks_overlapped).
+        return np.asarray(handle.toks)  # hvd: disable=HVD001(the one designed sync of the tick ring)
 
     def tick(self) -> np.ndarray:
         """Synchronous tick (dispatch + immediate sync) — the

@@ -37,6 +37,7 @@ class StallMonitor:
                 native.stall_configure(warning_time_s, check_every_s)
                 native.stall_start_thread()
                 self._native = native
+            # hvd: disable=HVD006(the C++ control plane is optional — ANY fault probing it degrades to the Python sweep, never fails init)
             except Exception:
                 self._native = None
         self._warning_time = warning_time_s

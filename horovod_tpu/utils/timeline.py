@@ -39,6 +39,7 @@ class Timeline:
             try:
                 if native.timeline_start(path) == 0:
                     self._native = native
+            # hvd: disable=HVD006(the C++ writer is optional — ANY probe fault falls back to the Python writer, never fails tracing)
             except Exception:
                 self._native = None
         self._path = path
@@ -187,15 +188,25 @@ class Timeline:
             sys.stderr.write(
                 f"WARNING: Error writing the Horovod Timeline file "
                 f"{self._path!r}, disabling the timeline: {e}\n")
+            # hvd: disable=HVD004(_flush_locked runs with self._lock held — every caller is inside a `with self._lock` block, per the name)
             self._closed = True
         self._events = []
         self._last_flush = time.time()
 
     def close(self):
         if self._native is not None:
-            if not self._closed:
-                self._native.timeline_stop()
-                self._closed = True
+            # The native writer is its own serialization point: every
+            # C++ entry (Record/Mark/Stop) takes the internal mutex
+            # and no-ops once Stop nulled the file, so a record racing
+            # this close is SAFE without Python-side locking — the
+            # unlocked `_closed` checks in record/begin/end/mark are
+            # only a cheap fast-path short-circuit. The lock here just
+            # keeps close() itself idempotent and `_closed` writes
+            # single-writer (hvdlint HVD004).
+            with self._lock:
+                if not self._closed:
+                    self._native.timeline_stop()
+                    self._closed = True
             return
         with self._lock:
             if self._closed:

@@ -1,0 +1,68 @@
+"""HVD001 fixture: host sync reachable from a @hot_path entry.
+
+Not imported by anything — parsed by hvdlint in tests/test_analysis.py.
+Lines tagged EXPECT must be flagged; SUPPRESSED lines must be muted;
+everything else must stay clean.
+"""
+
+import jax
+import numpy as np
+
+from horovod_tpu.annotations import hot_path
+
+
+@jax.jit
+def _device_step(x):
+    return x * 2
+
+
+def _helper_reads_back(x):
+    # True positive: .item() two calls deep into the hot path.
+    return x.item()                                        # EXPECT
+
+
+def _helper_suppressed(x):
+    # hvd: disable=HVD001(x is a host-side list here - SUPPRESSED)
+    return np.asarray(x)
+
+
+@hot_path
+def tick(x):
+    y = _device_step(x)
+    n = int(y)                                             # EXPECT
+    m = _helper_reads_back(y)
+    k = _helper_suppressed([1, 2, 3])
+    return n + m + k.sum()
+
+
+def cold_path_is_fine(x):
+    """Clean negative: not reachable from any @hot_path entry."""
+    return np.asarray(x).item()
+
+
+@hot_path
+def pure_device_tick(x):
+    """Clean negative: device-only work, int() of a constant."""
+    z = jax.numpy.tanh(x)
+    return z * int(4)
+
+
+from numpy import asarray as _as_host
+
+
+@hot_path
+def from_import_sync(x):
+    """Bare-name from-import of a sync function is still a sync."""
+    return _as_host(x)                                     # EXPECT
+
+
+def not_hot_path(fn):
+    """A decorator that merely ENDS in 'hot_path' must not seed the
+    HVD001 call graph."""
+    return fn
+
+
+@not_hot_path
+def lookalike_decorator_is_fine(x):
+    """Clean negative: decorated, but not @hot_path."""
+    return np.asarray(x).item()

@@ -1,0 +1,121 @@
+"""HVD002 fixture: Python control flow on traced values under jit."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_on_traced(x):
+    if x > 0:                                              # EXPECT
+        return x
+    return -x
+
+
+@jax.jit
+def assert_on_traced(x):
+    assert x.sum() > 0, "positive"                         # EXPECT
+    return x
+
+
+@jax.jit
+def suppressed_branch(x):
+    # hvd: disable=HVD002(trace-time constant in this fixture - SUPPRESSED)
+    if x > 0:
+        return x
+    return -x
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def static_is_fine(x, mode):
+    """Clean negative: `mode` is static, shape/None tests are static
+    structure."""
+    if mode == "double":
+        x = x * 2
+    if x.shape[0] > 1:
+        x = x[:1]
+    if x is not None:
+        x = jnp.where(x > 0, x, -x)
+    return x
+
+
+@jax.jit
+def nested_body_param_is_traced(x):
+    """A scan/cond body's params are tracers INSIDE the body..."""
+    def body(c, _):
+        if c.sum() > 0:                                    # EXPECT
+            c = -c
+        return c, None
+    return jax.lax.scan(body, x, None, length=2)[0]
+
+
+@jax.jit
+def outer_local_shares_nested_param_name(x):
+    """...but must not leak OUT: `c` here is a static shape local that
+    merely shares its name with the body's param (clean negative)."""
+    def body(c, _):
+        return c * 2, None
+    c = x.shape[0]
+    if c > 2:
+        x = x[:2]
+    return jax.lax.scan(body, x, None, length=c)[0]
+
+
+@jax.jit
+def direct_called_helper_static(x):
+    """Clean negative: the helper is only ever CALLED directly with a
+    Python int — its branch is trace-safe."""
+    def clamp(n):
+        if n > 4:
+            n = 4
+        return n
+    return x[:clamp(3)]
+
+
+@jax.jit
+def direct_called_helper_traced(x):
+    """The same shape with a TRACED argument taints the param."""
+    def scale(v):
+        if v.sum() > 0:                                    # EXPECT
+            return v * 2
+        return v
+    return scale(x)
+
+
+def plain_python_is_fine(x):
+    """Clean negative: not compiled — branch away."""
+    if x > 0:
+        return x
+    return -x
+
+
+def _alias_wrapped(x):
+    """Compiled through the module-level `jax.jit(...)` alias below —
+    traced exactly like the decorator form."""
+    if x > 0:                                              # EXPECT
+        return x
+    return -x
+
+
+alias_wrapped = jax.jit(_alias_wrapped)
+
+
+def _alias_static(x, n):
+    """Clean negative: `n` is static via the alias's static_argnames."""
+    if n > 4:
+        n = 4
+    return x[:n]
+
+
+alias_static = jax.jit(_alias_static, static_argnames=("n",))
+
+
+def make_local_jit_step():
+    """A factory jitting its nested def (the repo's train-step idiom):
+    the nested body runs traced."""
+    def inner(x):
+        if x.sum() > 0:                                    # EXPECT
+            return x
+        return -x
+    return jax.jit(inner)

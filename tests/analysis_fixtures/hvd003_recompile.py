@@ -1,0 +1,57 @@
+"""HVD003 fixture: recompilation hazards at jit call sites."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def _compiled(x):
+    return x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _compiled_static(x, cfg):
+    return x * len(cfg)
+
+
+def jit_and_discard(x):
+    return jax.jit(lambda y: y + 1)(x)                     # EXPECT
+
+
+def loop_varying_scalar(xs):
+    out = []
+    for i in range(8):
+        out.append(_compiled(i))                           # EXPECT
+    return out
+
+
+def unhashable_static(x):
+    return _compiled_static(x, ["a", "b"])                 # EXPECT
+
+
+def suppressed_probe(x):
+    # hvd: disable=HVD003(one-shot probe in this fixture - SUPPRESSED)
+    return jax.jit(lambda y: y * 2)(x)
+
+
+def converted_loop_is_fine(xs):
+    """Clean negative: the loop scalar is wrapped to a device value,
+    so every iteration hits the same compiled program."""
+    out = []
+    for i in range(8):
+        out.append(_compiled(jnp.int32(i)))
+    return out
+
+
+def hashable_static_is_fine(x):
+    return _compiled_static(x, ("a", "b"))
+
+
+def post_loop_use_is_fine(xs):
+    """Clean negative: the loop variable is read AFTER the loop — one
+    final value, one compile."""
+    for i in range(8):
+        xs = xs + 1
+    return _compiled(i)

@@ -1,0 +1,59 @@
+"""HVD004 fixture: mixed lock discipline on shared attributes."""
+
+import threading
+
+
+class MixedDiscipline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counter = 0
+        self.items = []
+
+    def guarded(self):
+        with self._lock:
+            self.counter += 1
+            self.items.append(1)
+
+    def unguarded(self):
+        self.counter += 1                                  # EXPECT
+        self.items.pop()                                   # EXPECT
+
+
+class SuppressedDiscipline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = 0
+
+    def guarded(self):
+        with self._lock:
+            self.state = 1
+
+    def owner_thread_only(self):
+        # hvd: disable=HVD004(single-owner attr, lock only brackets handoff - SUPPRESSED)
+        self.state = 2
+
+
+class ConsistentDiscipline:
+    """Clean negative: every mutation holds the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+    def reset(self):
+        with self._lock:
+            self.value = 0
+
+
+class LockFree:
+    """Clean negative: no lock attribute — single-threaded class."""
+
+    def __init__(self):
+        self.value = 0
+
+    def bump(self):
+        self.value += 1

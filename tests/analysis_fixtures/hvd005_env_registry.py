@@ -1,0 +1,74 @@
+"""HVD005 fixture: env knob reads bypassing the config registry."""
+
+import os
+from os import environ, getenv as _ge
+
+
+def raw_read():
+    return os.environ.get("HVD_FIXTURE_KNOB", "")          # EXPECT
+
+
+def raw_subscript():
+    return os.environ["HOROVOD_FIXTURE_KNOB"]              # EXPECT
+
+
+def aliased_read():
+    env = os.environ
+    return env.get("HVD_ALIASED_KNOB")                     # EXPECT
+
+
+def from_import_reads():
+    a = environ.get("HVD_FROM_IMPORT_KNOB")                # EXPECT
+    b = environ["HOROVOD_FROM_IMPORT_KNOB"]               # EXPECT
+    c = _ge("HVD_GETENV_ALIAS_KNOB")                       # EXPECT
+    return a, b, c
+
+
+def membership_test():
+    return "HVD_PRESENCE_KNOB" in os.environ               # EXPECT
+
+
+def unregistered_accessor():
+    from horovod_tpu.runtime.config import env_str
+    return env_str("HVD_NOT_DECLARED")                     # EXPECT
+
+
+def suppressed_read():
+    # hvd: disable=HVD005(fixture-local knob, deliberately unregistered - SUPPRESSED)
+    return os.environ.get("HVD_SUPPRESSED_KNOB", "")
+
+
+def non_knob_reads_are_fine():
+    """Clean negative: only HVD_*/HOROVOD_* names are knobs."""
+    path = os.environ.get("PATH", "")
+    home = os.environ["HOME"]
+    lang = os.getenv("LANG", "C")
+    return path, home, lang
+
+
+def writes_are_fine():
+    """Clean negative: SETTING a knob (arming chaos in-process, a
+    launcher exporting to workers) is not a registry-bypassing read."""
+    os.environ["HVD_WRITTEN_KNOB"] = "1"
+    del os.environ["HVD_WRITTEN_KNOB"]
+
+
+def shared_name_param_is_fine(env):
+    """Clean negative: this `env` is a plain mapping PARAMETER — it
+    only shares a name with `aliased_read`'s os.environ alias, which
+    is scoped to that function."""
+    return env.get("HVD_DICT_KEY"), env["HOROVOD_DICT_KEY"]
+
+
+def local_alias_scoping():
+    """The alias binds for this scope and its nested defs — but a
+    nested def's parameter shadows it again."""
+    env = os.environ
+
+    def read():
+        return env.get("HVD_CLOSURE_KNOB")                 # EXPECT
+
+    def shadowed(env):
+        return env.get("HVD_SHADOWED_KEY")
+
+    return read(), shadowed({})

@@ -1,0 +1,40 @@
+"""HVD006 fixture: swallowed broad excepts."""
+
+
+def swallows(fn):
+    try:
+        return fn()
+    except Exception:                                      # EXPECT
+        return None
+
+
+def bare_swallows(fn):
+    try:
+        return fn()
+    except:                                                # EXPECT  # noqa: E722
+        return None
+
+
+def suppressed_recovery(fn):
+    try:
+        return fn()
+    # hvd: disable=HVD006(recovery drill - any fault degrades gracefully - SUPPRESSED)
+    except Exception:
+        return None
+
+
+def typed_is_fine(fn):
+    """Clean negative: narrowed to what the path can recover from."""
+    try:
+        return fn()
+    except (ValueError, OSError):
+        return None
+
+
+def reraise_is_fine(fn):
+    """Clean negative: broad catch that re-raises is a fault BOUNDARY,
+    not a swallow."""
+    try:
+        return fn()
+    except Exception as e:
+        raise RuntimeError(f"wrapped: {e}") from e

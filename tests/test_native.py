@@ -127,15 +127,30 @@ def test_tsan_stress(tmp_path):
     # mask itself as 'unavailable'.
     probe = tmp_path / "probe.cc"
     probe.write_text("int main() { return 0; }\n")
-    if subprocess.run([gxx, "-fsanitize=thread", str(probe), "-o",
-                       str(tmp_path / "probe")],
-                      capture_output=True).returncode != 0:
-        pytest.skip("tsan toolchain unavailable")
+    link = subprocess.run([gxx, "-fsanitize=thread", str(probe), "-o",
+                           str(tmp_path / "probe")],
+                          capture_output=True, text=True)
+    if link.returncode != 0:
+        # Name the missing piece: -fsanitize=thread failing to LINK
+        # almost always means the libtsan runtime package (libtsan0 /
+        # libtsan-dev for this g++ major) is not installed.
+        detail = (link.stderr or "").strip().splitlines()
+        last = detail[-1] if detail else "no linker output"
+        pytest.skip(
+            f"TSan link probe failed with {gxx} — libtsan runtime "
+            f"missing for this g++? ({last})")
     # The runtime itself can abort at startup (mmap layout issues on
     # some kernels) even when the link works — run the probe too.
-    if subprocess.run([str(tmp_path / "probe")],
-                      capture_output=True).returncode != 0:
-        pytest.skip("tsan runtime unavailable on this kernel")
+    run = subprocess.run([str(tmp_path / "probe")],
+                         capture_output=True, text=True)
+    if run.returncode != 0:
+        detail = (run.stderr or "").strip().splitlines()
+        first = detail[0] if detail else "no runtime output"
+        pytest.skip(
+            f"TSan runtime aborts on this kernel "
+            f"({os.uname().release}): probe exited "
+            f"{run.returncode} — usually the shadow-memory mmap "
+            f"layout (try `sysctl vm.mmap_rnd_bits=28`). ({first})")
     src = pathlib.Path(__file__).resolve().parent.parent / \
         "horovod_tpu" / "native"
     exe = tmp_path / "stress"
