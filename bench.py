@@ -60,11 +60,15 @@ TRAIN_GFLOPS_PER_IMG = {
     "inception3": 3 * 5.7, "mnist": 3 * 0.01,
     "vit": 3 * 17.6,  # ViT-B/16 @224 (Dosovitskiy et al. Table 6)
 }
-# Peak bf16 TFLOP/s by device kind (public TPU specs).
-PEAK_BF16 = {
-    "TPU v4": 275e12, "TPU v5 lite": 197e12, "TPU v5e": 197e12,
-    "TPU v5p": 459e12, "TPU v6 lite": 918e12, "TPU v6e": 918e12,
-}
+# Peak bf16 TFLOP/s by device kind — canonical table lives in
+# utils/profile_analysis.py (shared with the obs-plane MFU gauge);
+# mirrored lazily here because bench.py must stay importable without
+# touching the horovod_tpu package until the backend probe decides.
+
+
+def _peak_bf16():
+    from horovod_tpu.utils.profile_analysis import PEAK_BF16_FLOPS
+    return PEAK_BF16_FLOPS
 # HBM bandwidth GB/s by device kind (public TPU specs) — the decode
 # roofline's denominator (docs/inference.md).
 HBM_GBPS = {
@@ -604,6 +608,69 @@ def _serve_rate(model, params, args, prompts, rate, *,
     return rec
 
 
+def _serving_trace_check(model, params, args, prompts, log):
+    """Observability acceptance evidence: run a few requests with the
+    event log, the (Python-writer) Timeline and the shared metric
+    registry all live, then recover ONE request's ``trace_id`` from
+    each subsystem — the proof that a request can be followed across
+    the whole plane (docs/observability.md). Recorded in the bench
+    artifact as ``trace_check``."""
+    import json as _json
+    import tempfile
+
+    from horovod_tpu.obs import events as obs_events
+    from horovod_tpu.obs.registry import registry as obs_registry
+    from horovod_tpu.runtime import state as _state
+    from horovod_tpu.serving import ServingEngine
+    from horovod_tpu.utils.timeline import Timeline
+
+    tmp = tempfile.mkdtemp(prefix="hvd_obs_trace_")
+    ev_path = os.path.join(tmp, "events.jsonl")
+    tl_path = os.path.join(tmp, "timeline.json")
+    # Scoped swaps, both restored: a user-configured HVD_EVENTS_LOG
+    # must keep receiving events after the check.
+    prev_ev = obs_events.install(obs_events.EventLog(ev_path))
+    prev_tl = _state.global_state().timeline
+    # The Python writer explicitly: the native C++ writer drops span
+    # args, and args are the Timeline leg of the check.
+    _state.global_state().timeline = Timeline(tl_path, native=None)
+    try:
+        with ServingEngine(model, params,
+                           num_slots=min(2, args.serving_slots),
+                           max_queue=16, warmup=True) as eng:
+            handles = [eng.submit(p, 8) for p in prompts[:3]]
+            for h in handles:
+                h.result(timeout=600)
+    finally:
+        _state.global_state().timeline.close()
+        _state.global_state().timeline = prev_tl
+        obs_events.install(prev_ev)
+    # Subsystem 1: the shared registry's exemplar (the last retired
+    # request's trace_id rides the e2e histogram).
+    hist = obs_registry().get("hvd_serving_e2e_seconds")
+    ex = hist.samples()[0][1].exemplar if hist else None
+    tid = (ex or {}).get("trace_id")
+    in_exemplar = tid is not None
+    # Subsystems 2+3: the SAME id in the event log and span args.
+    in_events = in_timeline = False
+    if tid:
+        with open(ev_path) as f:
+            in_events = any(
+                _json.loads(line).get("trace_id") == tid
+                for line in f)
+        with open(tl_path) as f:
+            in_timeline = any(
+                (e.get("args") or {}).get("trace_id") == tid
+                for e in _json.loads(f.read()))
+    n = sum((in_exemplar, in_events, in_timeline))
+    log(f"serving trace check: trace_id={tid} found in {n}/3 "
+        f"subsystems (metrics exemplar={in_exemplar}, "
+        f"event log={in_events}, timeline args={in_timeline})")
+    return {"trace_id": tid, "in_metrics_exemplar": in_exemplar,
+            "in_event_log": in_events, "in_timeline_args": in_timeline,
+            "subsystems": n}
+
+
 def run_serving(args, devices, n_chips, log):
     """Serving-engine throughput/latency under open-loop load: Poisson
     arrivals against `horovod_tpu.serving.ServingEngine` at each
@@ -676,7 +743,11 @@ def run_serving(args, devices, n_chips, log):
            "num_slots": S, "max_new_tokens": steps,
            "requests_per_rate": n_req, "chaos": chaos_mode,
            "pipeline_depth": depth, "prefill_chunk_budget": budget,
-           "rates": per_rate}
+           "rates": per_rate,
+           # One request followed across the observability plane
+           # (event log + Timeline span args + metric exemplar).
+           "trace_check": _serving_trace_check(
+               model, params, args, prompts, log)}
     if args.serving_ab and not chaos_mode:
         # In-artifact A/B at the highest rate: the PR-1-shaped hot
         # path (synchronous ticks, whole-prompt prefill) vs the PR-3
@@ -1293,14 +1364,16 @@ def _measured_overlap(args):
 
 
 def _cnn_mfu(name, shape, img_s_chip, device_kind):
-    """Analytic-FLOPs MFU estimate (coarse but honest; docs/mfu.md)."""
-    peak = PEAK_BF16.get(device_kind)
-    if not peak or name not in TRAIN_GFLOPS_PER_IMG:
+    """Analytic-FLOPs MFU estimate (coarse but honest; docs/mfu.md) —
+    the FLOP/s over the shared peak table via profile_analysis.mfu,
+    the same math the obs plane's hvd_training_mfu gauge uses."""
+    from horovod_tpu.utils.profile_analysis import mfu
+    if name not in TRAIN_GFLOPS_PER_IMG:
         return None
     base = 299 if name == "inception3" else 224
     scale = 1.0 if name == "mnist" else (shape[1] / base) ** 2
-    return round(img_s_chip * TRAIN_GFLOPS_PER_IMG[name] * scale
-                 * 1e9 / peak, 4)
+    return mfu(img_s_chip * TRAIN_GFLOPS_PER_IMG[name] * scale * 1e9,
+               device_kind)
 
 
 def _bench_body(args, devices, n_chips, metric, unit,
@@ -1385,7 +1458,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "--model bert (BertMLM has no autoregressive cache)")
     if args.model == "bert":
         r = run_bert(args, devices, n_chips, log)
-        peak = PEAK_BF16.get(device_kind)
+        peak = _peak_bf16().get(device_kind)
         _set_best({
             "metric": metric,
             "value": round(r["tok_s_chip"], 1),
@@ -1426,6 +1499,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "pipeline_depth": r["pipeline_depth"],
             "prefill_chunk_budget": r["prefill_chunk_budget"],
             "rates": r["rates"],
+            "trace_check": r["trace_check"],
             "arch": args.arch,
         }
         if "pipeline_ab" in r:
@@ -1466,7 +1540,7 @@ def _bench_body(args, devices, n_chips, metric, unit,
         return
     if is_lm:
         r = run_transformer(args, devices, n_chips, log)
-        peak = PEAK_BF16.get(device_kind)
+        peak = _peak_bf16().get(device_kind)
         _set_best({
             "metric": metric,
             "value": round(r["tok_s_chip"], 1),

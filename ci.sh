@@ -48,9 +48,14 @@ JAX_PLATFORMS=cpu python -m pytest -q --collect-only \
 # XLA compile inside the timed serving window, compiles == 0) and
 # --interleave-check pins that TPOT under a concurrent long-prompt
 # admission stays within 2x the idle-pool TPOT (interleaved chunked
-# prefill; bound loose enough for CPU CI).
+# prefill; bound loose enough for CPU CI). --obs-check is the
+# observability smoke (docs/observability.md): the metrics exporter
+# comes up on an EPHEMERAL port, /metrics is fetched over real HTTP
+# and must expose the serving + resilience + training metric families
+# from the shared registry in ONE scrape, and /healthz must show the
+# live engine's dispatch generation.
 JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4 \
-    --warmup --interleave-check
+    --warmup --interleave-check --obs-check
 
 # Chaos smoke (docs/resilience.md): one injected checkpoint-write
 # failure mid-run — the shared RetryPolicy must retry with backoff and

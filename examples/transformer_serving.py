@@ -23,9 +23,14 @@ snapshot. Two extra CI legs exercise the PR-3 hot-path guarantees:
   prefill). The 2x bound is calibrated for one concurrent long
   admission on a CPU CI box, where chunk compute shares the victim's
   cores; on a real accelerator the chunks overlap device compute.
+* ``--obs-check`` is the observability smoke (docs/observability.md):
+  the Prometheus exporter comes up on an ephemeral port, a live
+  engine serves requests, and one HTTP scrape of ``/metrics`` must
+  expose the serving/resilience/training families while ``/healthz``
+  shows the engine's dispatch generation.
 
 Run:  python examples/transformer_serving.py --requests 4 \
-          [--warmup] [--interleave-check]
+          [--warmup] [--interleave-check] [--obs-check]
 """
 
 import argparse
@@ -95,6 +100,68 @@ def interleave_check(model, params, budget, factor=2.0, repeats=3):
         f"idle-pool TPOT {idle * 1e3:.2f} ms — interleaving broken?")
 
 
+def obs_check(model, params, n_requests=3):
+    """The CI observability smoke (docs/observability.md): start the
+    exporter on an EPHEMERAL port, run requests through a live
+    engine, then scrape ``/metrics`` + ``/healthz`` + ``/metrics.json``
+    over real HTTP and assert (a) the serving, resilience AND
+    training metric families all appear in the one scrape, (b) the
+    serving counters moved, and (c) the live engine reports its
+    dispatch generation at /healthz."""
+    import re
+    import urllib.request
+
+    from horovod_tpu import obs
+
+    srv = obs.start_exporter(port=0)
+    try:
+        with ServingEngine(model, params, num_slots=2,
+                           warmup=True) as eng:
+            for h in [eng.submit(np.array([3 + i, 5, 7]), 6)
+                      for i in range(n_requests)]:
+                h.result(timeout=600)
+            text = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=30).read().decode()
+            health = json.loads(urllib.request.urlopen(
+                srv.url + "/healthz", timeout=30).read())
+            full = json.loads(urllib.request.urlopen(
+                srv.url + "/metrics.json", timeout=30).read())
+        for fam in (
+                # serving
+                "hvd_serving_ttft_seconds", "hvd_serving_tpot_seconds",
+                "hvd_serving_queue_depth", "hvd_serving_slot_occupancy",
+                "hvd_serving_events_total", "hvd_serving_compiles_total",
+                # resilience
+                "hvd_resilience_restarts_total",
+                "hvd_resilience_requeued_total",
+                "hvd_resilience_faults_injected_total",
+                "hvd_resilience_stalls_total",
+                # training
+                "hvd_training_step_seconds", "hvd_training_tokens_per_s",
+                "hvd_training_mfu"):
+            assert f"# TYPE {fam} " in text, f"family missing: {fam}"
+        m = re.search(
+            r'hvd_serving_events_total\{event="completed"\} (\d+)',
+            text)
+        assert m and int(m.group(1)) >= n_requests, (
+            "completed counter did not move", m and m.group(0))
+        assert re.search(
+            r"hvd_serving_ttft_seconds_bucket\{le=\"\+Inf\"\} [1-9]",
+            text), "TTFT histogram empty"
+        comps = {k: v for k, v in
+                 health.get("components", {}).items()
+                 if k.startswith("serving_engine_")}
+        assert health["status"] == "ok" and comps, health
+        assert any(c.get("engine_generation") == 0
+                   and c.get("dispatch_alive") for c in comps.values())
+        assert "hvd_serving_e2e_seconds" in full["metrics"]
+        print(f"obs check OK: exporter on port {srv.port}, "
+              f"{len(full['metrics'])} families scraped, engine "
+              f"generation visible at /healthz")
+    finally:
+        obs.stop_exporter()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=4)
@@ -107,6 +174,11 @@ def main():
                     help="assert TPOT under a concurrent long-prompt "
                          "admission stays within 2x idle (chunked-"
                          "prefill interleaving)")
+    ap.add_argument("--obs-check", action="store_true",
+                    help="start the metrics exporter on an ephemeral "
+                         "port and assert serving/resilience/training "
+                         "families are scrapeable (docs/"
+                         "observability.md)")
     ap.add_argument("--prefill-chunk-budget", type=int, default=8,
                     help="prompt tokens streamed per scheduler step")
     args = ap.parse_args()
@@ -151,6 +223,8 @@ def main():
           f"host-syncs/token {snap['host_syncs_per_token']}")
     if args.interleave_check:
         interleave_check(model, params, args.prefill_chunk_budget)
+    if args.obs_check:
+        obs_check(model, params)
 
 
 if __name__ == "__main__":

@@ -32,13 +32,25 @@ def make_cnn_train_step(model, tx: optax.GradientTransformation,
                         fusion_threshold: Optional[int] = None,
                         reduce_dtype: Optional[Any] = None,
                         donate: bool = True,
-                        remat: bool = False) -> Callable:
+                        remat: bool = False,
+                        examples_per_step: Optional[float] = None,
+                        flops_per_step: Optional[float] = None
+                        ) -> Callable:
     """Returns step(train_state, batch, rng) -> (train_state, loss) where
     train_state = {params, batch_stats, opt_state} (a plain dict pytree,
     replicated) and batch = (images, labels) sharded on dim 0.
 
     remat=True wraps the forward pass in jax.checkpoint, trading FLOPs
     for HBM — the standard TPU recipe for deep CNNs at large batch.
+
+    Every returned step is bracketed by the observability plane
+    (docs/observability.md): the `hvd_training_steps_total` counter
+    and `hvd_training_step_seconds` cadence histogram always record;
+    declaring the step's work turns on the throughput gauges —
+    ``examples_per_step`` drives `hvd_training_tokens_per_s` and
+    ``flops_per_step`` (analytic, e.g. bench.py's per-image tables)
+    the `hvd_training_mfu` gauge against the device's known peak
+    (`utils/profile_analysis.py` math).
     """
     st = _state.check_initialized()
     mesh = mesh or st.mesh
@@ -100,7 +112,9 @@ def make_cnn_train_step(model, tx: optax.GradientTransformation,
     jitted = step_bracket(jax.jit(
         sharded, donate_argnums=donate_argnums,
         compiler_options=combiner_override_options() or None))
-    return _chaos_step(jitted)
+    return _obs_step(_chaos_step(jitted),
+                     tokens_per_step=examples_per_step,
+                     flops_per_step=flops_per_step)
 
 
 def _chaos_step(step_fn):
@@ -135,6 +149,27 @@ def _chaos_step(step_fn):
     # contract step_bracket established and tests/test_fusion.py's HLO
     # introspection relies on: `step.__wrapped__.lower(...)`).
     stepped.__wrapped__ = getattr(step_fn, "__wrapped__", step_fn)
+    return stepped
+
+
+def _obs_step(step_fn, *, tokens_per_step=None, flops_per_step=None,
+              name: str = "train_step"):
+    """Observability bracket around one train-step invocation: step
+    cadence into `hvd_training_step_seconds`/`hvd_training_steps_total`
+    and, when the work per step is declared, the tokens-per-second and
+    MFU gauges (obs/profiling.StepProfiler). Failed steps (a chaos
+    `step_exception`, a real fault) are NOT recorded — the cadence
+    histogram is the healthy-step distribution."""
+    from horovod_tpu.obs.profiling import StepProfiler
+    prof = StepProfiler(name, tokens_per_step=tokens_per_step,
+                        flops_per_step=flops_per_step)
+
+    def stepped(state, batch, rng):
+        with prof.step():
+            return step_fn(state, batch, rng)
+
+    stepped.__wrapped__ = getattr(step_fn, "__wrapped__", step_fn)
+    stepped.__obs_profiler__ = prof
     return stepped
 
 

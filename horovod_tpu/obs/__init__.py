@@ -1,0 +1,40 @@
+"""horovod_tpu.obs — the unified observability plane.
+
+One process-wide layer (docs/observability.md) that serving,
+resilience, training, collectives and the stall monitor all register
+into, replacing per-subsystem silos:
+
+* `registry` — thread-safe `Counter`/`Gauge`/`Histogram` with label
+  sets; histograms use fixed log-scale buckets so percentiles merge
+  across ranks.
+* `catalog` — the single declaration site for every standard metric
+  family (the Grafana-ready catalog in the docs).
+* `exporter` — stdlib HTTP daemon: Prometheus text at ``/metrics``,
+  liveness + engine generation at ``/healthz``, full JSON (quantiles,
+  exemplars, recent events) at ``/metrics.json``. Enable with
+  ``HVD_METRICS_PORT``.
+* `events` — bounded JSONL structured-event log for discrete events
+  (restarts, requeues, sheds, chaos fires, stalls, compiles);
+  ``HVD_EVENTS_LOG=/path`` persists it.
+* `tracing` — ``trace_id`` minted per serving request and carried
+  through queue → prefill → decode → (requeue), stamped into
+  Timeline span args, events and histogram exemplars.
+* `profiling` — `profile_step` brackets + the opt-in `jax.profiler`
+  session (``HVD_PROFILE_DIR``).
+"""
+
+from horovod_tpu.obs import catalog, events, tracing
+from horovod_tpu.obs.exporter import (MetricsServer, render_prometheus,
+                                      start_exporter, stop_exporter)
+from horovod_tpu.obs.profiling import (StepProfiler, profile_step,
+                                       profiler_session)
+from horovod_tpu.obs.registry import (Counter, Gauge, Histogram,
+                                      MetricRegistry, registry)
+
+__all__ = [
+    "registry", "MetricRegistry", "Counter", "Gauge", "Histogram",
+    "catalog", "events", "tracing",
+    "MetricsServer", "render_prometheus", "start_exporter",
+    "stop_exporter",
+    "StepProfiler", "profile_step", "profiler_session",
+]

@@ -1,0 +1,167 @@
+"""The standard metric catalog — every family the subsystems emit.
+
+ONE declaration site (names, types, label sets, docs) serves three
+consumers: the subsystems fetch their metric objects here (get-or-
+create semantics make first-come irrelevant), the exporter pre-declares
+everything at startup so a single scrape always shows the full family
+set (a dashboard can be built against an idle process), and
+docs/observability.md's Grafana-ready catalog table is this module in
+prose. Add a family here first; hvdlint keeps env knobs honest, this
+file keeps metric names honest.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from horovod_tpu.obs.registry import MetricRegistry, registry
+
+
+def serving_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """The serving plane: request lifecycle counters, occupancy
+    gauges, and the TTFT/TPOT/queue-wait/e2e latency histograms
+    (docs/serving.md's vocabulary, now scrapeable)."""
+    reg = reg or registry()
+    return {
+        "events": reg.counter(
+            "hvd_serving_events_total",
+            "Serving request/tick lifecycle events by kind "
+            "(submitted, rejected, completed, cancelled, timed_out, "
+            "aborted, tokens_out, prefill_tokens, prefill_chunks, "
+            "ticks, ticks_overlapped, host_syncs, restarts, "
+            "requeued, faults_injected)", ("event",)),
+        # Engine-scoped gauges carry an `engine` label: several
+        # engines can coexist in one process, and unlabeled gauges
+        # would overwrite each other (engine B's construction would
+        # erase engine A's restart generation).
+        "queue_depth": reg.gauge(
+            "hvd_serving_queue_depth",
+            "Requests waiting in the admission queue", ("engine",)),
+        "slots_busy": reg.gauge(
+            "hvd_serving_slots_busy",
+            "Decode slots currently holding a request", ("engine",)),
+        "slots_total": reg.gauge(
+            "hvd_serving_slots_total",
+            "Configured decode-batch width (slot pool size)",
+            ("engine",)),
+        "slot_occupancy": reg.gauge(
+            "hvd_serving_slot_occupancy",
+            "slots_busy / slots_total (the continuous-batching "
+            "fullness the scheduler exists to maximize)",
+            ("engine",)),
+        "engine_generation": reg.gauge(
+            "hvd_serving_engine_generation",
+            "Dispatch-thread generation per engine (bumps on each "
+            "watchdog in-place restart; restarts vs counter resets)",
+            ("engine",)),
+        "compiles": reg.counter(
+            "hvd_serving_compiles_total",
+            "First-time-shape XLA compiles in the slot pool "
+            "(0 growth inside a warmed serving window)"),
+        "ttft": reg.histogram(
+            "hvd_serving_ttft_seconds",
+            "Time to first token: submit -> first token out "
+            "(queue wait + prefill)"),
+        "tpot": reg.histogram(
+            "hvd_serving_tpot_seconds",
+            "Time per output token after the first (steady-state "
+            "streaming rate)"),
+        "queue_wait": reg.histogram(
+            "hvd_serving_queue_wait_seconds",
+            "Submit -> prefill start (admission latency)"),
+        "e2e": reg.histogram(
+            "hvd_serving_e2e_seconds",
+            "Submit -> request completion"),
+    }
+
+
+def resilience_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """The resilience plane: every recovery path's counters
+    (docs/resilience.md), StallMonitor trips included."""
+    reg = reg or registry()
+    return {
+        "restarts": reg.counter(
+            "hvd_resilience_restarts_total",
+            "Serving-engine in-place watchdog restarts"),
+        "requeued": reg.counter(
+            "hvd_resilience_requeued_total",
+            "In-flight requests replayed across an engine restart"),
+        "faults_injected": reg.counter(
+            "hvd_resilience_faults_injected_total",
+            "Chaos-injection sites fired, by site (HVD_CHAOS)",
+            ("site",)),
+        "stalls": reg.counter(
+            "hvd_resilience_stalls_total",
+            "Operations pending past the stall-warning threshold "
+            "(utils/stall.py)"),
+        "rollbacks": reg.counter(
+            "hvd_resilience_rollbacks_total",
+            "NaN/loss-spike rollbacks to the last good checkpoint "
+            "(ElasticTrainer)"),
+        "emergency_saves": reg.counter(
+            "hvd_resilience_emergency_saves_total",
+            "Emergency checkpoints cut on a preemption signal"),
+        "recovery": reg.histogram(
+            "hvd_resilience_recovery_seconds",
+            "Fault -> requeued-and-running latency per watchdog "
+            "restart (time-to-requeue)"),
+    }
+
+
+def training_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """The training plane: step cadence, throughput, and the MFU
+    gauge (analytic FLOPs over the device's peak,
+    utils/profile_analysis.py math)."""
+    reg = reg or registry()
+    return {
+        "steps": reg.counter(
+            "hvd_training_steps_total", "Training steps completed"),
+        "step_time": reg.histogram(
+            "hvd_training_step_seconds",
+            "Host-side step cadence (dispatch-to-dispatch; device "
+            "time belongs to jax.profiler — docs/timeline.md)"),
+        "tokens_per_s": reg.gauge(
+            "hvd_training_tokens_per_s",
+            "Training throughput (tokens or examples per second, "
+            "per the step's declared work)"),
+        "mfu": reg.gauge(
+            "hvd_training_mfu",
+            "Model FLOPs utilization: declared FLOPs/step over the "
+            "device's peak (utils/profile_analysis.py)"),
+    }
+
+
+def collective_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """Eager-collective dispatch counts by op (SPMD in-graph
+    collectives are compiled away and invisible to the host)."""
+    reg = reg or registry()
+    return {
+        "dispatched": reg.counter(
+            "hvd_collectives_total",
+            "Eager collective dispatches by op", ("op",)),
+    }
+
+
+def event_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """The structured-event log's own volume counter."""
+    reg = reg or registry()
+    return {
+        "events": reg.counter(
+            "hvd_events_total",
+            "Structured events emitted to the JSONL event log, "
+            "by kind", ("kind",)),
+    }
+
+
+def declare_standard_metrics(
+        reg: Optional[MetricRegistry] = None) -> Dict[str, Dict]:
+    """Idempotently declare every standard family; the exporter calls
+    this at startup so any scrape exposes the complete catalog."""
+    reg = reg or registry()
+    return {
+        "serving": serving_metrics(reg),
+        "resilience": resilience_metrics(reg),
+        "training": training_metrics(reg),
+        "collectives": collective_metrics(reg),
+        "events": event_metrics(reg),
+    }

@@ -1,0 +1,166 @@
+"""Bounded structured-event log (JSONL).
+
+Metrics answer "how much / how fast"; this log answers "what
+happened": the DISCRETE occurrences an operator greps for during an
+incident — engine restarts, request requeues, shed requests, chaos
+fires, stall warnings, first-time-shape compiles, preemption signals,
+NaN rollbacks. Each event is one JSON object per line with a
+monotonic ``seq``, a wall-clock ``ts``, a ``kind``, and free-form
+fields (``trace_id`` whenever the event belongs to a request, the
+tracing leg of docs/observability.md).
+
+Bounded on BOTH sides: the in-memory ring keeps the newest ``maxlen``
+events for `/metrics.json` / `tail()`, and the JSONL file (enabled by
+``HVD_EVENTS_LOG=/path``) rotates once past ``max_bytes`` (one ``.1``
+generation) so an incident log can never fill a disk. File faults
+warn-and-disable, the Timeline's contract: observability must never
+cost the workload.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from horovod_tpu.obs import catalog
+
+__all__ = ["EventLog", "emit", "tail", "get", "configure"]
+
+
+class EventLog:
+    def __init__(self, path: Optional[str] = None, *,
+                 maxlen: int = 2048,
+                 max_bytes: int = 8 * 1024 * 1024):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=maxlen)
+        self._seq = 0
+        self._path = path or None
+        self._max_bytes = max_bytes
+        self._bytes = 0
+        self._disabled = False
+        self._fh = None   # persistent append handle (lazy; rotation
+        #                   reopens) — per-event open/close would put
+        #                   two syscalls inside the lock every emit
+        self._counter = catalog.event_metrics()["events"]
+        if self._path:
+            try:
+                self._bytes = os.path.getsize(self._path)
+            except OSError:
+                self._bytes = 0
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    def emit(self, kind: str, **fields) -> Dict:
+        """Record one event; returns the record (already stamped)."""
+        with self._lock:
+            self._seq += 1
+            rec = {"ts": round(time.time(), 6), "seq": self._seq,
+                   "kind": kind}
+            rec.update(fields)
+            self._ring.append(rec)
+            if self._path and not self._disabled:
+                self._write_locked(rec)
+        self._counter.inc(kind=kind)
+        return rec
+
+    def _write_locked(self, rec: Dict):
+        line = json.dumps(rec, default=repr) + "\n"
+        try:
+            if self._bytes + len(line) > self._max_bytes:
+                # One rotation generation: the previous .1 is dropped.
+                self._close_fh_locked()
+                os.replace(self._path, self._path + ".1")
+                self._bytes = 0
+            if self._fh is None:
+                self._fh = open(self._path, "a")
+            self._fh.write(line)
+            self._fh.flush()   # line-durable: tail -f sees each event
+            self._bytes += len(line)
+        except OSError as e:
+            # Warn-and-disable (the Timeline's unwritable-file
+            # contract): a full disk must cost the event log, never
+            # the serving request or train step that emitted.
+            self._disabled = True
+            self._close_fh_locked()
+            sys.stderr.write(
+                f"WARNING: error writing the event log "
+                f"{self._path!r}, disabling it: {e}\n")
+
+    def _close_fh_locked(self):
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    def close(self):
+        """Release the file handle (the ring stays readable)."""
+        with self._lock:
+            self._close_fh_locked()
+
+    def tail(self, n: int = 100) -> List[Dict]:
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+_LOG: Optional[EventLog] = None
+_LOG_LOCK = threading.Lock()
+
+
+def get() -> EventLog:
+    """The process-global log, built lazily from ``HVD_EVENTS_LOG``
+    (unset = in-memory ring only)."""
+    global _LOG
+    with _LOG_LOCK:
+        if _LOG is None:
+            from horovod_tpu.runtime.config import env_str
+            _LOG = EventLog(env_str("HVD_EVENTS_LOG") or None)
+        return _LOG
+
+
+def configure(path: Optional[str] = None, *, maxlen: int = 2048,
+              max_bytes: int = 8 * 1024 * 1024) -> EventLog:
+    """Install a fresh global log (programmatic twin of
+    ``HVD_EVENTS_LOG``; bench and tests point it at a temp file).
+    Returns the new log; the previous one is simply dropped — for a
+    scoped swap that must not clobber a user-configured log, use
+    `install` and restore the returned previous one."""
+    global _LOG
+    with _LOG_LOCK:
+        _LOG = EventLog(path, maxlen=maxlen, max_bytes=max_bytes)
+        return _LOG
+
+
+def install(log: Optional[EventLog]) -> Optional[EventLog]:
+    """Swap the global log, returning the PREVIOUS one (which may be
+    None if nothing ever emitted). The scoped-use twin of `configure`:
+    save the return value and re-install it when done, so a temporary
+    redirect (bench's trace check, a test) never silently disables a
+    log the user configured via ``HVD_EVENTS_LOG``."""
+    global _LOG
+    with _LOG_LOCK:
+        prev, _LOG = _LOG, log
+        return prev
+
+
+def emit(kind: str, **fields) -> Dict:
+    """One-line event hook for the subsystems: stamps ts/seq/kind,
+    mirrors a ``hvd_events_total{kind=...}`` count, appends to the
+    ring (and the JSONL file when configured)."""
+    return get().emit(kind, **fields)
+
+
+def tail(n: int = 100) -> List[Dict]:
+    return get().tail(n)

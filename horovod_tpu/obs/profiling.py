@@ -1,0 +1,118 @@
+"""Profiling hooks: step brackets and the opt-in jax.profiler session.
+
+Two layers, mirroring the Timeline/profiler split (docs/timeline.md):
+
+* `StepProfiler` / `profile_step` — host-side step bracketing into
+  the metric registry: step cadence histogram, steps counter, and —
+  when the caller declares the step's work — tokens/s and an MFU
+  gauge (declared FLOPs per step over the device's peak, the
+  `utils/profile_analysis.py` math). This is what
+  `models/train.py`'s step factory wraps around every jitted step.
+* `profiler_session` — the device-side escape hatch: an opt-in
+  `jax.profiler` trace session gated on ``HVD_PROFILE_DIR``, whose
+  captures feed `profile_analysis.analyze_profile_dir` (measured α,
+  op breakdown). Opt-in because a trace session costs memory and
+  trace-file I/O; the metric registry is the always-on layer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+from horovod_tpu.obs import catalog, events
+
+__all__ = ["StepProfiler", "profile_step", "profiler_session"]
+
+
+def _device_kind() -> Optional[str]:
+    try:
+        import jax
+        return jax.devices()[0].device_kind
+    except (ImportError, RuntimeError, IndexError):
+        return None
+
+
+class StepProfiler:
+    """Reusable step bracket feeding the training metric family.
+
+    ``tokens_per_step`` drives the ``hvd_training_tokens_per_s``
+    gauge (tokens OR examples — whatever unit the loop thinks in);
+    ``flops_per_step`` plus a known device peak drives
+    ``hvd_training_mfu``. Both optional: without them the bracket
+    still records the step-cadence histogram and step counter.
+
+    The measured time is host dispatch-to-return — under jax's async
+    dispatch that is the step CADENCE, not device busy time (which
+    belongs to `profiler_session`); on a saturated pipeline the two
+    converge, and cadence is the number input stalls show up in.
+    """
+
+    def __init__(self, name: str = "train_step", *,
+                 tokens_per_step: Optional[float] = None,
+                 flops_per_step: Optional[float] = None,
+                 device_kind: Optional[str] = None):
+        self.name = name
+        self.tokens_per_step = tokens_per_step
+        self.flops_per_step = flops_per_step
+        self._m = catalog.training_metrics()
+        self._device_kind = (device_kind if device_kind is not None
+                             else _device_kind())
+
+    def observe(self, dt_s: float):
+        """Fold one completed step of ``dt_s`` seconds in."""
+        self._m["steps"].inc()
+        self._m["step_time"].observe(dt_s)
+        if dt_s <= 0:
+            return
+        if self.tokens_per_step:
+            self._m["tokens_per_s"].set(self.tokens_per_step / dt_s)
+        if self.flops_per_step:
+            from horovod_tpu.utils.profile_analysis import mfu
+            m = mfu(self.flops_per_step / dt_s, self._device_kind)
+            if m is not None:
+                self._m["mfu"].set(m)
+
+    @contextlib.contextmanager
+    def step(self):
+        t0 = time.perf_counter()
+        yield
+        self.observe(time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def profile_step(name: str = "train_step", *,
+                 tokens: Optional[float] = None,
+                 flops: Optional[float] = None,
+                 device_kind: Optional[str] = None):
+    """One-shot step bracket (`with obs.profile_step(...):`) — the ad
+    hoc flavor of `StepProfiler` for loops that do not keep one."""
+    prof = StepProfiler(name, tokens_per_step=tokens,
+                        flops_per_step=flops,
+                        device_kind=device_kind)
+    with prof.step():
+        yield prof
+
+
+@contextlib.contextmanager
+def profiler_session(profile_dir: Optional[str] = None):
+    """Opt-in `jax.profiler` trace session. ``profile_dir=None``
+    reads ``HVD_PROFILE_DIR``; unset = no-op (yields None) so call
+    sites can bracket unconditionally. Start/stop are recorded in the
+    event log; analyze the capture with
+    `utils.profile_analysis.analyze_profile_dir`."""
+    if profile_dir is None:
+        from horovod_tpu.runtime.config import env_str
+        profile_dir = env_str("HVD_PROFILE_DIR") or None
+    if not profile_dir:
+        yield None
+        return
+    import jax
+    jax.profiler.start_trace(profile_dir)
+    events.emit("profile.start", dir=profile_dir)
+    try:
+        yield profile_dir
+    finally:
+        jax.profiler.stop_trace()
+        events.emit("profile.stop", dir=profile_dir)

@@ -385,6 +385,21 @@ def _shard_over_mesh(st, stacked: np.ndarray) -> jax.Array:
     return jax.device_put(jnp.asarray(stacked), sharding)
 
 
+# Cached once: _run_collective runs per collective per step, and
+# re-resolving the family through the registry lock every dispatch
+# would put avoidable lock traffic on the eager hot path.
+_COLLECTIVES_COUNTER = None
+
+
+def _collectives_counter():
+    global _COLLECTIVES_COUNTER
+    if _COLLECTIVES_COUNTER is None:
+        from horovod_tpu.obs import catalog as _obs_catalog
+        _COLLECTIVES_COUNTER = _obs_catalog.collective_metrics()[
+            "dispatched"]
+    return _COLLECTIVES_COUNTER
+
+
 def _run_collective(st, key, fn, data, *, mesh=None, in_specs=None,
                     out_specs=None):
     """Dispatch a cached shard_map'd collective over the framework mesh
@@ -403,6 +418,10 @@ def _run_collective(st, key, fn, data, *, mesh=None, in_specs=None,
     # rendezvous, so StallMonitor brackets around this call see the op
     # pending.
     chaos.slow_site("collective_slow")
+    # Observability: eager dispatches are the only collectives the
+    # host can still see at runtime (SPMD in-graph ones compile away)
+    # — count them by op so a scrape shows the eager-path volume.
+    _collectives_counter().inc(op=key[0])
     jitted = st.op_cache.get(key)
     if jitted is None:
         # check_vma=False: all_gather outputs are replicated by

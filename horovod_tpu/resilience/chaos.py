@@ -186,10 +186,27 @@ def active() -> Optional[ChaosMonkey]:
     return _active
 
 
+def _record_fire(site: str):
+    """Observability for a fired fault (docs/observability.md): the
+    per-site ``hvd_resilience_faults_injected_total`` counter and a
+    structured event. Only runs on the (rare) fire path, so the
+    zero-overhead-when-disarmed contract of `fires` is untouched."""
+    from horovod_tpu.obs import catalog as _obs_catalog
+    from horovod_tpu.obs import events as _events
+    _obs_catalog.resilience_metrics()["faults_injected"].inc(
+        site=site)
+    _events.emit("chaos.fire", site=site)
+
+
 def fires(site: str) -> bool:
     """The zero-overhead-when-disabled site hook."""
     m = _active
-    return False if m is None else m.fires(site)
+    if m is None:
+        return False
+    hit = m.fires(site)
+    if hit:
+        _record_fire(site)
+    return hit
 
 
 def slow_site(site: str, default_delay: float = 1.0) -> bool:
@@ -200,6 +217,7 @@ def slow_site(site: str, default_delay: float = 1.0) -> bool:
     m = _active
     if m is None or not m.fires(site):
         return False
+    _record_fire(site)
     import time
     time.sleep(m.delay_of(site, default_delay))
     return True

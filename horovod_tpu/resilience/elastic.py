@@ -65,6 +65,11 @@ class PreemptionHandler:
         self._prev.clear()
 
     def _on_signal(self, signum, frame):
+        # No observability emission HERE: a Python signal handler runs
+        # between bytecodes on the main thread, and the event log's
+        # lock could already be held by the interrupted frame — the
+        # loop-side consumers (`ElasticTrainer.after_step`) emit the
+        # preemption event from clean context instead.
         if self._event.is_set():
             # Second signal: restore the previous disposition and
             # re-deliver so a wedged loop still dies (SIG_DFL SIGTERM
@@ -248,6 +253,8 @@ class ElasticTrainer:
         return self.handler is not None and self.handler.triggered
 
     def _rollback(self, step: int, loss: float) -> Any:
+        from horovod_tpu.obs import catalog as _obs_catalog
+        from horovod_tpu.obs import events as _events
         from horovod_tpu.utils import checkpoint as ckpt
         self.rollbacks += 1
         out = ckpt.restore_latest(self.directory, like=self._like,
@@ -260,6 +267,9 @@ class ElasticTrainer:
         # The restore may have fallen back PAST what we last wrote
         # (that checkpoint could itself be the corrupt one).
         self._last_good_step = good_step
+        _obs_catalog.resilience_metrics()["rollbacks"].inc()
+        _events.emit("training.rollback", step=step, loss=loss,
+                     restored_step=int(good_step))
         sys.stderr.write(
             f"horovod_tpu: step {step} diverged (loss={loss}); rolled "
             f"back to checkpoint step {good_step} "
@@ -271,12 +281,18 @@ class ElasticTrainer:
         would race teardown), once."""
         if self._emergency_done:
             return
+        from horovod_tpu.obs import catalog as _obs_catalog
+        from horovod_tpu.obs import events as _events
         from horovod_tpu.utils import checkpoint as ckpt
         ckpt.wait_pending()
         ckpt.save_step(self.directory, step, state, keep=self.keep,
                        block=True, retry=self.retry)
         self._last_good_step = step
         self._emergency_done = True
+        _obs_catalog.resilience_metrics()["emergency_saves"].inc()
+        _events.emit(
+            "training.emergency_save", step=step,
+            signum=getattr(self.handler, "signum", None))
         sys.stderr.write(
             f"horovod_tpu: preemption signal "
             f"{getattr(self.handler, 'signum', None)} — emergency "

@@ -174,6 +174,12 @@ def init(devices: Optional[Sequence] = None,
         st.stall_monitor = StallMonitor(config.stall_warning_time,
                                         native=st.native)
 
+        # Observability exporter (docs/observability.md): env-gated —
+        # with HVD_METRICS_PORT unset this is a no-op, so the knob
+        # alone turns the HTTP endpoint on for any init()'d process.
+        from horovod_tpu.obs.exporter import start_exporter
+        start_exporter()
+
         st.initialized = True
         # Clean teardown even when user scripts never call shutdown()
         # (the reference finalizes from its global destructor,

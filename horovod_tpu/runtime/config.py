@@ -215,6 +215,23 @@ register_knob(
     "HVD_BENCH_PROBE_BUDGET_S", "float", "(unset)", "bench.py",
     "Caps the benchmark's backend probe loop (seconds) before the "
     "CPU fallback engages")
+register_knob(
+    "HVD_METRICS_PORT", "int", "(unset)", "obs/exporter.py",
+    "Serve Prometheus /metrics + /healthz + /metrics.json on this "
+    "port (0 = ephemeral; binds 127.0.0.1 — wider exposure is a "
+    "programmatic host= opt-in); honored by hvd.init() and "
+    "ServingEngine construction, unset disables the exporter, "
+    "docs/observability.md")
+register_knob(
+    "HVD_EVENTS_LOG", "str", "(unset)", "obs/events.py",
+    "Append the structured JSONL event log (restarts, requeues, "
+    "sheds, chaos fires, stalls, compiles) to this path "
+    "(size-rotated), docs/observability.md")
+register_knob(
+    "HVD_PROFILE_DIR", "str", "(unset)", "obs/profiling.py",
+    "Opt-in jax.profiler trace session directory "
+    "(obs.profiling.profiler_session); analyze captures with "
+    "utils/profile_analysis.py")
 
 
 # ---------------------------------------------------------------------------

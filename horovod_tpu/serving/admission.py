@@ -88,6 +88,12 @@ class Request:
     sampling: SamplingParams
     deadline: Optional[float]        # absolute time.time() or None
     future: Any                      # concurrent.futures.Future
+    # Observability identity (docs/observability.md): minted once at
+    # submit() and carried for the request's whole life — across the
+    # queue, prefill chunks, pipelined ticks AND watchdog-restart
+    # requeues (dataclasses.replace preserves it), so the event log,
+    # Timeline span args and metric exemplars all correlate on it.
+    trace_id: str = ""
     t_submit: float = 0.0
     t_prefill: float = 0.0           # dispatcher: prefill started
     t_first: float = 0.0             # dispatcher: first token emitted

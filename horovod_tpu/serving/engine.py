@@ -45,6 +45,10 @@ from typing import Optional
 
 import numpy as np
 
+from horovod_tpu.obs import catalog as _obs_catalog
+from horovod_tpu.obs import events as _events
+from horovod_tpu.obs import tracing as _tracing
+from horovod_tpu.obs.registry import registry as _obs_registry
 from horovod_tpu.resilience import chaos
 from horovod_tpu.models.transformer import TransformerLM
 from horovod_tpu.serving.admission import (
@@ -66,6 +70,10 @@ __all__ = ["ServingEngine", "RequestHandle", "CompletedRequest",
 # only bounds how stale a shutdown/cancel notice can go unnoticed.
 _IDLE_WAIT_S = 0.05
 
+# Process-unique engine numbers for /healthz provider keys (several
+# engines can coexist; each reports its own dispatch generation).
+_ENGINE_IDS = itertools.count()
+
 
 class RequestHandle:
     """The caller's view of one in-flight request."""
@@ -76,6 +84,13 @@ class RequestHandle:
     @property
     def id(self) -> int:
         return self._req.id
+
+    @property
+    def trace_id(self) -> str:
+        """The request's observability id — the key into the event
+        log, the Timeline span args, and the histogram exemplars
+        (docs/observability.md); survives watchdog-restart requeues."""
+        return self._req.trace_id
 
     @property
     def future(self) -> Future:
@@ -179,7 +194,11 @@ class ServingEngine:
         self.model = model
         self.eos_id = eos_id
         self.default_timeout_s = default_timeout_s
-        self.metrics = EngineMetrics()
+        # Process-unique engine number: the /healthz provider key and
+        # the `engine` label on the shared engine-scoped gauges.
+        self._engine_id = next(_ENGINE_IDS)
+        self.metrics = EngineMetrics(
+            engine_label=str(self._engine_id))
         self.auto_restart = auto_restart
         self.max_restarts = max_restarts
         self.tick_deadline_s = tick_deadline_s
@@ -228,6 +247,22 @@ class ServingEngine:
             args=(0, self.scheduler, self.queue),
             name="serving-dispatch", daemon=True)
         self._thread.start()
+        # Observability plane (docs/observability.md): the engine
+        # reports its dispatch generation + liveness at /healthz (so a
+        # prober can tell an in-place watchdog restart from a process
+        # restart) and mirrors the generation into the shared gauge
+        # (labeled per engine). Registered BEFORE the watchdog exists:
+        # a restart touching `_obs_gen` must never race construction.
+        self._obs_gen = _obs_catalog.serving_metrics()[
+            "engine_generation"]
+        self._obs_gen.set(0, engine=str(self._engine_id))
+        _obs_registry().register_health(
+            f"serving_engine_{self._engine_id}", self._health)
+        # Env-gated exporter bring-up (no-op unless HVD_METRICS_PORT
+        # is set): a serving process that never calls hvd.init() still
+        # honors the knob.
+        from horovod_tpu.obs.exporter import start_exporter
+        start_exporter()
         self._watchdog: Optional[threading.Thread] = None
         self._wd_stop = threading.Event()
         if auto_restart:
@@ -235,6 +270,21 @@ class ServingEngine:
                 target=self._watchdog_loop, name="serving-watchdog",
                 daemon=True)
             self._watchdog.start()
+
+    def _health(self) -> dict:
+        with self._lock:
+            alive = self._thread.is_alive()
+            return {
+                "engine_generation": self._epoch,
+                "dispatch_alive": alive,
+                "closing": self._closing,
+                "restarts": self._restart_count,
+                "queue_depth": len(self.queue),
+                # Drives /healthz's HTTP code: a dead (or draining)
+                # dispatch thread must read 503 to a status-code
+                # probe, not 200-with-fine-print.
+                "healthy": alive and not self._closing,
+            }
 
     # -- submit side --------------------------------------------------
 
@@ -278,18 +328,25 @@ class ServingEngine:
             id=next(self._ids), prompt=prompt,
             max_new_tokens=max_new_tokens, sampling=sampling,
             deadline=None if timeout_s is None else now + timeout_s,
-            future=Future(), t_submit=now)
+            future=Future(), trace_id=_tracing.new_trace_id(),
+            t_submit=now)
         self.metrics.count("submitted")
-        _span("begin_span", req.id, "QUEUE")
+        _span("begin_span", req.id, "QUEUE", trace_id=req.trace_id)
         try:
             self.queue.offer(req)
         except QueueFullError:
             self.metrics.count("rejected")
             _span("end_span", req.id, "QUEUE")
+            _events.emit("serving.shed", request_id=req.id,
+                         trace_id=req.trace_id,
+                         queue_depth=len(self.queue))
             raise
         except EngineClosedError:
             _span("end_span", req.id, "QUEUE")
             raise
+        _events.emit("serving.submit", request_id=req.id,
+                     trace_id=req.trace_id,
+                     prompt_tokens=P, max_new_tokens=max_new_tokens)
         return RequestHandle(req)
 
     # -- dispatch side ------------------------------------------------
@@ -449,6 +506,15 @@ class ServingEngine:
         self.metrics.count("restarts")
         if n:
             self.metrics.count("requeued", n)
+        self._obs_gen.set(epoch, engine=str(self._engine_id))
+        # Requeue continuity: the replayed requests keep their
+        # ORIGINAL trace_ids (dataclasses.replace preserves the
+        # field), so the event log shows one id crossing the restart.
+        _events.emit(
+            "serving.restart", engine=self._engine_id, reason=reason,
+            generation=epoch, requeued=n,
+            failed=len(inflight) - len(requeued),
+            requeued_trace_ids=[r.trace_id for r in requeued])
         # Fresh device state: the old pool's cache is mid-unknown-
         # tick; compiled programs are shared so this is cheap.
         self.pool = self.pool.clone_fresh()
@@ -482,6 +548,8 @@ class ServingEngine:
                 f"serving engine gave up: {why}"))
         doomed = self.queue.close(drain=False)
         self.metrics.count("aborted", len(doomed))
+        _events.emit("serving.contain", engine=self._engine_id,
+                     reason=why, failed=len(doomed))
         sys.stderr.write(f"serving watchdog: {why}; engine closed\n")
 
     # -- lifecycle ----------------------------------------------------
@@ -531,6 +599,13 @@ class ServingEngine:
                 f"engine shut down while request {req.id} was in "
                 f"flight"))
         self.metrics.count("aborted", n)
+        # The engine is gone from /healthz AND its labeled gauge rows
+        # leave the registry (idempotent: double shutdown removes
+        # missing keys harmlessly) — scrape cardinality tracks live
+        # engines only.
+        _obs_registry().unregister_health(
+            f"serving_engine_{self._engine_id}")
+        self.metrics.close()
 
     def __enter__(self) -> "ServingEngine":
         return self

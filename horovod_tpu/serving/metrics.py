@@ -1,5 +1,5 @@
 """Request-level serving metrics: TTFT / TPOT / throughput with
-p50/p95, queue depth, and slot occupancy.
+p50/p95/p99, queue depth, and slot occupancy.
 
 The vocabulary is the standard serving triple:
 
@@ -15,6 +15,15 @@ The vocabulary is the standard serving triple:
 Percentiles come from a bounded reservoir (newest `maxlen` samples) —
 serving metrics answer "how is it behaving NOW", so recency beats
 completeness and memory stays O(1) under unbounded load.
+
+Since the obs plane landed, `EngineMetrics` is ALSO a registrant of
+the process-wide `horovod_tpu.obs` registry: every counter mirrors
+into ``hvd_serving_events_total{event=...}``, the gauges into the
+``hvd_serving_*`` gauge family, and each finished request's latencies
+into the fixed-bucket ``hvd_serving_{ttft,tpot,queue_wait,e2e}_seconds``
+histograms (exemplar = the request's ``trace_id``), so one Prometheus
+scrape sees every engine in the process. The per-engine `snapshot()`
+dict remains the engine-scoped view (`metrics_snapshot()`).
 """
 
 from __future__ import annotations
@@ -23,6 +32,8 @@ import collections
 import threading
 import time
 from typing import Dict, Optional
+
+from horovod_tpu.obs import catalog as _obs_catalog
 
 
 class Series:
@@ -37,14 +48,20 @@ class Series:
     def __len__(self) -> int:
         return len(self._buf)
 
-    def percentile(self, q: float) -> Optional[float]:
-        """Nearest-rank percentile (q in [0, 100]); None when empty."""
-        if not self._buf:
-            return None
-        xs = sorted(self._buf)
+    @staticmethod
+    def _rank(xs, q: float) -> float:
+        """Nearest-rank pick from an ALREADY-SORTED sample list."""
         rank = min(len(xs) - 1, max(0, int(round(q / 100.0
                                                  * (len(xs) - 1)))))
         return xs[rank]
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Nearest-rank percentile (q in [0, 100]); None when empty.
+        One-off readout — `summary()` is the batch API and sorts the
+        reservoir exactly once for all its percentiles."""
+        if not self._buf:
+            return None
+        return self._rank(sorted(self._buf), q)
 
     def mean(self) -> Optional[float]:
         if not self._buf:
@@ -52,13 +69,20 @@ class Series:
         return sum(self._buf) / len(self._buf)
 
     def summary(self, scale: float = 1.0, nd: int = 2) -> Dict:
-        """{p50, p95, mean, n} with values scaled (e.g. 1e3 for ms)."""
+        """{p50, p95, p99, mean, n} with values scaled (e.g. 1e3 for
+        ms). Sorts the reservoir ONCE for all three percentiles —
+        `snapshot()` calls this per series, and the old
+        percentile-per-call shape paid O(n log n) twice per series
+        per scrape."""
         if not self._buf:
-            return {"p50": None, "p95": None, "mean": None, "n": 0}
-        return {"p50": round(self.percentile(50) * scale, nd),
-                "p95": round(self.percentile(95) * scale, nd),
-                "mean": round(self.mean() * scale, nd),
-                "n": len(self._buf)}
+            return {"p50": None, "p95": None, "p99": None,
+                    "mean": None, "n": 0}
+        xs = sorted(self._buf)
+        return {"p50": round(self._rank(xs, 50) * scale, nd),
+                "p95": round(self._rank(xs, 95) * scale, nd),
+                "p99": round(self._rank(xs, 99) * scale, nd),
+                "mean": round((sum(xs) / len(xs)) * scale, nd),
+                "n": len(xs)}
 
 
 class EngineMetrics:
@@ -70,9 +94,20 @@ class EngineMetrics:
     never sees a torn update.
     """
 
-    def __init__(self):
+    def __init__(self, engine_label: str = "0"):
         self._lock = threading.Lock()
         self._t0 = time.time()
+        # Monotonic per-snapshot sequence: lets a scraper distinguish
+        # an engine RESTART (scrape_seq keeps climbing, uptime_s keeps
+        # climbing, engine_generation bumps) from a counter RESET
+        # (scrape_seq/uptime_s start over — a new engine/process).
+        self._scrape_seq = 0
+        # The process-wide obs families this engine registers into;
+        # engine-scoped gauges are labeled by `engine_label` so
+        # coexisting engines never overwrite each other's gauges.
+        self._engine_label = str(engine_label)
+        self._obs = _obs_catalog.serving_metrics()
+        self._obs_res = _obs_catalog.resilience_metrics()
         # Counters.
         self.submitted = 0
         self.rejected = 0          # shed at the full queue
@@ -116,6 +151,7 @@ class EngineMetrics:
     def observe_recovery(self, dt_s: float):
         with self._lock:
             self.recovery_s.add(dt_s)
+        self._obs_res["recovery"].observe(dt_s)
 
     def observe_pipeline(self, depth: int):
         with self._lock:
@@ -128,6 +164,14 @@ class EngineMetrics:
     def count(self, name: str, n: int = 1):
         with self._lock:
             setattr(self, name, getattr(self, name) + n)
+        self._obs["events"].inc(n, event=name)
+        # The watchdog counters are ALSO the resilience plane's
+        # restarts/requeued families (one source of truth per number;
+        # chaos owns the per-site faults_injected breakdown).
+        if name == "restarts":
+            self._obs_res["restarts"].inc(n)
+        elif name == "requeued":
+            self._obs_res["requeued"].inc(n)
 
     def observe_gauges(self, queue_depth: int, slots_busy: int,
                        num_slots: int):
@@ -135,24 +179,58 @@ class EngineMetrics:
             self.queue_depth = queue_depth
             self.slots_busy = slots_busy
             self.num_slots = num_slots
+        eng = self._engine_label
+        self._obs["queue_depth"].set(queue_depth, engine=eng)
+        self._obs["slots_busy"].set(slots_busy, engine=eng)
+        self._obs["slots_total"].set(num_slots, engine=eng)
+        if num_slots:
+            self._obs["slot_occupancy"].set(slots_busy / num_slots,
+                                            engine=eng)
 
     def observe_request(self, *, t_submit: float, t_prefill: float,
-                        t_first: float, t_done: float, n_tokens: int):
+                        t_first: float, t_done: float, n_tokens: int,
+                        trace_id: str = ""):
         """Fold one finished request into the series (called by the
-        dispatcher at retire time, successful finishes only)."""
+        dispatcher at retire time, successful finishes only).
+        ``trace_id`` becomes the shared-registry histograms' exemplar
+        — the metrics leg of request tracing."""
         with self._lock:
             self.queue_wait_s.add(t_prefill - t_submit)
             self.ttft_s.add(t_first - t_submit)
             if n_tokens > 1:
                 self.tpot_s.add((t_done - t_first) / (n_tokens - 1))
             self.e2e_s.add(t_done - t_submit)
+        ex = {"trace_id": trace_id} if trace_id else None
+        self._obs["queue_wait"].observe(t_prefill - t_submit,
+                                        exemplar=ex)
+        self._obs["ttft"].observe(t_first - t_submit, exemplar=ex)
+        if n_tokens > 1:
+            self._obs["tpot"].observe(
+                (t_done - t_first) / (n_tokens - 1), exemplar=ex)
+        self._obs["e2e"].observe(t_done - t_submit, exemplar=ex)
+
+    def close(self):
+        """Drop this engine's labeled gauge rows from the shared
+        registry (shutdown path): a dead engine's frozen queue-depth
+        must not linger on /metrics forever, and per-engine series
+        cardinality must track live engines, not every engine the
+        process ever built. Counters/histograms are process-lifetime
+        aggregates and stay."""
+        eng = self._engine_label
+        for name in ("queue_depth", "slots_busy", "slots_total",
+                     "slot_occupancy", "engine_generation"):
+            self._obs[name].remove(engine=eng)
 
     def snapshot(self) -> Dict:
-        """One JSON-ready dict: counters, gauges, p50/p95 latencies
-        (ms), and the engine-lifetime output tokens/s."""
+        """One JSON-ready dict: counters, gauges, p50/p95/p99
+        latencies (ms), the engine-lifetime output tokens/s, plus the
+        scraper-disambiguation pair (`scrape_seq`, `uptime_s`)."""
         with self._lock:
+            self._scrape_seq += 1
             dt = max(time.time() - self._t0, 1e-9)
             return {
+                "scrape_seq": self._scrape_seq,
+                "uptime_s": round(dt, 3),
                 "submitted": self.submitted,
                 "rejected": self.rejected,
                 "completed": self.completed,

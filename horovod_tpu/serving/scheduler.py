@@ -54,6 +54,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from horovod_tpu.annotations import hot_path
+from horovod_tpu.obs import events as _events
 from horovod_tpu.resilience import chaos
 from horovod_tpu.serving.admission import (
     AdmissionQueue, DeadlineExceededError, EngineClosedError, Request,
@@ -73,6 +74,7 @@ class CompletedRequest:
     ttft_s: float
     tpot_s: Optional[float]       # None for single-token outputs
     e2e_s: float
+    trace_id: str = ""            # the request's observability id
 
     @property
     def full_sequence(self) -> np.ndarray:
@@ -113,9 +115,19 @@ def _timeline():
         return None   # interpreter teardown / pre-init introspection
 
 
-def _span(method: str, request_id: int, name: str):
+def _span(method: str, request_id: int, name: str,
+          trace_id: str = ""):
+    """Emit a request-span Timeline verb; begin_span additionally
+    stamps the request's ``trace_id`` into the span ``args`` (the
+    Timeline leg of request tracing — one id follows the request
+    across QUEUE/PREFILL/DECODE and engine restarts)."""
     tl = _timeline()
-    if tl is not None:
+    if tl is None:
+        return
+    if method == "begin_span" and trace_id:
+        tl.begin_span(f"request:{request_id}", name,
+                      args={"trace_id": trace_id})
+    else:
         getattr(tl, method)(f"request:{request_id}", name)
 
 
@@ -353,7 +365,8 @@ class ContinuousBatchingScheduler:
                     self._prefill_order.append(slot)
                 req.t_prefill = time.time()
                 _span("end_span", req.id, "QUEUE")
-                _span("begin_span", req.id, "PREFILL")
+                _span("begin_span", req.id, "PREFILL",
+                      trace_id=req.trace_id)
                 # Registered BEFORE any device work so a fault inside
                 # it (compile failure, OOM) leaves the request findable
                 # by the engine's crash containment — never a future
@@ -406,7 +419,8 @@ class ContinuousBatchingScheduler:
         req.tokens.append(first)
         self.metrics.count("tokens_out")
         _span("end_span", req.id, "PREFILL")
-        _span("begin_span", req.id, "DECODE")
+        _span("begin_span", req.id, "DECODE",
+              trace_id=req.trace_id)
         self._maybe_retire(slot, req, first, req.t_first)
 
     def _queue_drop(self, req: Request, kind: str):
@@ -418,6 +432,8 @@ class ContinuousBatchingScheduler:
         tl = _timeline()
         if tl is not None:
             tl.mark(f"request:{req.id}", kind.upper())
+        _events.emit("serving.queue_drop", request_id=req.id,
+                     trace_id=req.trace_id, reason=kind)
 
     def _maybe_retire(self, slot: int, req: Request, tok: int,
                       now: float):
@@ -479,12 +495,16 @@ class ContinuousBatchingScheduler:
         tl = _timeline()
         if tl is not None:
             tl.mark(f"request:{req.id}", reason.upper())
+        _events.emit("serving.retire", request_id=req.id,
+                     trace_id=req.trace_id, reason=reason,
+                     tokens=len(req.tokens))
         if reason in ("eos", "length"):
             n = len(req.tokens)
             self.metrics.count("completed")
             self.metrics.observe_request(
                 t_submit=req.t_submit, t_prefill=req.t_prefill,
-                t_first=req.t_first, t_done=now, n_tokens=n)
+                t_first=req.t_first, t_done=now, n_tokens=n,
+                trace_id=req.trace_id)
             self._resolve(req.future, result=CompletedRequest(
                 request_id=req.id,
                 # hvd: disable=HVD001(req.prompt is the submitted numpy array, req.tokens a host list — retire-time packaging, no device read)
@@ -495,7 +515,8 @@ class ContinuousBatchingScheduler:
                 ttft_s=req.t_first - req.t_submit,
                 tpot_s=((now - req.t_first) / (n - 1)
                         if n > 1 else None),
-                e2e_s=now - req.t_submit))
+                e2e_s=now - req.t_submit,
+                trace_id=req.trace_id))
         elif reason == "cancelled":
             self.metrics.count("cancelled")
             self._resolve(req.future, exc=CancelledError())

@@ -145,6 +145,14 @@ class SlotPool:
         if key not in self._seen_shapes:
             self.compiles += 1
             self._seen_shapes.add(key)
+            # Observability: compiles are discrete operator-visible
+            # events (a compile inside a warmed serving window is a
+            # bug ci.sh asserts against) — count them process-wide
+            # and log which program shape triggered.
+            from horovod_tpu.obs import catalog as _obs_catalog
+            from horovod_tpu.obs import events as _events
+            _obs_catalog.serving_metrics()["compiles"].inc()
+            _events.emit("serving.compile", shape=repr(key))
 
     def clone_fresh(self) -> "SlotPool":
         """A brand-new pool over the same model/params/mesh — the

@@ -33,6 +33,35 @@ import os
 import re
 from typing import Any, Dict, List, Optional, Tuple
 
+# Peak bf16 FLOP/s by device kind (public TPU specs) — the MFU
+# denominator, shared by bench.py's analytic estimates and the
+# obs-plane `hvd_training_mfu` gauge (obs/profiling.StepProfiler).
+PEAK_BF16_FLOPS = {
+    "TPU v4": 275e12, "TPU v5 lite": 197e12, "TPU v5e": 197e12,
+    "TPU v5p": 459e12, "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+}
+
+
+def device_peak_flops(device_kind: Optional[str]) -> Optional[float]:
+    """Peak bf16 FLOP/s for a jax ``device_kind`` string; None for
+    unknown hardware (CPU, unlisted TPU generations) — MFU is then
+    unreported rather than fabricated."""
+    if not device_kind:
+        return None
+    return PEAK_BF16_FLOPS.get(device_kind)
+
+
+def mfu(flops_per_s: float,
+        device_kind: Optional[str]) -> Optional[float]:
+    """Model FLOPs utilization: achieved FLOP/s over the device peak
+    (coarse but honest — docs/mfu.md); None when the peak is
+    unknown."""
+    peak = device_peak_flops(device_kind)
+    if not peak:
+        return None
+    return round(flops_per_s / peak, 4)
+
+
 # HLO collective op names (TPU device timeline), e.g. "all-reduce.1",
 # "all-reduce-start.7", "all-gather-done.3", "collective-permute.2".
 _COLLECTIVE_RE = re.compile(

@@ -73,7 +73,7 @@ class StallMonitor:
         the Python backend.
         """
         if self._native is not None:
-            return self._native.stall_check()
+            return self._record_stalls(self._native.stall_check())
         now = now if now is not None else time.time()
         stalled = []
         with self._lock:
@@ -81,6 +81,7 @@ class StallMonitor:
                 if now - t0 > self._warning_time and name not in self._warned:
                     stalled.append(name)
                     self._warned.add(name)
+        self._record_stalls(stalled)
         if stalled:
             # Message shape follows mpi_ops.cc:1166-1186.
             sys.stderr.write(
@@ -92,6 +93,31 @@ class StallMonitor:
                 "submitting tensors, which will cause deadlock.\n"
                 "Stalled ops: %s\n" % (int(self._warning_time),
                                        ", ".join(stalled)))
+        return stalled
+
+    def _record_stalls(self, stalled):
+        """Beyond the stderr warning, each newly-stalled op now lands
+        in the observability plane (docs/observability.md): the
+        ``hvd_resilience_stalls_total`` counter and one structured
+        event per op — a stall is exactly the discrete incident
+        signal the event log exists for.
+
+        Coverage caveat: with the NATIVE control plane loaded the C++
+        sweep thread owns the periodic check and warns on stderr
+        directly — it never passes through here, so on that backend
+        only programmatic `check_once()` polls reach the counter/
+        event log (the pure-Python sweep, the in-process default,
+        records everything). Routing the C++ sweep through the plane
+        needs a native->Python callback; out of scope here."""
+        if stalled:
+            from horovod_tpu.obs import catalog as _obs_catalog
+            from horovod_tpu.obs import events as _events
+            _obs_catalog.resilience_metrics()["stalls"].inc(
+                len(stalled))
+            for name in stalled:
+                _events.emit(
+                    "stall", op=name,
+                    threshold_s=self._warning_time)
         return stalled
 
     def _loop(self):

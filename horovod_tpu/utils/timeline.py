@@ -121,18 +121,24 @@ class Timeline:
                 self._states[tensor] = UNKNOWN
             self._maybe_flush()
 
-    def begin_span(self, process: str, name: str):
+    def begin_span(self, process: str, name: str,
+                   args: Optional[dict] = None):
         """Open a named B span on ``process`` (interned as its own
         trace pid, like a tensor) — the request-level vocabulary the
         serving engine emits (QUEUE / PREFILL / DECODE), so every
         request renders as a distinct trace process in
         chrome://tracing. Unlike `record` there is no per-tensor state
         machine: spans pair by name via `end_span` and nest freely.
+        ``args`` lands in the Chrome-trace event's ``args`` payload —
+        the serving engine stamps each request's ``trace_id`` there,
+        so a span in chrome://tracing links to the same request's
+        event-log lines and metric exemplars (docs/observability.md).
 
         The native C++ writer has no generic-span verb, so spans ride
         its TOP_LEVEL/DONE tensor lifecycle (one outer process-named
         bar wrapping each span's activity bar) — same trace, slightly
-        chattier nesting."""
+        chattier nesting, and ``args`` are dropped (the Python writer
+        is the tracing-fidelity path)."""
         if self._native is not None:
             if not self._closed:
                 self._native.timeline_record(process, "TOP_LEVEL", name)
@@ -140,7 +146,10 @@ class Timeline:
         with self._lock:
             if self._closed:
                 return
-            self._emit("B", name, self._pid(process))
+            if args:
+                self._emit("B", name, self._pid(process), args=args)
+            else:
+                self._emit("B", name, self._pid(process))
             self._maybe_flush()
 
     def end_span(self, process: str, name: str):
