@@ -773,6 +773,47 @@ def run_serving(args, devices, n_chips, log):
     return out
 
 
+def run_resume_check(args):
+    """--resume-check: the exactly-once resumable-training acceptance
+    artifact (docs/resilience.md "Exact resume"). Runs the
+    crash-restart equivalence harness — train a small sharded-dataset
+    workload uninterrupted, then again under chaos-injected kills
+    (kill-mid-epoch + kill-during-save) with restarts — and records
+    the proof: bitwise-identical batch streams, params match,
+    resume_gap_batches == 0, plus recovery_ms per restart. Host-side
+    (numpy + checkpoint I/O), so it runs identically on any backend;
+    cpu is forced unless --platform says otherwise."""
+    import tempfile
+
+    _force_platform(args.platform or "cpu")
+    from horovod_tpu.resilience.equivalence import (
+        run_crash_restart_equivalence)
+
+    import shutil
+
+    workdir = tempfile.mkdtemp(prefix="hvd_resume_check_")
+    try:
+        report = run_crash_restart_equivalence(workdir, log=log)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    s = report.summary()
+    # Same acceptance as the module CLI: equivalent, zero gap, AND at
+    # least one kill actually fired — an externally-armed monkey with
+    # unrelated sites would otherwise make this a vacuous pass.
+    result = {
+        "metric": "crash_restart_equivalence",
+        "value": 1.0 if (report.ok and report.resume_gap_batches == 0
+                         and report.kills > 0) else 0.0,
+        "unit": "bool",
+        "vs_baseline": None,  # reference has no exact-resume story
+        **s,
+    }
+    _set_best(result)
+    emit(_BEST_RESULT)
+    write_out(args)
+    return 0 if result["value"] else 1
+
+
 def run_bert(args, devices, n_chips, log):
     """BERT-MLM pretraining throughput (tokens/sec/chip): the masked-
     LM objective on the shared encoder blocks (`models/bert.py`) —
@@ -1076,7 +1117,17 @@ def main():
     ap.add_argument("--profile", default=None, metavar="DIR",
                     help="capture a jax.profiler trace of the timed "
                          "steps into DIR (overlap/MFU analysis)")
+    ap.add_argument("--resume-check", action="store_true",
+                    help="run the crash-restart equivalence harness "
+                         "(exactly-once resumable training) and emit "
+                         "its report as the artifact: batch streams "
+                         "bitwise-identical across chaos-injected "
+                         "kills+restarts, resume_gap_batches == 0, "
+                         "recovery_ms recorded (docs/resilience.md)")
     args = ap.parse_args()
+
+    if args.resume_check:
+        sys.exit(run_resume_check(args))
 
     if args.model is None:  # driver default: full BASELINE.md coverage
         args.model = "resnet101"

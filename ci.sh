@@ -57,6 +57,21 @@ JAX_PLATFORMS=cpu python -m pytest -q --collect-only \
 JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4 \
     --warmup --interleave-check --obs-check
 
+# Resume smoke (docs/resilience.md "Exact resume"): a short training
+# run over a sharded shuffled dataset is killed mid-epoch AND
+# mid-checkpoint-save via HVD_CHAOS, restarted with full TrainSnapshot
+# resume (model + data cursor + guard), and equivalence-checked
+# against an uninterrupted control — the batch streams must be
+# bitwise identical, final params must match, and the resume gap must
+# be 0 (the module exits nonzero otherwise, and also if no kill
+# actually fired — an inert smoke proves nothing).
+rm -rf /tmp/hvd_resume_smoke
+HVD_CHAOS=train_crash:2,ckpt_kill:1 JAX_PLATFORMS=cpu \
+    python -m horovod_tpu.resilience.equivalence \
+    --workdir /tmp/hvd_resume_smoke --epochs 2 --save-every 2 \
+    2>&1 | tee /tmp/hvd_resume_smoke.log
+grep -q "equivalence OK" /tmp/hvd_resume_smoke.log
+
 # Chaos smoke (docs/resilience.md): one injected checkpoint-write
 # failure mid-run — the shared RetryPolicy must retry with backoff and
 # the run must still complete and leave a restorable checkpoint.

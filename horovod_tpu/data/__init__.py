@@ -15,6 +15,18 @@ this subsystem makes the recipe a component:
 Records are structured rows: a `spec` of (name, dtype, shape) fields,
 e.g. ``[("image", "float32", (28, 28, 1)), ("label", "int32", ())]``;
 batches come back as dicts of numpy arrays with a leading batch dim.
+
+Exact resume (docs/resilience.md "Exact resume"): both loader
+implementations shuffle with the SAME splitmix64-keyed stable sort, so
+native and fallback yield bitwise-identical batch streams for a given
+(seed, epoch, rank, world) — and the stream is addressable by a
+cursor. `state()` snapshots the cursor (epoch index, next batch,
+shuffle seed — everything needed to re-derive the permutation),
+`restore(state)` validates and re-installs it in a fresh process, and
+`epoch(epoch_idx, start_batch=k)` restarts mid-epoch: the native
+loader skips to the record offset inside the producer
+(`hvd_dl_start_epoch_at`), the fallback slices the shuffled order —
+batches ``k..end`` are bitwise identical to the uninterrupted epoch's.
 """
 
 from __future__ import annotations
@@ -29,6 +41,41 @@ from horovod_tpu.resilience import chaos
 from horovod_tpu.resilience.retry import default_io_policy
 
 Spec = Sequence[Tuple[str, str, Tuple[int, ...]]]
+
+# Version stamp of the `ShardedDataset.state()` dict; bump on any
+# incompatible change so a stale cursor fails restore() loudly instead
+# of silently mis-seeking.
+DATA_STATE_SCHEMA = 1
+
+_GOLDEN = 0x9E3779B97F4A7C15  # splitmix64 stream constant
+
+
+class DataStateError(ValueError):
+    """A data-pipeline cursor cannot be restored onto this dataset —
+    wrong schema version or the dataset's identity fields (seed,
+    batch size, sharding, ...) disagree with the snapshot's. Resume
+    logic catches this and falls back to the epoch boundary
+    (`resilience/elastic.py`), loudly."""
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer over a uint64 array — the shared shuffle
+    key (`native/data_loader.cc::Mix64` is the same arithmetic; the
+    two must never diverge or native/fallback parity breaks)."""
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def shuffle_perm(n: int, seed: int, epoch: int) -> np.ndarray:
+    """The deterministic epoch permutation BOTH loader implementations
+    apply: stable argsort of splitmix64 keys Mix64(seed*GOLDEN+epoch+i).
+    Stable, so key ties break toward the lower index — matching the
+    native `std::stable_sort`. Exposed so tests (and any external
+    tooling) can compute the oracle order without a loader."""
+    base = (int(seed) * _GOLDEN + int(epoch)) % (1 << 64)
+    keys = _mix64(np.uint64(base) + np.arange(n, dtype=np.uint64))
+    return np.argsort(keys, kind="stable")
 
 
 def _open_with_retry(path: str, mode: str):
@@ -128,6 +175,17 @@ class _NativeLoader:
             ctypes.c_int64, ctypes.c_int]
         lib.hvd_dl_start_epoch.argtypes = [ctypes.c_void_p,
                                            ctypes.c_uint64]
+        try:
+            lib.hvd_dl_start_epoch_at.argtypes = [
+                ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64]
+            self._start_at = lib.hvd_dl_start_epoch_at
+        except AttributeError:
+            # Stale pre-resume .so (build.py rebuilds on source mtime,
+            # so this only survives an externally-pinned library):
+            # epoch() below fast-forwards on the host instead —
+            # batches 0..k-1 are produced and discarded, slow but
+            # cursor-correct.
+            self._start_at = None
         lib.hvd_dl_next.restype = ctypes.c_int64
         lib.hvd_dl_next.argtypes = [ctypes.c_void_p,
                                     ctypes.POINTER(ctypes.c_uint8)]
@@ -149,8 +207,16 @@ class _NativeLoader:
     def num_records(self) -> int:
         return self._lib.hvd_dl_num_records(self._h)
 
-    def epoch(self, epoch_idx: int):
-        self._lib.hvd_dl_start_epoch(self._h, epoch_idx)
+    def epoch(self, epoch_idx: int, start_record: int = 0):
+        skip_batches = 0
+        if start_record > 0 and self._start_at is not None:
+            self._start_at(self._h, epoch_idx, start_record)
+        else:
+            # Documented host-side fast-forward (stale .so): producer
+            # runs the whole epoch; the first start_record/batch full
+            # batches are drained and discarded here.
+            self._lib.hvd_dl_start_epoch(self._h, epoch_idx)
+            skip_batches = start_record // self._batch
         buf = np.empty(self._batch * self._rb, np.uint8)
         ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
         while True:
@@ -160,6 +226,9 @@ class _NativeLoader:
                     self._lib.hvd_dl_error(self._h).decode())
             if n == 0:
                 return
+            if skip_batches > 0:
+                skip_batches -= 1
+                continue
             yield buf, int(n)
 
     def close(self):
@@ -182,16 +251,19 @@ class _PythonLoader:
     def num_records(self) -> int:
         return sum(os.path.getsize(f) // self._rb for f in self._files)
 
-    def epoch(self, epoch_idx: int):
+    def epoch(self, epoch_idx: int, start_record: int = 0):
         order = []
         for fi, f in enumerate(self._files):
             n = os.path.getsize(f) // self._rb
             order += [(fi, r) for r in range(n)]
         if self._shuffle:
-            rng = np.random.default_rng(
-                (self._seed * 0x9E3779B97F4A7C15 + epoch_idx)
-                % (2 ** 63))
-            rng.shuffle(order)
+            # The SAME permutation the native loader computes
+            # (splitmix64 keys + stable sort) — exact-resume parity.
+            order = [order[i]
+                     for i in shuffle_perm(len(order), self._seed,
+                                           epoch_idx)]
+        if start_record > 0:
+            order = order[start_record:]
         buf = np.empty(self._batch * self._rb, np.uint8)
         n_in = 0
         handles = [_open_with_retry(f, "rb") for f in self._files]
@@ -240,6 +312,13 @@ class ShardedDataset:
         self._rb = record_bytes(spec)
         self.batch_size = batch_size
         self.drop_remainder = drop_remainder
+        self.shuffle = shuffle
+        self.seed = seed
+        self.rank, self.world = rank, world
+        self._num_files = len(files)
+        # (epoch, next batch) — advanced as epoch() yields, snapshotted
+        # by state(), re-installed by restore().
+        self._cursor = (0, 0)
         impl = None
         if config.use_native:
             try:
@@ -289,10 +368,81 @@ class ShardedDataset:
         return int(np.min(np.asarray(hvd.allgather(
             np.asarray([self.steps_per_epoch()])))))
 
-    def epoch(self, epoch_idx: int = 0):
-        """Iterate one epoch of batches as {field: array} dicts."""
-        for buf, n in self._impl.epoch(epoch_idx):
+    def epoch(self, epoch_idx: int = 0, start_batch: int = 0):
+        """Iterate one epoch of batches as {field: array} dicts.
+
+        ``start_batch=k`` restarts mid-epoch: the yielded batches are
+        bitwise identical to batches ``k..end`` of the uninterrupted
+        ``epoch(epoch_idx)`` stream (the native loader seeks inside
+        the producer; the fallback slices the shuffled order). Every
+        yield advances the cursor `state()` snapshots, so a checkpoint
+        cut after consuming batch j resumes at batch j+1 exactly."""
+        epoch_idx, b = int(epoch_idx), int(start_batch)
+        if b < 0:
+            raise ValueError(f"start_batch must be >= 0, got {b}")
+        self._cursor = (epoch_idx, b)
+        for buf, n in self._impl.epoch(epoch_idx,
+                                       b * self.batch_size):
+            b += 1
+            self._cursor = (epoch_idx, b)
             yield unpack_records(self.spec, buf, n)
+        self._cursor = (epoch_idx + 1, 0)
+
+    # -- the checkpointable cursor ------------------------------------
+
+    @property
+    def cursor(self) -> Tuple[int, int]:
+        """(epoch_idx, next_batch): where the NEXT batch would come
+        from — feed it to ``epoch(e, start_batch=b)`` after a restart."""
+        return self._cursor
+
+    def state(self) -> Dict:
+        """JSON-able snapshot of the data-pipeline position plus the
+        identity fields that make the position meaningful (a cursor
+        into a differently-seeded or differently-batched stream would
+        silently yield the wrong records — `restore` refuses it)."""
+        e, b = self._cursor
+        return {
+            "schema": DATA_STATE_SCHEMA,
+            "epoch": e, "next_batch": b,
+            "seed": int(self.seed), "shuffle": bool(self.shuffle),
+            "batch_size": int(self.batch_size),
+            "drop_remainder": bool(self.drop_remainder),
+            "rank": int(self.rank), "world": int(self.world),
+            "num_files": int(self._num_files),
+            "record_bytes": int(self._rb),
+        }
+
+    def restore(self, state: Dict) -> "ShardedDataset":
+        """Re-install a `state()` snapshot onto this (fresh) dataset.
+
+        Raises `DataStateError` naming every mismatched identity field
+        — resume logic treats that as a corrupt/incompatible cursor
+        and falls back to the epoch boundary rather than serving a
+        stream the snapshot does not describe."""
+        if not isinstance(state, dict):
+            raise DataStateError(
+                f"data state must be a dict, got {type(state).__name__}")
+        if state.get("schema") != DATA_STATE_SCHEMA:
+            raise DataStateError(
+                f"data state schema {state.get('schema')!r} != "
+                f"supported {DATA_STATE_SCHEMA}")
+        mine = self.state()
+        mismatched = [
+            f"{k}: snapshot {state.get(k)!r} != dataset {mine[k]!r}"
+            for k in ("seed", "shuffle", "batch_size", "drop_remainder",
+                      "rank", "world", "num_files", "record_bytes")
+            if state.get(k) != mine[k]]
+        if mismatched:
+            raise DataStateError(
+                "data state incompatible with this dataset — "
+                + "; ".join(mismatched))
+        e, b = int(state["epoch"]), int(state["next_batch"])
+        if e < 0 or b < 0:
+            raise DataStateError(
+                f"data state cursor out of range: epoch={e} batch={b}")
+        self._cursor = (e, b)
+        return self
 
     def close(self):
         self._impl.close()

@@ -13,7 +13,15 @@
 //     (double buffering: the host reads batch k+1 while the device
 //     runs step k),
 //   * optional within-shard record shuffling, deterministic by
-//     (seed, epoch) on every rank.
+//     (seed, epoch) on every rank — a splitmix64-keyed stable sort,
+//     chosen over std::shuffle because the SAME permutation is
+//     reproducible from numpy in the pure-Python fallback
+//     (horovod_tpu/data `_shuffle_perm`): native and fallback yield
+//     bitwise-identical batch streams, the exact-resume contract,
+//   * mid-epoch resume: hvd_dl_start_epoch_at skips the first
+//     start_record entries of the (already shuffled) epoch order, so a
+//     checkpointed data cursor restarts the stream at batch k without
+//     re-reading batches 0..k-1 on the host.
 //
 // Plain C ABI consumed via ctypes (horovod_tpu/data), same pattern as
 // control_plane.cc. Build: g++ -O2 -std=c++17 -shared -fPIC -pthread
@@ -29,12 +37,22 @@
 #include <deque>
 #include <memory>
 #include <mutex>
-#include <random>
 #include <string>
 #include <thread>
 #include <vector>
 
 namespace {
+
+// splitmix64 finalizer (Steele et al.) — the shared shuffle key both
+// this loader and the Python fallback compute. Permutation = stable
+// sort of indices by Mix64(seed * GOLDEN + epoch + i); stable so ties
+// (astronomically unlikely) break identically to numpy's stable
+// argsort.
+inline uint64_t Mix64(uint64_t x) {
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
+  return x ^ (x >> 31);
+}
 
 struct Batch {
   std::vector<uint8_t> data;
@@ -89,7 +107,10 @@ struct Loader {
 
 // Reads one epoch: every record of every owned shard, in shuffled order
 // when requested, packed into batches pushed to the bounded queue.
-void ProduceEpoch(Loader* L, uint64_t epoch) {
+// `start_record` entries of the epoch order are skipped first (the
+// exact-resume fast path: resume at batch k costs zero reads of
+// batches 0..k-1).
+void ProduceEpoch(Loader* L, uint64_t epoch, int64_t start_record) {
   std::vector<std::pair<int, int64_t>> order;  // (file idx, record idx)
   std::vector<int64_t> counts(L->files.size(), 0);
   for (size_t fi = 0; fi < L->files.size(); ++fi) {
@@ -105,8 +126,23 @@ void ProduceEpoch(Loader* L, uint64_t epoch) {
     for (int64_t r = 0; r < counts[fi]; ++r) order.emplace_back(fi, r);
   }
   if (L->shuffle) {
-    std::mt19937_64 rng(L->seed * 0x9E3779B97F4A7C15ULL + epoch);
-    std::shuffle(order.begin(), order.end(), rng);
+    const uint64_t base = L->seed * 0x9E3779B97F4A7C15ULL + epoch;
+    std::vector<uint64_t> keys(order.size());
+    for (size_t i = 0; i < order.size(); ++i) keys[i] = Mix64(base + i);
+    std::vector<size_t> perm(order.size());
+    for (size_t i = 0; i < perm.size(); ++i) perm[i] = i;
+    std::stable_sort(perm.begin(), perm.end(),
+                     [&keys](size_t a, size_t b) {
+                       return keys[a] < keys[b];
+                     });
+    std::vector<std::pair<int, int64_t>> shuffled(order.size());
+    for (size_t i = 0; i < perm.size(); ++i) shuffled[i] = order[perm[i]];
+    order.swap(shuffled);
+  }
+  if (start_record > 0) {
+    order.erase(order.begin(),
+                order.begin() + std::min<int64_t>(
+                    start_record, static_cast<int64_t>(order.size())));
   }
 
   Batch cur;
@@ -192,11 +228,14 @@ void* hvd_dl_open(const char** paths, int64_t nfiles,
   return L;
 }
 
-// Starts producing epoch `epoch` in the background. Call once per
-// epoch, then drain with hvd_dl_next until it returns 0.
-int hvd_dl_start_epoch(void* handle, uint64_t epoch) {
+// Starts producing epoch `epoch` in the background at record offset
+// `start_record` of the (shuffled) epoch order — the data-cursor
+// resume entry point. Call once per epoch, then drain with
+// hvd_dl_next until it returns 0.
+int hvd_dl_start_epoch_at(void* handle, uint64_t epoch,
+                          int64_t start_record) {
   auto* L = static_cast<Loader*>(handle);
-  if (!L || L->closed.load()) return -1;
+  if (!L || L->closed.load() || start_record < 0) return -1;
   // The previous epoch may have been abandoned mid-drain with its
   // producer parked on a full queue: abort it, join, and discard any
   // stale batches so epoch N+1 never serves epoch-N data.
@@ -214,8 +253,13 @@ int hvd_dl_start_epoch(void* handle, uint64_t epoch) {
     L->epoch_done = false;
     L->error.clear();
   }
-  L->producer = std::thread(ProduceEpoch, L, epoch);
+  L->producer = std::thread(ProduceEpoch, L, epoch, start_record);
   return 0;
+}
+
+// Back-compat entry: a full epoch from record 0.
+int hvd_dl_start_epoch(void* handle, uint64_t epoch) {
+  return hvd_dl_start_epoch_at(handle, epoch, 0);
 }
 
 // Copies the next prefetched batch into `out` (capacity

@@ -105,6 +105,24 @@ def resilience_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
             "hvd_resilience_recovery_seconds",
             "Fault -> requeued-and-running latency per watchdog "
             "restart (time-to-requeue)"),
+        "resumes": reg.counter(
+            "hvd_resilience_resumes_total",
+            "Training resumes from a step checkpoint "
+            "(ElasticTrainer.resume with a restorable step)"),
+        "cursor_fallbacks": reg.counter(
+            "hvd_resilience_cursor_fallbacks_total",
+            "Resumes whose data-pipeline cursor was missing/corrupt/"
+            "incompatible — degraded to the epoch boundary "
+            "(docs/resilience.md 'Exact resume')"),
+        "resume_gap": reg.gauge(
+            "hvd_resilience_resume_gap_batches",
+            "Batches replayed by the LAST resume relative to the "
+            "exact cursor (0 = exactly-once; >0 only on a cursor "
+            "fallback)"),
+        "train_recovery": reg.histogram(
+            "hvd_resilience_train_recovery_seconds",
+            "Checkpoint-discovery-to-restored latency per training "
+            "resume (state + optimizer + data cursor + host RNG)"),
     }
 
 

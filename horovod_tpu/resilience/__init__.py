@@ -16,11 +16,18 @@ recovery layer (docs/resilience.md):
 * `elastic` — `PreemptionHandler` (SIGTERM/SIGINT emergency
   checkpoint), `NaNGuard` (loss-spike / NaN rollback), and
   `ElasticTrainer` tying resume discovery, periodic + emergency
-  checkpointing, and rollback into one loop-side helper.
+  checkpointing, rollback, and the exactly-once `TrainSnapshot`
+  (model + optimizer + data cursor + host RNG + guard history) into
+  one loop-side helper.
+* `equivalence` — the crash-restart equivalence harness: trains the
+  same workload twice, once uninterrupted and once under
+  chaos-injected kills + restarts, and asserts the batch streams are
+  bitwise identical and the final params match (``python -m
+  horovod_tpu.resilience.equivalence`` is the CI smoke entry).
 
 The chaos-vs-recovery contract is exercised end-to-end in
-`tests/test_resilience.py`: every recovery path in this package is
-driven by an injected fault, not asserted.
+`tests/test_resilience.py` / `tests/test_resume.py`: every recovery
+path in this package is driven by an injected fault, not asserted.
 """
 
 from horovod_tpu.resilience.chaos import (
@@ -33,6 +40,7 @@ from horovod_tpu.resilience.elastic import (
     ElasticTrainer,
     NaNGuard,
     PreemptionHandler,
+    TrainSnapshot,
 )
 from horovod_tpu.resilience.retry import (
     RetryError,
@@ -44,4 +52,5 @@ __all__ = [
     "ChaosError", "ChaosMonkey", "armed", "fires",
     "RetryError", "RetryPolicy", "default_io_policy",
     "ElasticTrainer", "NaNGuard", "PreemptionHandler",
+    "TrainSnapshot",
 ]

@@ -32,6 +32,12 @@ Instrumented sites (docs/resilience.md has the full table):
 site                    instrumented at
 ======================  ==================================================
 ckpt_write_fail         `utils/checkpoint.py::save` (each write attempt)
+ckpt_kill               `utils/checkpoint.py::save_step` — process
+                        death DURING a save: after the staging write,
+                        before the atomic rename (no discoverable step)
+train_crash             `resilience/elastic.py::after_step` — process
+                        death mid-epoch: the step's work is done,
+                        nothing checkpointed yet
 data_read_fail          `data/__init__.py` shard open, read mode
 data_write_fail         `data/__init__.py` shard open, write mode
 collective_slow         `ops/collectives.py` op entry (host-side; under

@@ -19,18 +19,39 @@ the job. Three pieces deliver that here:
   saves with `keep` retention, emergency save on preemption, and
   rollback — the loop-side glue `examples/jax_checkpoint_resume.py`
   demonstrates.
+
+Exact resume (docs/resilience.md "Exact resume"): a checkpoint that
+captures model/optimizer state alone makes a resumed run *silently
+lossy* — the interrupted epoch's remaining batches are replayed or
+skipped depending on where the loop restarts. `TrainSnapshot` makes
+the FULL training state one checkpointable unit: the pytree plus the
+data-pipeline cursor (`ShardedDataset.state()`), the host RNG, and
+the NaN-guard history, saved atomically by every `save_step` /
+emergency save (the cursor rides the `aux` sidecar) and restored by
+`resume()`. A missing/corrupt/incompatible cursor degrades to the
+epoch boundary — loudly: `hvd_resilience_cursor_fallbacks_total`
+increments, a `training.cursor_fallback` event fires, and
+`resume_gap_batches` reports how many batches the fallback replays.
+`resilience/equivalence.py` proves the exactly-once contract
+end-to-end under chaos-injected kills.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import math
 import signal
 import sys
 import threading
 import time
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from horovod_tpu.resilience.retry import RetryPolicy
+
+# Version stamp of the TrainSnapshot aux schema; restore refuses a
+# different version (the cursor would be meaningless) and falls back
+# to the epoch boundary.
+SNAPSHOT_SCHEMA = 1
 
 
 class PreemptionHandler:
@@ -141,6 +162,77 @@ class NaNGuard:
             self._good.pop(0)
         return False
 
+    def state(self) -> Dict:
+        """JSON-able snapshot (the TrainSnapshot guard leg): without
+        it a resumed run restarts with an empty loss window, and the
+        first `min_history` post-resume steps are spike-blind."""
+        return {"good": [float(x) for x in self._good],
+                "trips": int(self.trips)}
+
+    def restore(self, state: Dict) -> "NaNGuard":
+        self._good = [float(x) for x in state.get("good", [])]
+        self.trips = int(state.get("trips", 0))
+        return self
+
+
+def _rng_state(rng) -> Dict:
+    """JSON-able host-RNG snapshot: `np.random.Generator` (via its
+    bit_generator state dict) and legacy `np.random.RandomState`
+    (MT19937 key list) both supported — these are the two host-side
+    RNGs training loops draw batch/augmentation randomness from."""
+    import numpy as np
+    if isinstance(rng, np.random.Generator):
+        return {"kind": "generator", "state": rng.bit_generator.state}
+    if isinstance(rng, np.random.RandomState):
+        name, keys, pos, has_gauss, cached = rng.get_state()
+        return {"kind": "random_state",
+                "state": [name, [int(k) for k in keys], int(pos),
+                          int(has_gauss), float(cached)]}
+    raise TypeError(
+        f"unsupported host RNG {type(rng).__name__}: pass a "
+        f"numpy Generator or RandomState")
+
+
+def _rng_restore(rng, snap: Dict):
+    import numpy as np
+    kind = snap.get("kind")
+    if kind == "generator":
+        if not isinstance(rng, np.random.Generator):
+            raise TypeError("snapshot holds a Generator state but the "
+                            f"trainer's rng is {type(rng).__name__}")
+        rng.bit_generator.state = snap["state"]
+    elif kind == "random_state":
+        if not isinstance(rng, np.random.RandomState):
+            raise TypeError("snapshot holds a RandomState state but "
+                            f"the trainer's rng is {type(rng).__name__}")
+        name, keys, pos, has_gauss, cached = snap["state"]
+        rng.set_state((name, np.asarray(keys, np.uint32), int(pos),
+                       int(has_gauss), float(cached)))
+    else:
+        raise ValueError(f"unknown rng snapshot kind {kind!r}")
+
+
+@dataclasses.dataclass
+class TrainSnapshot:
+    """The composite unit `resume()` reconstructs: model/optimizer
+    pytree + step + the host-side legs of exactly-once training. The
+    pytree lands in the Orbax step directory; everything else rides
+    the atomic `aux` sidecar (`utils/checkpoint.py::save_step`).
+
+    ``exact`` distinguishes a full restore from the degraded
+    epoch-boundary fallback (cursor missing/corrupt/incompatible);
+    ``gap_batches`` is how many batches the fallback replays — 0 on
+    every exact resume."""
+
+    state: Any
+    step: int
+    data_state: Optional[Dict] = None
+    rng_state: Optional[Dict] = None
+    guard_state: Optional[Dict] = None
+    exact: bool = True
+    gap_batches: int = 0
+    schema: int = SNAPSHOT_SCHEMA
+
 
 class ElasticTrainer:
     """Checkpoint-directory-centric resilience for a training loop::
@@ -166,7 +258,8 @@ class ElasticTrainer:
                  guard: Optional[NaNGuard] = None,
                  handler: Optional[PreemptionHandler] = None,
                  retry: Optional[RetryPolicy] = None,
-                 install_signals: bool = True):
+                 install_signals: bool = True,
+                 dataset: Any = None, rng: Any = None):
         self.directory = directory
         self.save_every = save_every
         self.keep = keep
@@ -181,6 +274,19 @@ class ElasticTrainer:
         self._last_good_step: Optional[int] = None
         self._emergency_done = False
         self.rollbacks = 0
+        # Exact-resume legs (docs/resilience.md "Exact resume"):
+        # attach the ShardedDataset and the host RNG so every save_step
+        # snapshots their state in the aux sidecar and resume()
+        # restores them. Both optional — a loop without them keeps the
+        # PR-2 model-state-only behavior.
+        self.dataset = dataset
+        self.rng = rng
+        if rng is not None:
+            _rng_state(rng)  # validate the type NOW, not at save time
+        self.data_start: Tuple[int, int] = (0, 0)
+        self.resume_gap_batches = 0
+        self.cursor_fallbacks = 0
+        self.snapshot: Optional[TrainSnapshot] = None
 
     def close(self):
         """Uninstall the signal handlers this trainer installed (a
@@ -210,23 +316,147 @@ class ElasticTrainer:
         through unchanged, so the documented
         ``state, start = trainer.resume(like=state)`` loop works on
         the very first run too. Keeps `like` as the rollback
-        template."""
+        template.
+
+        With a `dataset`/`rng`/guard attached, the step's aux sidecar
+        is restored too: the data cursor lands in `data_start` (feed
+        it to ``dataset.epoch(e, start_batch=b)``), the RNG and guard
+        are re-seeded in place, and `resume_gap_batches` is 0 — the
+        exactly-once contract. A missing/corrupt/incompatible sidecar
+        degrades to the epoch boundary derived from the step count:
+        the interrupted epoch replays from batch 0 (`resume_gap_
+        batches` counts the replay), `cursor_fallbacks` increments,
+        and a `training.cursor_fallback` event names the reason —
+        degraded must never mean silent. `snapshot` keeps the full
+        `TrainSnapshot` of what was actually reconstructed."""
+        from horovod_tpu.obs import catalog as _obs_catalog
+        from horovod_tpu.obs import events as _events
         from horovod_tpu.utils import checkpoint as ckpt
+        t0 = time.time()
         self._like = like
+        self.resume_gap_batches = 0
+        self.data_start = (0, 0)
         out = ckpt.restore_latest(self.directory, like=like,
                                   broadcast=broadcast, with_step=True)
         if out is None:
+            self.snapshot = None
             return like, 0
         restored, step = out
+        step = int(step)
         self._last_good_step = step
-        return restored, int(step)
+        aux, aux_err = ckpt.load_step_aux(self.directory, step)
+        needs_aux = self.dataset is not None or self.rng is not None
+        if aux is None and not needs_aux:
+            # Model-state-only mode (no dataset/rng attached) resuming
+            # a checkpoint saved without a sidecar — e.g. a pre-exact-
+            # resume directory or a plain save_step caller. There is
+            # no cursor to lose: this is the documented PR-2 behavior,
+            # not a degraded resume, so no fallback noise.
+            aux_err = None
+        exact = aux is not None or not needs_aux
+        if exact and aux is not None \
+                and aux.get("schema") != SNAPSHOT_SCHEMA:
+            exact, aux_err = False, (
+                f"snapshot schema {aux.get('schema')!r} != supported "
+                f"{SNAPSHOT_SCHEMA}")
+        if exact and aux is not None and aux.get("step") != step:
+            # Sidecar from a different save than the step that
+            # restored (e.g. the newest step was corrupt and discovery
+            # fell back, or an orphan sidecar from a killed save) —
+            # its cursor describes the wrong position.
+            exact, aux_err = False, (
+                f"snapshot step {aux.get('step')!r} != restored "
+                f"step {step}")
+        if exact and aux is not None:
+            try:
+                if self.dataset is not None:
+                    data_state = aux.get("data")
+                    if data_state is None:
+                        raise ValueError(
+                            "snapshot has no data cursor (saved "
+                            "without an attached dataset?)")
+                    self.dataset.restore(data_state)
+                    self.data_start = tuple(self.dataset.cursor)
+                if self.rng is not None:
+                    if aux.get("rng") is None:
+                        # Same contract as the dataset leg: an
+                        # attached RNG with no snapshotted stream is
+                        # NOT an exact resume — draws would restart
+                        # from the fresh seed silently.
+                        raise ValueError(
+                            "snapshot has no host RNG state (saved "
+                            "without an attached rng?)")
+                    _rng_restore(self.rng, aux["rng"])
+                if aux.get("guard") is not None:
+                    self.guard.restore(aux["guard"])
+            except (TypeError, ValueError, KeyError) as e:
+                # DataStateError is a ValueError: incompatible cursors
+                # land here too, with the mismatch named.
+                exact, aux_err = False, repr(e)
+        gap = 0
+        if not exact:
+            if self.dataset is not None:
+                # Epoch-boundary fallback: derive the epoch from the
+                # step count and replay it from batch 0. Degraded but
+                # correct-on-epoch-boundaries — and loud.
+                spe = max(1, int(self.dataset.steps_per_epoch()))
+                epoch, gap = divmod(step, spe)
+                self.data_start = (int(epoch), 0)
+            self.cursor_fallbacks += 1
+            _obs_catalog.resilience_metrics()["cursor_fallbacks"].inc()
+            _events.emit("training.cursor_fallback", step=step,
+                         reason=str(aux_err), gap_batches=int(gap))
+            sys.stderr.write(
+                f"horovod_tpu: exact-resume cursor unavailable at step "
+                f"{step} ({aux_err}); resuming from the epoch boundary "
+                f"— {gap} batch(es) of the interrupted epoch will "
+                f"replay\n")
+        self.resume_gap_batches = int(gap)
+        recovery_s = time.time() - t0
+        met = _obs_catalog.resilience_metrics()
+        met["resumes"].inc()
+        met["resume_gap"].set(float(gap))
+        met["train_recovery"].observe(recovery_s)
+        _events.emit(
+            "training.resume", step=step, exact=bool(exact),
+            epoch=int(self.data_start[0]), batch=int(self.data_start[1]),
+            gap_batches=int(gap),
+            recovery_ms=round(recovery_s * 1e3, 3))
+        self.snapshot = TrainSnapshot(
+            state=restored, step=step,
+            data_state=(aux or {}).get("data"),
+            rng_state=(aux or {}).get("rng"),
+            guard_state=(aux or {}).get("guard"),
+            exact=bool(exact), gap_batches=int(gap))
+        return restored, step
 
     # -- the per-step hook --------------------------------------------
+
+    def _snapshot_aux(self, step: int) -> Dict:
+        """The aux sidecar for one save: everything exactly-once needs
+        beyond the pytree. Cheap (a handful of scalars + the guard
+        window), so it's built fresh at every save."""
+        aux: Dict[str, Any] = {"schema": SNAPSHOT_SCHEMA,
+                               "step": int(step),
+                               "guard": self.guard.state()}
+        if self.dataset is not None:
+            aux["data"] = self.dataset.state()
+        if self.rng is not None:
+            aux["rng"] = _rng_state(self.rng)
+        return aux
 
     def after_step(self, step: int, state: Any, loss) -> Any:
         """Fold one finished step into the resilience machinery; see
         class docstring. Returns the state the loop should continue
         from (the rolled-back one after a NaN/spike trip)."""
+        from horovod_tpu.resilience import chaos
+        if chaos.fires("train_crash"):
+            # Simulated process death at the worst mid-epoch point:
+            # the step's work is done but nothing is checkpointed yet
+            # — the equivalence harness's kill-mid-epoch scenario.
+            raise chaos.ChaosError(
+                f"injected training-process kill after step {step} "
+                f"(site train_crash)")
         if self.guard.check(loss):
             # No emergency save needed even if a preemption signal
             # landed this same step: the rolled-back state IS the last
@@ -244,7 +474,8 @@ class ElasticTrainer:
             from horovod_tpu.utils import checkpoint as ckpt
             ckpt.save_step(self.directory, step, state,
                            keep=self.keep, block=self.block,
-                           retry=self.retry)
+                           retry=self.retry,
+                           aux=self._snapshot_aux(step))
             self._last_good_step = step
         return state
 
@@ -286,7 +517,8 @@ class ElasticTrainer:
         from horovod_tpu.utils import checkpoint as ckpt
         ckpt.wait_pending()
         ckpt.save_step(self.directory, step, state, keep=self.keep,
-                       block=True, retry=self.retry)
+                       block=True, retry=self.retry,
+                       aux=self._snapshot_aux(step))
         self._last_good_step = step
         self._emergency_done = True
         _obs_catalog.resilience_metrics()["emergency_saves"].inc()

@@ -181,6 +181,11 @@ register_knob(
     "Checkpoint/data I/O retry attempts under the shared RetryPolicy "
     "(0 disables retries)")
 register_knob(
+    "HVD_CKPT_KEEP", "int", "0", "utils/checkpoint.py",
+    "Default step-checkpoint retention for save_step callers that "
+    "don't pass keep= (GC prunes oldest beyond N; 0 = keep all), "
+    "docs/resilience.md")
+register_knob(
     "HVD_CHAOS", "str", "(unset)", "resilience/chaos.py",
     "Arm chaos-injection sites: 'site:count[:p=..][:delay=..],...' "
     "(docs/resilience.md)")

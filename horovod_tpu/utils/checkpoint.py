@@ -263,24 +263,103 @@ def latest_step(directory: str) -> Optional[int]:
     return entries[-1][0] if entries else None
 
 
+def _looks_committed(path: str) -> bool:
+    """Cheap commit probe for the retention GC: every write path ends
+    in an atomic directory appearance (our tmp+rename for sync saves,
+    Orbax's own commit rename for async), and committed Orbax trees
+    carry a `_CHECKPOINT_METADATA` marker — so a discoverable step
+    without the marker is externally damaged (filesystem ate blocks,
+    a manually gutted dir) and must never count as the restorable
+    entry the GC is obliged to preserve."""
+    return os.path.isfile(os.path.join(path, "_CHECKPOINT_METADATA"))
+
+
+def _aux_path(directory: str, name: str) -> str:
+    """Sidecar path for a step's auxiliary snapshot JSON (the
+    data-pipeline cursor + host RNG + guard state of a TrainSnapshot,
+    `resilience/elastic.py`)."""
+    return os.path.join(directory, name + ".aux.json")
+
+
+def _write_aux(directory: str, name: str, aux: Any):
+    """Atomically (tmp + rename) write the aux sidecar. Written BEFORE
+    the step directory becomes discoverable, so any discoverable step
+    saved with aux has its sidecar on disk; a crash in the window
+    between sidecar and state commit leaves only a harmless orphan
+    that the next save of the same step overwrites (and pruning
+    removes)."""
+    import json
+    path = _aux_path(directory, name)
+    tmp = path + ".tmp"
+    os.makedirs(directory, exist_ok=True)
+    with open(tmp, "w") as f:
+        json.dump(aux, f)
+    os.replace(tmp, path)
+
+
+def load_step_aux(directory: str, step: int
+                  ) -> Tuple[Optional[Any], Optional[str]]:
+    """Read the aux sidecar saved alongside step `step`.
+
+    Returns ``(aux, None)`` on success, ``(None, reason)`` when the
+    sidecar is missing or unreadable — the caller decides how loud the
+    degraded path is (`ElasticTrainer.resume` falls back to the epoch
+    boundary and emits a `cursor_fallbacks` metric + event)."""
+    import json
+    names = [n for s, n in _step_entries(directory) if s == step]
+    if not names:
+        return None, f"no step {step} under {directory}"
+    path = _aux_path(directory, names[-1])
+    if not os.path.isfile(path):
+        return None, f"aux sidecar missing: {path}"
+    try:
+        with open(path) as f:
+            return json.load(f), None
+    except (OSError, ValueError) as e:
+        return None, f"aux sidecar unreadable ({e!r}): {path}"
+
+
 def save_step(directory: str, step: int, state: Any, *,
-              keep: int = 3, block: bool = True,
-              retry: Optional[RetryPolicy] = None) -> bool:
+              keep: Optional[int] = None, block: bool = True,
+              retry: Optional[RetryPolicy] = None,
+              aux: Optional[Any] = None) -> bool:
     """`save()` into `directory/step_{step:08d}`, then prune the lowest
-    steps down to `keep` entries — never the one just written (rank 0
-    only). ``block=False`` saves asynchronously; Orbax commits the
-    directory atomically, so pruning only ever sees finished steps —
-    which also means the in-flight save isn't counted yet and the
-    directory can transiently hold `keep + 1` entries until the next
-    call (or `wait_pending()` + another `save_step`) prunes it.
+    steps down to `keep` entries (rank 0 only). ``keep=None`` reads
+    the registered ``HVD_CKPT_KEEP`` knob (default 0 = keep all; the
+    GC is opt-in because deleting history is the one thing a
+    checkpoint layer must never surprise anyone with). The GC never
+    deletes the step just written, nor the newest COMMITTED step —
+    the one `restore_latest` would pick right now — so an async save
+    still in flight can't leave the directory restorable-empty if the
+    process dies before its commit lands. Pruned steps take their aux
+    sidecars with them.
+
+    ``aux``: optional JSON-able sidecar (`<step>.aux.json`, read back
+    by `load_step_aux`) written atomically BEFORE the step becomes
+    discoverable — the TrainSnapshot home for the data-pipeline
+    cursor, host RNG, and guard state (`docs/resilience.md` "Exact
+    resume"). Sidecar, not pytree leaf: the cursor must survive a
+    `like=` template that doesn't mention it, and a corrupt cursor
+    must degrade to an epoch-boundary resume without poisoning the
+    model restore.
+
+    ``block=False`` saves asynchronously; Orbax commits the directory
+    atomically, so pruning only ever sees finished steps — which also
+    means the in-flight save isn't counted yet and the directory can
+    transiently hold `keep + 1` entries until the next call (or
+    `wait_pending()` + another `save_step`) prunes it.
 
     The sync path is atomic end-to-end: the tree is written into a
     hidden ``.tmp.step_*`` staging directory (invisible to step
     discovery) and renamed into place only after the write fully
-    committed — a process killed mid-save leaves either the previous
-    checkpoint set or the complete new one, never a discoverable
-    half-written step. (The async path relies on Orbax's own atomic
-    directory commit.)"""
+    committed — a process killed mid-save (the ``ckpt_kill`` chaos
+    site injects exactly that) leaves either the previous checkpoint
+    set or the complete new one, never a discoverable half-written
+    step. (The async path relies on Orbax's own atomic directory
+    commit.)"""
+    if keep is None:
+        from horovod_tpu.runtime.config import env_int
+        keep = env_int("HVD_CKPT_KEEP", 0)
     current = f"step_{step:08d}"
     final = os.path.join(directory, current)
     if block:
@@ -289,19 +368,43 @@ def save_step(directory: str, step: int, state: Any, *,
         shutil.rmtree(tmp, ignore_errors=True)  # stale staging dir
         wrote = save(tmp, state, block=True, retry=retry)
         if wrote:
+            if chaos.fires("ckpt_kill"):
+                # Simulated mid-save process death: the staged tree
+                # exists, the rename never happens — discovery sees
+                # only the previous steps (the crash-restart
+                # equivalence harness's kill-during-save scenario).
+                raise chaos.ChaosError(
+                    f"injected process kill mid-save at {final} "
+                    f"(site ckpt_kill)")
+            if aux is not None:
+                _write_aux(directory, current, aux)
             if os.path.isdir(final):
                 shutil.rmtree(final, ignore_errors=True)
             os.replace(tmp, final)
     else:
         wrote = save(final, state, block=False, retry=retry)
+        if wrote and aux is not None:
+            # Sidecar lands before Orbax's background commit renames
+            # the step into discoverability — same ordering contract
+            # as the sync path.
+            _write_aux(directory, current, aux)
     if wrote and keep > 0:
         import shutil
         entries = _step_entries(directory)
-        candidates = [n for _, n in entries if n != current]
+        protected = {current}
+        committed = [n for _, n in entries
+                     if _looks_committed(os.path.join(directory, n))]
+        if committed:
+            protected.add(committed[-1])
+        candidates = [n for _, n in entries if n not in protected]
         excess = len(entries) - keep
         for name in candidates[:max(0, excess)]:
             shutil.rmtree(os.path.join(directory, name),
                           ignore_errors=True)
+            try:
+                os.unlink(_aux_path(directory, name))
+            except OSError:
+                pass
     return wrote
 
 

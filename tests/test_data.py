@@ -188,6 +188,147 @@ class TestLoaderRobustness:
                 list(ds.epoch(0))
 
 
+def _ds(paths, monkeypatch, native, **kw):
+    """Build a ShardedDataset pinned to one loader implementation,
+    skipping (not failing) when the native build is absent."""
+    from horovod_tpu.runtime.config import config
+    monkeypatch.setattr(config, "use_native", native)
+    ds = hd.ShardedDataset(paths, SPEC, **kw)
+    if native and not ds.native:
+        ds.close()
+        pytest.skip("native data loader unavailable in this build")
+    return ds
+
+
+def _stream(ds, epoch, start_batch=0):
+    return [{k: v.copy() for k, v in b.items()}
+            for b in ds.epoch(epoch, start_batch=start_batch)]
+
+
+def _assert_streams_equal(a, b):
+    assert len(a) == len(b)
+    for ba, bb in zip(a, b):
+        assert sorted(ba) == sorted(bb)
+        for k in ba:
+            np.testing.assert_array_equal(ba[k], bb[k])
+
+
+class TestLoaderParityAndResume:
+    """Exact-resume contracts (docs/resilience.md "Exact resume"):
+    native and pure-Python loaders are bitwise-interchangeable, and a
+    cursor saved at batch k reopens to exactly batches k..end."""
+
+    @pytest.mark.parametrize("world", [1, 2])
+    @pytest.mark.parametrize("seed,epoch", [(3, 0), (3, 2), (9, 1)])
+    def test_native_python_identical_shuffled_stream(
+            self, shards, monkeypatch, world, seed, epoch):
+        """Determinism parity: the SAME (seed, epoch, rank, world)
+        must yield the identical shuffled batch stream from both
+        implementations — the property exact resume stands on (a
+        snapshot cut under one loader must restore under the other,
+        e.g. when a restarted host falls back to the Python reader)."""
+        paths, _ = shards
+        for rank in range(world):
+            kw = dict(batch_size=8, shuffle=True, seed=seed,
+                      rank=rank, world=world)
+            with _ds(paths, monkeypatch, True, **kw) as nat:
+                a = _stream(nat, epoch)
+            with _ds(paths, monkeypatch, False, **kw) as py:
+                b = _stream(py, epoch)
+            _assert_streams_equal(a, b)
+
+    @pytest.mark.parametrize("native", [True, False],
+                             ids=["native", "python"])
+    @pytest.mark.parametrize("drop", [False, True],
+                             ids=["keep_tail", "drop_remainder"])
+    def test_mid_epoch_resume_bitwise(self, shards, monkeypatch,
+                                      native, drop):
+        """Save the cursor at batch k, reopen the dataset in a fresh
+        instance (the process-restart shape), restore, and the resumed
+        stream must be bitwise identical to batches k..end of the
+        uninterrupted epoch."""
+        paths, _ = shards
+        kw = dict(batch_size=6, shuffle=True, seed=5, rank=0, world=1,
+                  drop_remainder=drop)
+        with _ds(paths, monkeypatch, native, **kw) as ds:
+            full = _stream(ds, epoch=1)
+        assert len(full) >= 4
+        for k in (1, 3, len(full) - 1):
+            with _ds(paths, monkeypatch, native, **kw) as ds1:
+                it = ds1.epoch(1)
+                for _ in range(k):
+                    next(it)
+                saved = ds1.state()
+                del it
+            assert saved["next_batch"] == k
+            with _ds(paths, monkeypatch, native, **kw) as ds2:
+                ds2.restore(saved)
+                e, b = ds2.cursor
+                assert (e, b) == (1, k)
+                resumed = _stream(ds2, e, start_batch=b)
+            _assert_streams_equal(resumed, full[k:])
+
+    @pytest.mark.parametrize("native", [True, False],
+                             ids=["native", "python"])
+    def test_mid_epoch_resume_multirank(self, shards, monkeypatch,
+                                        native):
+        """Rank ownership survives the cursor round trip: each rank of
+        world=2 resumes its OWN stream suffix."""
+        paths, _ = shards
+        for rank in range(2):
+            kw = dict(batch_size=4, shuffle=True, seed=2, rank=rank,
+                      world=2)
+            with _ds(paths, monkeypatch, native, **kw) as ds:
+                full = _stream(ds, epoch=0)
+            with _ds(paths, monkeypatch, native, **kw) as ds1:
+                it = ds1.epoch(0)
+                next(it), next(it)
+                saved = ds1.state()
+                del it
+            assert saved["rank"] == rank
+            with _ds(paths, monkeypatch, native, **kw) as ds2:
+                resumed = _stream(ds2.restore(saved), *ds2.cursor)
+            _assert_streams_equal(resumed, full[2:])
+
+    def test_cursor_advances_across_epoch_boundary(self, shards):
+        paths, _ = shards
+        with hd.ShardedDataset(paths, SPEC, batch_size=16, rank=0,
+                               world=1) as ds:
+            assert ds.cursor == (0, 0)
+            list(ds.epoch(0))
+            assert ds.cursor == (1, 0)   # next batch = epoch 1 start
+
+    def test_restore_rejects_incompatible_state(self, shards):
+        paths, _ = shards
+        with hd.ShardedDataset(paths, SPEC, batch_size=8, shuffle=True,
+                               seed=1, rank=0, world=1) as ds:
+            good = ds.state()
+            with pytest.raises(hd.DataStateError, match="schema"):
+                ds.restore(dict(good, schema=99))
+            with pytest.raises(hd.DataStateError, match="seed"):
+                ds.restore(dict(good, seed=2))
+            with pytest.raises(hd.DataStateError,
+                               match="batch_size"):
+                ds.restore(dict(good, batch_size=4))
+            with pytest.raises(hd.DataStateError, match="dict"):
+                ds.restore("not a dict")
+            # the good state still restores after the failed attempts
+            assert ds.restore(good).cursor == (0, 0)
+
+    def test_native_fast_forward_fallback(self, shards, monkeypatch):
+        """A stale .so without hvd_dl_start_epoch_at must still resume
+        correctly via the documented host-side fast-forward (produce
+        and discard batches 0..k-1)."""
+        paths, _ = shards
+        kw = dict(batch_size=8, shuffle=True, seed=4, rank=0, world=1)
+        with _ds(paths, monkeypatch, True, **kw) as ds:
+            full = _stream(ds, epoch=0)
+        with _ds(paths, monkeypatch, True, **kw) as ds:
+            monkeypatch.setattr(ds._impl, "_start_at", None)
+            resumed = _stream(ds, 0, start_batch=3)
+        _assert_streams_equal(resumed, full[3:])
+
+
 class TestTokenPacking:
     def test_pack_tokens_concat_and_tail_drop(self):
         rows = hd.pack_tokens([[1, 2, 3], [4, 5], [6, 7, 8, 9]], 4)

@@ -1,0 +1,473 @@
+"""Exactly-once resumable training (docs/resilience.md "Exact
+resume"): TrainSnapshot composition, the aux checkpoint sidecar,
+HVD_CKPT_KEEP retention GC, the loud cursor-fallback path, and the
+chaos-driven crash-restart equivalence harness end to end — for both
+loader implementations."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu import data as hd
+from horovod_tpu.obs import catalog, events
+from horovod_tpu.resilience import chaos
+from horovod_tpu.resilience.elastic import (ElasticTrainer, NaNGuard,
+                                            _rng_restore, _rng_state)
+from horovod_tpu.resilience.equivalence import (
+    run_crash_restart_equivalence)
+from horovod_tpu.resilience.retry import RetryPolicy
+from horovod_tpu.utils import checkpoint as ckpt
+
+FAST = RetryPolicy(max_attempts=2, base_delay_s=0.01)
+
+SPEC = [("x", "float32", (3,)), ("y", "float32", ())]
+
+
+def _shards(tmp_path, n=40, num_shards=2, seed=0):
+    rs = np.random.RandomState(seed)
+    arrays = {"x": rs.randn(n, 3).astype(np.float32),
+              "y": rs.randn(n).astype(np.float32)}
+    return hd.write_shards(str(tmp_path / "shards"), "t", SPEC,
+                           arrays, num_shards)
+
+
+def _native_or_skip(monkeypatch, native):
+    from horovod_tpu.runtime.config import config
+    monkeypatch.setattr(config, "use_native", native)
+    return native
+
+
+# ---------------------------------------------------------------- aux
+
+
+class TestAuxSidecar:
+    def test_round_trip(self, tmp_path, hvd):
+        state = {"w": np.arange(3.0)}
+        aux = {"schema": 1, "step": 5, "data": {"epoch": 1,
+                                                "next_batch": 7}}
+        assert ckpt.save_step(str(tmp_path), 5, state, aux=aux,
+                              retry=FAST)
+        got, err = ckpt.load_step_aux(str(tmp_path), 5)
+        assert err is None
+        assert got == aux
+        # sidecar is a sibling file, not inside the step dir
+        assert os.path.isfile(str(tmp_path / "step_00000005.aux.json"))
+
+    def test_missing_and_corrupt(self, tmp_path, hvd):
+        state = {"w": np.arange(3.0)}
+        ckpt.save_step(str(tmp_path), 3, state, retry=FAST)  # no aux
+        got, err = ckpt.load_step_aux(str(tmp_path), 3)
+        assert got is None and "missing" in err
+        got, err = ckpt.load_step_aux(str(tmp_path), 99)
+        assert got is None and "no step" in err
+        ckpt.save_step(str(tmp_path), 4, state, aux={"a": 1},
+                       retry=FAST)
+        (tmp_path / "step_00000004.aux.json").write_text("{broken")
+        got, err = ckpt.load_step_aux(str(tmp_path), 4)
+        assert got is None and "unreadable" in err
+
+    def test_async_save_writes_sidecar(self, tmp_path, hvd):
+        state = {"w": np.arange(3.0)}
+        ckpt.save_step(str(tmp_path), 7, state, aux={"step": 7},
+                       block=False, retry=FAST)
+        ckpt.wait_pending()
+        got, err = ckpt.load_step_aux(str(tmp_path), 7)
+        assert err is None and got == {"step": 7}
+
+
+# ---------------------------------------------------------- retention
+
+
+class TestRetentionGC:
+    def test_default_is_keep_all(self, tmp_path, hvd, monkeypatch):
+        monkeypatch.delenv("HVD_CKPT_KEEP", raising=False)
+        state = {"w": np.zeros(2)}
+        for s in range(1, 6):
+            ckpt.save_step(str(tmp_path), s, state, retry=FAST)
+        names = [n for n in os.listdir(str(tmp_path))
+                 if n.startswith("step_")]
+        assert len(names) == 5
+
+    def test_hvd_ckpt_keep_knob_prunes(self, tmp_path, hvd,
+                                       monkeypatch):
+        monkeypatch.setenv("HVD_CKPT_KEEP", "2")
+        state = {"w": np.zeros(2)}
+        for s in range(1, 6):
+            ckpt.save_step(str(tmp_path), s, state,
+                           aux={"step": s}, retry=FAST)
+        names = sorted(n for n in os.listdir(str(tmp_path))
+                       if n.startswith("step_")
+                       and not n.endswith(".aux.json"))
+        assert names == ["step_00000004", "step_00000005"]
+        # pruned steps took their aux sidecars with them
+        auxes = sorted(n for n in os.listdir(str(tmp_path))
+                       if n.endswith(".aux.json"))
+        assert auxes == ["step_00000004.aux.json",
+                        "step_00000005.aux.json"]
+
+    def test_gc_protects_newest_committed_step(self, tmp_path, hvd,
+                                               monkeypatch):
+        """The GC must never delete the step restore_latest would
+        pick: with the newest entry damaged (no commit marker) and the
+        current save still in flight (async, not yet discoverable),
+        pruning keeps the older GOOD step and removes the damaged one
+        instead."""
+        state = {"w": np.zeros(2)}
+        ckpt.save_step(str(tmp_path), 10, state, retry=FAST)
+        ckpt.save_step(str(tmp_path), 20, state, retry=FAST)
+        os.unlink(str(tmp_path / "step_00000020"
+                      / "_CHECKPOINT_METADATA"))
+        # Simulate an in-flight async save of step 30: save() reports
+        # scheduled but nothing is discoverable yet.
+        monkeypatch.setattr(ckpt, "save", lambda *a, **k: True)
+        ckpt.save_step(str(tmp_path), 30, state, keep=1, block=False)
+        names = sorted(n for n in os.listdir(str(tmp_path))
+                       if n.startswith("step_"))
+        assert names == ["step_00000010"]  # the restorable one
+        out = ckpt.restore_latest(str(tmp_path))
+        assert out is not None
+
+
+# ----------------------------------------------------- kill-mid-save
+
+
+class TestKillSites:
+    def test_ckpt_kill_leaves_no_discoverable_step(self, tmp_path,
+                                                   hvd):
+        state = {"w": np.arange(2.0)}
+        ckpt.save_step(str(tmp_path), 1, state, retry=FAST)
+        with chaos.armed("ckpt_kill:1") as monkey:
+            with pytest.raises(chaos.ChaosError, match="ckpt_kill"):
+                ckpt.save_step(str(tmp_path), 2, state, retry=FAST)
+        assert monkey.fired("ckpt_kill") == 1
+        # step 2 must NOT be discoverable (staging only), step 1 must
+        assert ckpt.latest_step(str(tmp_path)) == 1
+        # and a later save of the same step overwrites the staging dir
+        ckpt.save_step(str(tmp_path), 2, state, retry=FAST)
+        assert ckpt.latest_step(str(tmp_path)) == 2
+
+    def test_train_crash_fires_in_after_step(self, tmp_path, hvd):
+        trainer = ElasticTrainer(str(tmp_path), save_every=0,
+                                 install_signals=False, retry=FAST)
+        state = {"w": np.zeros(2)}
+        with chaos.armed("train_crash:1"):
+            with pytest.raises(chaos.ChaosError, match="train_crash"):
+                trainer.after_step(1, state, 0.1)
+
+
+# --------------------------------------------------- host RNG legs
+
+
+class TestHostRngSnapshot:
+    def test_generator_round_trip(self):
+        rng = np.random.default_rng(7)
+        rng.random(5)
+        snap = _rng_state(rng)
+        json.dumps(snap)  # must be JSON-able
+        expect = rng.random(4).tolist()
+        rng2 = np.random.default_rng(0)
+        _rng_restore(rng2, snap)
+        assert rng2.random(4).tolist() == expect
+
+    def test_random_state_round_trip(self):
+        rng = np.random.RandomState(3)
+        rng.randn(5)
+        snap = _rng_state(rng)
+        json.dumps(snap)
+        expect = rng.randn(4).tolist()
+        rng2 = np.random.RandomState(0)
+        _rng_restore(rng2, snap)
+        assert rng2.randn(4).tolist() == expect
+
+    def test_type_mismatch_and_unsupported(self):
+        with pytest.raises(TypeError, match="unsupported"):
+            _rng_state(object())
+        snap = _rng_state(np.random.default_rng(1))
+        with pytest.raises(TypeError, match="Generator"):
+            _rng_restore(np.random.RandomState(1), snap)
+
+    def test_nan_guard_state_round_trip(self):
+        g = NaNGuard(min_history=2)
+        for x in (1.0, 1.1, 0.9):
+            assert not g.check(x)
+        assert g.check(float("nan"))
+        snap = g.state()
+        json.dumps(snap)
+        g2 = NaNGuard(min_history=2).restore(snap)
+        assert g2.trips == 1
+        # restored history keeps spike detection armed immediately
+        assert g2.check(1e6)
+
+
+# --------------------------------------------- exact resume + fallback
+
+
+class TestExactResume:
+    def _loop(self, trainer, ds, state, step_fn, epochs, stream):
+        state, step = trainer.resume(like=state)
+        del stream[step:]
+        e0, b0 = trainer.data_start
+        for epoch in range(e0, epochs):
+            sb = b0 if epoch == e0 else 0
+            for batch in ds.epoch(epoch, start_batch=sb):
+                state, loss = step_fn(state, batch)
+                step += 1
+                stream.append(batch["y"].tobytes())
+                state = trainer.after_step(step, state, loss)
+        return state, step
+
+    @staticmethod
+    def _step(state, batch):
+        x, y = batch["x"].astype(np.float64), batch["y"].astype(
+            np.float64)
+        err = x @ state["w"] - y
+        return {"w": state["w"] - 0.05 * x.T @ err / len(y)}, float(
+            (err ** 2).mean())
+
+    def test_snapshot_resume_is_exact(self, tmp_path, hvd,
+                                      monkeypatch):
+        """Kill after step 5 (snapshot at 4): the fresh-process resume
+        restores the cursor mid-epoch, replays nothing it shouldn't,
+        and the combined effective stream equals the uninterrupted
+        one."""
+        paths = _shards(tmp_path)
+        state0 = {"w": np.zeros(3, np.float64)}
+        kw = dict(batch_size=4, shuffle=True, seed=3, rank=0, world=1)
+
+        def control():
+            with hd.ShardedDataset(paths, SPEC, **kw) as ds:
+                t = ElasticTrainer(str(tmp_path / "c"), save_every=2,
+                                   keep=0, block=True,
+                                   install_signals=False, dataset=ds,
+                                   retry=FAST)
+                stream = []
+                st, n = self._loop(t, ds, state0, self._step, 2,
+                                   stream)
+                return st, n, stream
+
+        c_state, c_steps, c_stream = control()
+
+        # interrupted run: die after step 5 (mid-epoch; last save = 4)
+        d = str(tmp_path / "r")
+        stream = []
+        with hd.ShardedDataset(paths, SPEC, **kw) as ds:
+            t = ElasticTrainer(d, save_every=2, keep=0, block=True,
+                               install_signals=False, dataset=ds,
+                               retry=FAST)
+            st, step = t.resume(like=state0)
+            it = ds.epoch(0)
+            for batch in it:
+                st, loss = self._step(st, batch)
+                step += 1
+                stream.append(batch["y"].tobytes())
+                st = t.after_step(step, st, loss)
+                if step == 5:
+                    break
+            del it
+        # fresh process: new dataset, new trainer
+        with hd.ShardedDataset(paths, SPEC, **kw) as ds2:
+            t2 = ElasticTrainer(d, save_every=2, keep=0, block=True,
+                                install_signals=False, dataset=ds2,
+                                retry=FAST)
+            r_state, r_steps = self._loop(t2, ds2, state0, self._step,
+                                          2, stream)
+            assert t2.resume_gap_batches == 0
+            assert t2.snapshot is not None and t2.snapshot.exact
+            assert t2.snapshot.step == 4
+            assert t2.data_start == (0, 4)
+        assert r_steps == c_steps
+        assert stream == c_stream
+        np.testing.assert_allclose(r_state["w"], c_state["w"],
+                                   rtol=0, atol=0)
+
+    def test_rng_and_guard_ride_the_snapshot(self, tmp_path, hvd):
+        paths = _shards(tmp_path)
+        rng = np.random.default_rng(5)
+        with hd.ShardedDataset(paths, SPEC, batch_size=8) as ds:
+            t = ElasticTrainer(str(tmp_path / "k"), save_every=1,
+                               keep=0, block=True,
+                               install_signals=False, dataset=ds,
+                               rng=rng, retry=FAST)
+            t.resume(like={"w": np.zeros(3)})
+            list(ds.epoch(0))
+            rng.random(3)                      # advance the host RNG
+            t.guard.check(1.0)
+            t.after_step(1, {"w": np.ones(3)}, 0.5)   # snapshot
+            expect = rng.random(4).tolist()
+        rng2 = np.random.default_rng(0)        # cold-start RNG
+        with hd.ShardedDataset(paths, SPEC, batch_size=8) as ds2:
+            t2 = ElasticTrainer(str(tmp_path / "k"), save_every=1,
+                                keep=0, block=True,
+                                install_signals=False, dataset=ds2,
+                                rng=rng2, retry=FAST)
+            st, step = t2.resume(like={"w": np.zeros(3)})
+            assert step == 1
+            assert t2.snapshot.exact
+            assert rng2.random(4).tolist() == expect
+            # guard history: the explicit check(1.0) plus after_step's
+            # own check of the snapshotted step's loss (0.5)
+            assert t2.guard.state()["good"] == [1.0, 0.5]
+
+    def test_cursor_fallback_is_loud(self, tmp_path, hvd):
+        """aux sidecar deleted (or schema-mismatched): resume degrades
+        to the epoch boundary, reports the replay gap, increments the
+        cursor_fallbacks counter, and emits the events."""
+        paths = _shards(tmp_path)
+        d = str(tmp_path / "fb")
+        kw = dict(batch_size=4, shuffle=True, seed=1)
+        with hd.ShardedDataset(paths, SPEC, **kw) as ds:
+            t = ElasticTrainer(d, save_every=1, keep=0, block=True,
+                               install_signals=False, dataset=ds,
+                               retry=FAST)
+            t.resume(like={"w": np.zeros(3)})
+            it = ds.epoch(0)
+            for k, _ in zip(range(3), it):
+                t.after_step(k + 1, {"w": np.zeros(3)}, 0.1)
+            del it
+        os.unlink(os.path.join(d, "step_00000003.aux.json"))
+        c = catalog.resilience_metrics()["cursor_fallbacks"]
+        before = c.value()
+        with hd.ShardedDataset(paths, SPEC, **kw) as ds2:
+            t2 = ElasticTrainer(d, save_every=1, keep=0, block=True,
+                                install_signals=False, dataset=ds2,
+                                retry=FAST)
+            _, step = t2.resume(like={"w": np.zeros(3)})
+            assert step == 3
+            assert not t2.snapshot.exact
+            # epoch boundary: 40 records / batch 4 = 10 steps/epoch ->
+            # epoch 0, 3 batches replay
+            assert t2.data_start == (0, 0)
+            assert t2.resume_gap_batches == 3
+            assert t2.cursor_fallbacks == 1
+        assert c.value() == before + 1
+        kinds = [r["kind"] for r in events.tail(20)]
+        assert "training.cursor_fallback" in kinds
+        assert "training.resume" in kinds
+        fallback = [r for r in events.tail(20)
+                    if r["kind"] == "training.cursor_fallback"][-1]
+        assert fallback["gap_batches"] == 3
+
+    def test_schema_mismatch_falls_back(self, tmp_path, hvd):
+        paths = _shards(tmp_path)
+        d = str(tmp_path / "sm")
+        with hd.ShardedDataset(paths, SPEC, batch_size=4) as ds:
+            t = ElasticTrainer(d, save_every=1, keep=0, block=True,
+                               install_signals=False, dataset=ds,
+                               retry=FAST)
+            t.resume(like={"w": np.zeros(3)})
+            next(ds.epoch(0))
+            t.after_step(1, {"w": np.zeros(3)}, 0.1)
+        aux_path = os.path.join(d, "step_00000001.aux.json")
+        with open(aux_path) as f:
+            aux = json.load(f)
+        aux["schema"] = 99
+        with open(aux_path, "w") as f:
+            json.dump(aux, f)
+        with hd.ShardedDataset(paths, SPEC, batch_size=4) as ds2:
+            t2 = ElasticTrainer(d, save_every=1, keep=0, block=True,
+                                install_signals=False, dataset=ds2,
+                                retry=FAST)
+            t2.resume(like={"w": np.zeros(3)})
+            assert not t2.snapshot.exact
+            assert t2.cursor_fallbacks == 1
+
+    def test_model_only_resume_of_auxless_ckpt_is_quiet(self, tmp_path,
+                                                        hvd):
+        """Upgrade path: a trainer WITHOUT dataset/rng resuming a
+        checkpoint saved without a sidecar (pre-exact-resume dir or a
+        plain save_step caller) is the documented model-state-only
+        mode — no cursor to lose, so no fallback noise."""
+        ckpt.save_step(str(tmp_path), 4, {"w": np.arange(2.0)},
+                       retry=FAST)   # no aux
+        c = catalog.resilience_metrics()["cursor_fallbacks"]
+        before = c.value()
+        t = ElasticTrainer(str(tmp_path), save_every=1, keep=0,
+                           block=True, install_signals=False,
+                           retry=FAST)
+        _, step = t.resume(like={"w": np.zeros(2)})
+        assert step == 4
+        assert t.snapshot.exact
+        assert t.cursor_fallbacks == 0
+        assert c.value() == before
+
+    def test_attached_rng_with_rngless_snapshot_falls_back(
+            self, tmp_path, hvd):
+        """An attached RNG whose stream is NOT in the snapshot cannot
+        be an exact resume (draws would silently restart from the
+        fresh seed) — same loud contract as the dataset leg."""
+        t = ElasticTrainer(str(tmp_path), save_every=1, keep=0,
+                           block=True, install_signals=False,
+                           retry=FAST)   # saved WITHOUT rng
+        t.resume(like={"w": np.zeros(2)})
+        t.after_step(1, {"w": np.zeros(2)}, 0.1)
+        t2 = ElasticTrainer(str(tmp_path), save_every=1, keep=0,
+                            block=True, install_signals=False,
+                            rng=np.random.default_rng(0), retry=FAST)
+        t2.resume(like={"w": np.zeros(2)})
+        assert not t2.snapshot.exact
+        assert t2.cursor_fallbacks == 1
+
+    def test_incompatible_dataset_falls_back(self, tmp_path, hvd):
+        """Cursor saved under one dataset identity must not seek a
+        differently-configured dataset (DataStateError -> fallback)."""
+        paths = _shards(tmp_path)
+        d = str(tmp_path / "inc")
+        with hd.ShardedDataset(paths, SPEC, batch_size=4,
+                               shuffle=True, seed=1) as ds:
+            t = ElasticTrainer(d, save_every=1, keep=0, block=True,
+                               install_signals=False, dataset=ds,
+                               retry=FAST)
+            t.resume(like={"w": np.zeros(3)})
+            next(ds.epoch(0))
+            t.after_step(1, {"w": np.zeros(3)}, 0.1)
+        with hd.ShardedDataset(paths, SPEC, batch_size=8,
+                               shuffle=True, seed=1) as ds2:
+            t2 = ElasticTrainer(d, save_every=1, keep=0, block=True,
+                                install_signals=False, dataset=ds2,
+                                retry=FAST)
+            t2.resume(like={"w": np.zeros(3)})
+            assert not t2.snapshot.exact
+            assert t2.cursor_fallbacks == 1
+
+
+# --------------------------------------------- equivalence end to end
+
+
+class TestCrashRestartEquivalence:
+    @pytest.mark.parametrize("native", [True, False],
+                             ids=["native", "python"])
+    def test_equivalence_under_kills(self, tmp_path, hvd, monkeypatch,
+                                     native):
+        """Acceptance: a chaos-interrupted, resumed run yields a
+        bitwise-identical batch stream and matching final params vs.
+        the uninterrupted control — both loader implementations."""
+        if native:
+            from horovod_tpu.runtime.config import config
+            if not config.use_native:
+                pytest.skip("native disabled in this environment")
+        report = run_crash_restart_equivalence(
+            str(tmp_path), use_native=native, epochs=2)
+        if native and report.loader != "native":
+            pytest.skip("native data loader unavailable")
+        assert report.kills >= 1, "chaos never fired — proves nothing"
+        assert report.batches_match
+        assert report.params_match
+        assert report.max_param_delta == 0.0
+        assert report.resume_gap_batches == 0
+        assert report.cursor_fallbacks == 0
+        assert report.resumed_batches == report.control_batches
+        assert len(report.recovery_ms) >= 1
+        assert report.summary()["ok"] is True
+
+    def test_env_armed_monkey_takes_precedence(self, tmp_path, hvd):
+        """The CI smoke shape: an installed monkey (HVD_CHAOS) drives
+        the kill schedule instead of the default spec — and the
+        control leg still runs disarmed."""
+        with chaos.armed("train_crash:1"):
+            report = run_crash_restart_equivalence(
+                str(tmp_path), epochs=2, use_native=False,
+                kill_spec="ckpt_kill:5")     # must be ignored
+            assert report.kills == 1
+        assert report.ok
