@@ -529,17 +529,24 @@ def _tpot_histogram(results):
 
 def _serve_rate(model, params, args, prompts, rate, *,
                 pipeline_depth, prefill_chunk_budget, chaos_mode,
-                log):
+                log, paged_cfg=None):
     """One open-loop Poisson rate point through a fresh (pre-warmed)
     engine; returns the per-rate record. ``pipeline_depth`` /
     ``prefill_chunk_budget`` parameterize the hot path so the same
-    harness measures the PR-3 pipeline and its PR-1-shaped control."""
+    harness measures the PR-3 pipeline and its PR-1-shaped control;
+    ``paged_cfg`` (num_slots/kv_blocks/kv_block_size) switches the
+    engine to the paged KV cache for the PR-7 paged-vs-fixed A/B."""
     import numpy as np
 
     from horovod_tpu.serving import ServingEngine
 
-    S, steps, n_req = (args.serving_slots, args.decode_steps,
-                       args.serving_requests)
+    steps, n_req = args.decode_steps, args.serving_requests
+    S = (paged_cfg["num_slots"] if paged_cfg
+         else args.serving_slots)
+    kw = {}
+    if paged_cfg:
+        kw = dict(paged=True, kv_blocks=paged_cfg["kv_blocks"],
+                  kv_block_size=paged_cfg["kv_block_size"])
     if chaos_mode:
         from horovod_tpu.resilience import chaos as chaos_mod
     gaps = np.random.RandomState(7).exponential(1.0 / rate, size=n_req)
@@ -547,7 +554,8 @@ def _serve_rate(model, params, args, prompts, rate, *,
                         max_queue=2 * n_req, warmup=True,
                         pipeline_depth=pipeline_depth,
                         prefill_chunk_budget=prefill_chunk_budget,
-                        auto_restart=chaos_mode, max_restarts=8)
+                        auto_restart=chaos_mode, max_restarts=8,
+                        **kw)
     t0 = time.time()
     handles = []
     for i, p in enumerate(prompts):
@@ -584,7 +592,34 @@ def _serve_rate(model, params, args, prompts, rate, *,
         "compiles": snap["compiles"],
         "pipeline_depth": pipeline_depth,
         "prefill_chunk_budget": prefill_chunk_budget,
+        # Effective concurrency high-water mark (decoding +
+        # mid-prefill): bounded by num_slots on the fixed pool, by
+        # BLOCK availability on the paged one — the capacity half of
+        # the paged A/B.
+        "peak_active": snap["peak_active"],
+        "num_slots": S,
     }
+    if paged_cfg:
+        cold = [r.ttft_s for r in results
+                if r.prefix_tokens_cached == 0]
+        hit = [r.ttft_s for r in results if r.prefix_tokens_cached > 0]
+        rec.update({
+            "paged": True,
+            "kv_blocks": paged_cfg["kv_blocks"],
+            "kv_block_size": paged_cfg["kv_block_size"],
+            "prefix_hits": snap["prefix_hits"],
+            "prefix_misses": snap["prefix_misses"],
+            "prefix_hit_rate": snap["prefix_hit_rate"],
+            "prefix_evictions": snap["prefix_evictions"],
+            "prefill_tokens_skipped": snap["prefill_tokens_skipped"],
+            "requests_prefix_hit": len(hit),
+            # The TTFT the cache deletes: requests whose prefix was
+            # resident vs requests that prefilled everything.
+            "ttft_cold_ms_p50": (round(float(
+                np.percentile(cold, 50)) * 1e3, 3) if cold else None),
+            "ttft_hit_ms_p50": (round(float(
+                np.percentile(hit, 50)) * 1e3, 3) if hit else None),
+        })
     if chaos_mode:
         # The robustness cost on the perf trajectory: how long a
         # crash-to-requeued recovery takes under this load.
@@ -671,6 +706,63 @@ def _serving_trace_check(model, params, args, prompts, log):
             "subsystems": n}
 
 
+def _prefix_ttft_check(model, params, args, paged_cfg, log,
+                       rounds=5):
+    """The controlled cold-vs-cache-hit TTFT measurement (PR-7
+    acceptance): on one warmed, otherwise-idle paged engine, each
+    round submits a request with a FRESH block-aligned prefix (cold —
+    full prefill) and then a second sharing that prefix (hit —
+    prefill covers only the tail), sequentially. Same engine, same
+    conditions, the only variable is prefix residency — unlike the
+    open-loop rate point, where cold/hit correlates with arrival-time
+    LOAD (early arrivals are cold AND unloaded), this isolates the
+    prefill the cache deletes. Reported as p50 over rounds."""
+    import numpy as np
+
+    from horovod_tpu.serving import ServingEngine
+
+    bs = paged_cfg["kv_block_size"]
+    steps = args.decode_steps
+    # Largest block-aligned prefix that (with its 2-token tail) still
+    # satisfies the engine's P + steps - 1 <= max_len contract; a
+    # geometry with no room for even one block skips the check
+    # instead of crashing the run after the expensive rate sweep.
+    plen = min(args.serving_prefix_len, args.seq - steps + 1 - 2)
+    plen -= plen % bs
+    if plen < bs:
+        log(f"prefix TTFT check skipped: no room for a {bs}-token "
+            f"block in prompts at --seq {args.seq} / --decode-steps "
+            f"{steps}")
+        return None
+    rs = np.random.RandomState(13)
+    cold_ts, hit_ts, skipped = [], [], 0
+    eng = ServingEngine(model, params, num_slots=2,
+                        max_queue=8, warmup=True, paged=True,
+                        kv_blocks=paged_cfg["kv_blocks"],
+                        kv_block_size=bs)
+    try:
+        for _ in range(rounds):
+            prefix = rs.randint(0, 32768, (plen,))
+            a = eng.submit(np.concatenate(
+                [prefix, rs.randint(0, 32768, (2,))]), steps).result()
+            b = eng.submit(np.concatenate(
+                [prefix, rs.randint(0, 32768, (2,))]), steps).result()
+            assert a.prefix_tokens_cached == 0
+            cold_ts.append(a.ttft_s)
+            hit_ts.append(b.ttft_s)
+            skipped += b.prefix_tokens_cached
+    finally:
+        eng.shutdown()
+    cold = round(float(np.percentile(cold_ts, 50)) * 1e3, 3)
+    hit = round(float(np.percentile(hit_ts, 50)) * 1e3, 3)
+    log(f"prefix TTFT check ({rounds} rounds, {plen}-token prefix): "
+        f"cold p50 {cold} ms -> cache-hit p50 {hit} ms "
+        f"({skipped // max(1, rounds)} tokens skipped per hit)")
+    return {"rounds": rounds, "prefix_tokens": plen,
+            "ttft_cold_ms_p50": cold, "ttft_hit_ms_p50": hit,
+            "tokens_skipped_per_hit": skipped // max(1, rounds)}
+
+
 def run_serving(args, devices, n_chips, log):
     """Serving-engine throughput/latency under open-loop load: Poisson
     arrivals against `horovod_tpu.serving.ServingEngine` at each
@@ -710,8 +802,45 @@ def run_serving(args, devices, n_chips, log):
         f"max_new={steps}, {n_req} req/rate at rates={rates} req/s")
 
     rs = np.random.RandomState(0)
-    prompts = [rs.randint(0, 32768, (int(rs.randint(4, max_prompt)),))
-               for _ in range(n_req)]
+    frac = max(0.0, min(1.0, args.serving_shared_prefix))
+    if frac > 0 and args.seq % args.serving_kv_block_size:
+        # Fail BEFORE the expensive rate sweep: the paged A/B leg
+        # needs the block size to divide max_len (paged_cache_spec
+        # enforces it at engine construction, which would otherwise
+        # only fire after the sweep completed).
+        raise ValueError(
+            f"--serving-kv-block-size {args.serving_kv_block_size} "
+            f"must divide --seq {args.seq} for the paged A/B "
+            f"(--serving-shared-prefix)")
+    sys_prompt = None
+    if frac > 0:
+        # The millions-of-users traffic shape: `frac` of requests
+        # share ONE system prompt (block-aligned so the paged leg's
+        # prefix match covers it fully), each with a short unique
+        # tail; the rest stay fully random. The prefix must leave
+        # prompt room: clamp to half the usable span.
+        plen = min(args.serving_prefix_len, max(0, max_prompt // 2))
+        plen -= plen % args.serving_kv_block_size
+        if plen <= 0:
+            raise ValueError(
+                f"--serving-shared-prefix needs room for at least one "
+                f"{args.serving_kv_block_size}-token block in prompts "
+                f"(max_prompt={max_prompt}); raise --seq or lower "
+                f"--serving-prefix-len / --serving-kv-block-size")
+        sys_prompt = rs.randint(0, 32768, (plen,))
+        log(f"serving workload: {frac:.0%} of requests share a "
+            f"{plen}-token system prompt")
+    prompts = []
+    for _ in range(n_req):
+        if sys_prompt is not None and rs.rand() < frac:
+            tail = rs.randint(
+                0, 32768,
+                (int(rs.randint(1, max(2, max_prompt
+                                       - len(sys_prompt)))),))
+            prompts.append(np.concatenate([sys_prompt, tail]))
+        else:
+            prompts.append(
+                rs.randint(0, 32768, (int(rs.randint(4, max_prompt)),)))
 
     # Program warmup: the first engine construction precompiles the
     # tick + pinned prefill-chunk set (ServingEngine(warmup=True));
@@ -770,6 +899,49 @@ def run_serving(args, devices, n_chips, log):
             f"{a['tpot_ms_p50']} -> {b['tpot_ms_p50']} ms, "
             f"host-syncs/token {a['host_syncs_per_token']} -> "
             f"{b['host_syncs_per_token']}")
+    if args.serving_shared_prefix > 0 and not chaos_mode:
+        # Paged-vs-fixed A/B at the highest rate (PR 7): SAME device
+        # KV bytes on both sides — the fixed leg is S slots x max_len
+        # rows, the paged leg carves those exact bytes into blocks
+        # (kv_blocks = S x max_len / block_size, +1 null) but runs 4S
+        # decode lanes, since lanes are now cheap program width and
+        # admission gates on BLOCKS. The artifact's acceptance
+        # numbers: prefix_hit_rate > 0, ttft_hit_ms_p50 strictly
+        # below ttft_cold_ms_p50, and the paged leg's peak_active
+        # exceeding the fixed leg's num_slots bound.
+        rate = max(rates)
+        bs = args.serving_kv_block_size
+        paged_cfg = {"num_slots": 4 * S,
+                     "kv_blocks": S * args.seq // bs + 1,
+                     "kv_block_size": bs}
+        out["paged_ab"] = {
+            "rate": rate,
+            "equal_kv_token_rows": S * args.seq,
+            "fixed": _serve_rate(
+                model, params, args, prompts, rate,
+                pipeline_depth=depth, prefill_chunk_budget=budget,
+                chaos_mode=False, log=log),
+            "paged": _serve_rate(
+                model, params, args, prompts, rate,
+                pipeline_depth=depth, prefill_chunk_budget=budget,
+                chaos_mode=False, log=log, paged_cfg=paged_cfg),
+            # Controlled cold-vs-hit TTFT (the acceptance pair): the
+            # open-loop leg's per-request split above is confounded by
+            # arrival-time load (early arrivals are cold AND
+            # unloaded), so the isolated measurement runs idle.
+            "prefix_ttft": _prefix_ttft_check(
+                model, params, args, paged_cfg, log),
+        }
+        f, p = out["paged_ab"]["fixed"], out["paged_ab"]["paged"]
+        pt = out["paged_ab"]["prefix_ttft"]
+        ttft = (f"; controlled TTFT cold {pt['ttft_cold_ms_p50']} -> "
+                f"hit {pt['ttft_hit_ms_p50']} ms" if pt else "")
+        log(f"paged A/B at rate={rate}/s (equal KV bytes): "
+            f"ttft p50 {f['ttft_ms_p50']} -> {p['ttft_ms_p50']} ms, "
+            f"prefix hit rate {p['prefix_hit_rate']}, prefill tokens "
+            f"skipped {p['prefill_tokens_skipped']}, peak concurrency "
+            f"{f['peak_active']} (cap {f['num_slots']}) -> "
+            f"{p['peak_active']}{ttft}")
     return out
 
 
@@ -1061,6 +1233,25 @@ def main():
                     action="store_false", default=True,
                     help="serving: skip the in-artifact pipelined-vs-"
                          "control A/B at the highest rate")
+    ap.add_argument("--serving-shared-prefix", type=float, default=0.0,
+                    metavar="FRAC",
+                    help="serving: fraction of requests sharing one "
+                         "system prompt (paged-KV workload mix); > 0 "
+                         "adds a paged-vs-fixed A/B at the highest "
+                         "rate (prefix hit rate, cache-hit vs cold "
+                         "TTFT, effective concurrency at equal KV "
+                         "bytes) to the artifact (docs/serving.md "
+                         "'Paged KV cache')")
+    ap.add_argument("--serving-prefix-len", type=int, default=32,
+                    metavar="TOKENS",
+                    help="serving: shared system-prompt length for "
+                         "--serving-shared-prefix (block-aligned "
+                         "skips want a multiple of the KV block "
+                         "size)")
+    ap.add_argument("--serving-kv-block-size", type=int, default=16,
+                    help="serving: paged-KV block size in tokens for "
+                         "the paged A/B leg (HVD_KV_BLOCK_SIZE "
+                         "parity)")
     ap.add_argument("--out", default=None, metavar="PATH",
                     help="also write the final result JSON to PATH "
                          "(e.g. BENCH_serving_pr3.json)")
@@ -1555,6 +1746,9 @@ def _bench_body(args, devices, n_chips, metric, unit,
         }
         if "pipeline_ab" in r:
             result["pipeline_ab"] = r["pipeline_ab"]
+        if "paged_ab" in r:
+            result["paged_ab"] = r["paged_ab"]
+            result["serving_shared_prefix"] = args.serving_shared_prefix
         _set_best(result)
         emit(_BEST_RESULT)
         write_out(args)

@@ -53,9 +53,14 @@ JAX_PLATFORMS=cpu python -m pytest -q --collect-only \
 # comes up on an EPHEMERAL port, /metrics is fetched over real HTTP
 # and must expose the serving + resilience + training metric families
 # from the shared registry in ONE scrape, and /healthz must show the
-# live engine's dispatch generation.
+# live engine's dispatch generation. --prefix-check is the paged-KV
+# smoke (PR 7, docs/serving.md "Paged KV cache"): two requests sharing
+# a 48-token system prompt through a PAGED engine — the second must
+# report prefill-tokens-skipped > 0 (prefix served from resident
+# blocks) and TTFT strictly below the cold request's, both token-exact
+# vs sequential generate.
 JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4 \
-    --warmup --interleave-check --obs-check
+    --warmup --interleave-check --obs-check --prefix-check
 
 # Resume smoke (docs/resilience.md "Exact resume"): a short training
 # run over a sharded shuffled dataset is killed mid-epoch AND

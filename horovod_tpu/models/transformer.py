@@ -1164,6 +1164,253 @@ def slot_decode_tick(dec_model, params, cache, toks, temps, top_ps,
     return jax.vmap(one)(cache, toks, temps, top_ps, rngs, live, done)
 
 
+# ---------------------------------------------------------------------------
+# Paged slot cache (the device surface of `horovod_tpu.serving.paging`).
+#
+# The slot-pool cache above still RESERVES a private [max_len] KV region
+# per slot, so device KV capacity is num_slots x max_len regardless of
+# how long requests actually run — the same per-tensor-allocation waste
+# Horovod's fusion buffer removed for gradients, here applied to KV
+# state. These primitives carve the cache into fixed-size BLOCKS
+# instead (vLLM-style): one shared pool of [num_blocks, 1, block_size,
+# ...] rows per cache leaf, and each sequence owns an int32 BLOCK TABLE
+# mapping its logical positions to pool blocks. The table and the fill
+# index are TRACED operands, so one compiled program serves every
+# layout; the per-tick view of a sequence's KV is a gather of its
+# blocks (`pool[table]`), reshaped back to the exact [1, max_len, ...]
+# linear layout the decode attention already consumes — the compute is
+# the SAME flax apply on the SAME values, which is what makes the paged
+# path bitwise-equal to the slot pool (pinned by tests). Writes scatter
+# only the newly produced rows back into their blocks; lanes that must
+# not advance (FREE, mid-prefill, done) route their row to the reserved
+# NULL block 0, whose content is never attended (every decode mask
+# attends positions < fill only).
+# ---------------------------------------------------------------------------
+
+class PagedCacheSpec:
+    """Static (hashable — rides jit static args) description of one
+    paged slot cache: the B=1 decode-cache tree structure, each leaf's
+    kind ("kv" = pooled into blocks, "index" = the per-lane fill
+    scalar), and the block geometry. Built once per pool via
+    `paged_cache_spec`."""
+
+    __slots__ = ("treedef", "kinds", "block_size", "blocks_per_seq")
+
+    def __init__(self, treedef, kinds, block_size, blocks_per_seq):
+        self.treedef = treedef
+        self.kinds = tuple(kinds)
+        self.block_size = int(block_size)
+        self.blocks_per_seq = int(blocks_per_seq)
+
+    @property
+    def view_len(self) -> int:
+        return self.block_size * self.blocks_per_seq
+
+    def _key(self):
+        return (self.treedef, self.kinds, self.block_size,
+                self.blocks_per_seq)
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __eq__(self, other):
+        return (isinstance(other, PagedCacheSpec)
+                and self._key() == other._key())
+
+
+def paged_cache_spec(model: TransformerLM,
+                     block_size: int) -> PagedCacheSpec:
+    """Classify the B=1 decode cache's leaves for paging. KV-bearing
+    leaves (``cached_key``/``cached_value`` and their int8-KV scale
+    twins) carry the max_len axis at position 1 and are pooled into
+    blocks; ``cache_index``/``pos_index`` scalars become the per-lane
+    fill vector the paged pool keeps outside the tree. Requires
+    ``block_size`` to divide ``max_len`` exactly, so the gathered view
+    is shape-identical to the linear cache (the bitwise-equality
+    contract), and no sliding window (a rolling buffer's slot = pos
+    mod window layout has no block-aligned prefix to share)."""
+    if model.window is not None:
+        raise ValueError(
+            "paged KV cache requires window=None (a rolling-window "
+            "cache has no block-aligned prefix to page or share)")
+    if block_size < 1 or model.max_len % block_size:
+        raise ValueError(
+            f"block_size must divide max_len={model.max_len} exactly, "
+            f"got {block_size}")
+    from jax.tree_util import tree_flatten_with_path
+    dec_model = slot_decode_model(model)
+    shapes = jax.eval_shape(
+        dec_model.init, jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, model.max_len), jnp.int32))["cache"]
+    flat, treedef = tree_flatten_with_path(shapes)
+    kinds = []
+    for path, leaf in flat:
+        if "index" in str(path):
+            assert leaf.shape == (), (path, leaf.shape)
+            kinds.append("index")
+        else:
+            assert leaf.shape[:2] == (1, model.max_len), (path,
+                                                          leaf.shape)
+            kinds.append("kv")
+    return PagedCacheSpec(treedef, kinds, block_size,
+                          model.max_len // block_size)
+
+
+def init_paged_pools(model: TransformerLM, spec: PagedCacheSpec,
+                     num_blocks: int) -> list:
+    """Zero-filled block pools: one [num_blocks, 1, block_size, ...]
+    array per KV leaf of the B=1 decode cache (flatten order). Block 0
+    is the NULL block — never allocated to a sequence; masked lanes
+    dump their dead writes there."""
+    if num_blocks < 2:
+        raise ValueError(
+            f"num_blocks must be >= 2 (block 0 is the reserved null "
+            f"block), got {num_blocks}")
+    from jax.tree_util import tree_flatten_with_path
+    dec_model = slot_decode_model(model)
+    shapes = jax.eval_shape(
+        dec_model.init, jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, model.max_len), jnp.int32))["cache"]
+    flat, _ = tree_flatten_with_path(shapes)
+    pools = []
+    for kind, (path, leaf) in zip(spec.kinds, flat):
+        if kind == "kv":
+            pools.append(jnp.zeros(
+                (num_blocks, 1, spec.block_size) + leaf.shape[2:],
+                leaf.dtype))
+    return pools
+
+
+def _paged_view(spec: PagedCacheSpec, pools, table, fill):
+    """Assemble one lane's [1, max_len, ...] cache view from its block
+    table: KV leaves are `pool[table]` gathers reshaped back to the
+    linear layout; index leaves are the lane's fill scalar (every
+    layer's cache_index — and pos_index at learned-position models —
+    advances in lockstep, so ONE scalar determines them all). The
+    table is a traced operand: one compiled program for all layouts."""
+    leaves, pi = [], 0
+    fill = jnp.asarray(fill, jnp.int32)
+    for kind in spec.kinds:
+        if kind == "kv":
+            g = jnp.take(pools[pi], table, axis=0)   # [nb, 1, bs, ...]
+            pi += 1
+            g = jnp.moveaxis(g, 1, 0)                # [1, nb, bs, ...]
+            leaves.append(g.reshape((1, spec.view_len) + g.shape[3:]))
+        else:
+            leaves.append(fill)
+    from jax.tree_util import tree_unflatten
+    return tree_unflatten(spec.treedef, leaves)
+
+
+def _paged_new_rows(spec: PagedCacheSpec, cache, fill, length: int):
+    """The rows a decode/prefill apply just wrote into a view cache —
+    positions [fill, fill+length) of every KV leaf, [length, ...] each
+    (flatten order, matching the pools list)."""
+    rows = []
+    for kind, leaf in zip(spec.kinds, jax.tree.leaves(cache)):
+        if kind == "kv":
+            rows.append(lax.dynamic_slice_in_dim(
+                leaf, fill, length, axis=1)[0])
+    return rows
+
+
+def _paged_scatter(spec: PagedCacheSpec, pools, rows, bids, offs):
+    """Write freshly produced rows into their blocks: ``bids``/``offs``
+    are parallel int32 vectors (block id, within-block offset) — one
+    batched scatter per leaf. Duplicate (0, off) targets from masked
+    lanes land in the null block, where last-writer-wins is harmless
+    (null content is never attended)."""
+    return [p.at[bids, 0, offs].set(r) for p, r in zip(pools, rows)]
+
+
+@hot_path
+@functools.partial(jax.jit, static_argnames=("dec_model", "spec"),
+                   donate_argnums=(2,))
+def paged_prefill_chunk(dec_model, spec: PagedCacheSpec, pools, params,
+                        tables, fills, slot, chunk):
+    """Append one [C]-token prompt chunk into lane ``slot``'s paged
+    cache; returns ``(pools, fills, last-position logits [V])``. The
+    lane's view is gathered through its block table, the apply is the
+    SAME `chunked_prefill` cache-wide-mask program the linear slot
+    pool runs (correct at any fill — including a fill that starts past
+    a shared-prefix span the admission matched and skipped), and only
+    the chunk's C new rows scatter back into their blocks."""
+    table = tables[slot]
+    fill = fills[slot]
+    cache = _paged_view(spec, pools, table, fill)
+    (hidden, embed), mut = dec_model.apply(
+        {"params": params, "cache": cache}, chunk[None, :],
+        return_hidden=True, mutable=["cache"])
+    C = chunk.shape[0]
+    rows = _paged_new_rows(spec, mut["cache"], fill, C)
+    pos = fill + jnp.arange(C, dtype=jnp.int32)
+    bids = table[pos // spec.block_size]
+    offs = pos % spec.block_size
+    pools = _paged_scatter(spec, pools, rows, bids, offs)
+    fills = fills.at[slot].set(fill + C)
+    logits = jnp.einsum("d,vd->v", hidden[0, -1],
+                        embed.astype(hidden.dtype))
+    return pools, fills, logits.astype(jnp.float32)
+
+
+@hot_path
+@functools.partial(jax.jit, static_argnames=("dec_model", "spec"),
+                   donate_argnums=(2,))
+def paged_decode_tick(dec_model, spec: PagedCacheSpec, pools, params,
+                      tables, fills, toks, temps, top_ps, rngs, live,
+                      done, eos):
+    """One continuous-batching decode tick over every lane of a PAGED
+    pool: vmap of (gather view -> B=1 decode apply -> sample) over the
+    lane axis, then ONE batched scatter of the new KV rows into their
+    blocks. Same occupancy semantics as `slot_decode_tick` — ``live``
+    gates fill advance, ``done`` is the on-device stop — expressed in
+    paged form: a non-advancing lane keeps its fill (the freeze) and
+    routes its dead row to the null block (the masked write)."""
+
+    def one(table, fill, tok, temp, top_p, rng, lv, dn):
+        cache = _paged_view(spec, pools, table, fill)
+        (hidden, embed), mut = dec_model.apply(
+            {"params": params, "cache": cache}, tok[None, None],
+            return_hidden=True, mutable=["cache"])
+        rows = [r[0] for r in _paged_new_rows(spec, mut["cache"],
+                                              fill, 1)]
+        logits = jnp.einsum("d,vd->v", hidden[0, -1],
+                            embed.astype(hidden.dtype))
+        rng, r = jax.random.split(rng)
+        nxt = sample_token(logits.astype(jnp.float32), temp, top_p, r)
+        nxt = nxt.astype(tok.dtype)
+        emit = jnp.where(dn, eos.astype(tok.dtype), nxt)
+        return rows, emit, rng, dn | (emit == eos), lv & ~dn
+
+    rows, emit, rngs, done, adv = jax.vmap(one)(
+        tables, fills, toks, temps, top_ps, rngs, live, done)
+    bs = spec.block_size
+    # A lane at the P + max_new - 1 == max_len boundary gets one
+    # pipelined extra tick with fill == max_len: the table lookup
+    # indexes one past the row, take_along_axis's default fill mode
+    # yields an out-of-range id, and the scatter below silently DROPS
+    # that write (out-of-bounds scatter indices drop) — the surplus
+    # token was headed for the discard pile anyway. Keep the fill
+    # mode: a clip mode here would instead overwrite the lane's last
+    # real block.
+    owner = jnp.take_along_axis(tables, (fills // bs)[:, None],
+                                axis=1)[:, 0]
+    bids = jnp.where(adv, owner, 0)          # masked lanes -> null
+    offs = fills % bs
+    pools = _paged_scatter(spec, pools, rows, bids, offs)
+    fills = jnp.where(adv, fills + 1, fills)
+    return pools, emit, rngs, done, fills
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def paged_copy_block(pools, src, dst):
+    """Device-side block copy (every KV leaf) — the copy-on-write
+    primitive: before a lane appends into a block whose refcount > 1
+    (a forked sequence sharing its tail), the allocator gives it a
+    private copy and this materializes the bytes."""
+    return [p.at[dst].set(p[src]) for p in pools]
+
+
 def serving_params(params, dtype=jnp.bfloat16):
     """Cast the big (ndim >= 2) float params to the serving dtype.
 

@@ -58,6 +58,36 @@ def serving_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
             "hvd_serving_compiles_total",
             "First-time-shape XLA compiles in the slot pool "
             "(0 growth inside a warmed serving window)"),
+        # Paged KV cache + shared-prefix caching (docs/serving.md
+        # "Paged KV cache"): block occupancy per engine and the
+        # process-wide prefix-cache accounting.
+        "kv_blocks_free": reg.gauge(
+            "hvd_kv_blocks_free",
+            "Paged-KV blocks on the free list", ("engine",)),
+        "kv_blocks_used": reg.gauge(
+            "hvd_kv_blocks_used",
+            "Paged-KV blocks owned by live sequences (refcount >= 1)",
+            ("engine",)),
+        "kv_blocks_cached": reg.gauge(
+            "hvd_kv_blocks_cached",
+            "Refcount-0 blocks kept resident by the shared-prefix "
+            "cache (LRU-evictable)", ("engine",)),
+        "prefix_hits": reg.counter(
+            "hvd_prefix_cache_hits_total",
+            "Block-aligned prompt-prefix blocks served from the "
+            "resident cache at admission (prefill skipped)"),
+        "prefix_misses": reg.counter(
+            "hvd_prefix_cache_misses_total",
+            "Block-aligned prompt-prefix blocks queried but not "
+            "resident at admission"),
+        "prefix_evictions": reg.counter(
+            "hvd_prefix_cache_evictions_total",
+            "Cached prefix blocks reclaimed by allocation "
+            "(LRU, oldest first)"),
+        "prefill_tokens_skipped": reg.counter(
+            "hvd_serving_prefill_tokens_skipped_total",
+            "Prompt tokens never prefilled because the shared-prefix "
+            "cache already held them (the TTFT the cache deleted)"),
         "ttft": reg.histogram(
             "hvd_serving_ttft_seconds",
             "Time to first token: submit -> first token out "

@@ -35,6 +35,11 @@ DEFAULT_CYCLE_TIME_MS = 5.0                  # mpi_ops.cc:1292 (latency floor)
 # step (interleaved chunked prefill, docs/serving.md "Performance
 # tuning"); <= 0 disables interleaving (whole prompt at once).
 DEFAULT_PREFILL_CHUNK_BUDGET = 128
+# Serving: paged KV cache geometry (docs/serving.md "Paged KV cache").
+# Block size in tokens (must divide max_len); block count 0 = auto
+# (num_slots x max_len / block_size — byte-parity with the fixed slot
+# pool); prefix cache on by default when paging is on.
+DEFAULT_KV_BLOCK_SIZE = 16
 
 
 # ---------------------------------------------------------------------------
@@ -144,6 +149,21 @@ register_knob(
     "runtime/config.py",
     "Serving: max prompt tokens streamed per dispatch step "
     "(interleaved chunked prefill; <= 0 streams whole prompts), "
+    "docs/serving.md")
+register_knob(
+    "HVD_KV_BLOCK_SIZE", "int", str(DEFAULT_KV_BLOCK_SIZE),
+    "runtime/config.py",
+    "Serving: paged-KV block size in tokens (must divide the model's "
+    "max_len; ServingEngine(paged=True)), docs/serving.md")
+register_knob(
+    "HVD_KV_BLOCKS", "int", "0", "runtime/config.py",
+    "Serving: paged-KV device block count (0 = auto: num_slots x "
+    "max_len / block_size, byte-parity with the fixed slot pool), "
+    "docs/serving.md")
+register_knob(
+    "HVD_PREFIX_CACHE", "int", "1", "runtime/config.py",
+    "Serving: shared-prefix caching over the paged KV pool (0 "
+    "disables matching/publishing; blocks then free eagerly), "
     "docs/serving.md")
 register_knob(
     "HOROVOD_TIMELINE", "str", "(unset)", "runtime/config.py",
@@ -256,6 +276,12 @@ class Config:
     stall_warning_time: float = DEFAULT_STALL_WARNING_TIME
     cycle_time_ms: float = DEFAULT_CYCLE_TIME_MS
     prefill_chunk_budget: int = DEFAULT_PREFILL_CHUNK_BUDGET
+    # Paged KV cache (serving): block size in tokens, device block
+    # count (0 = auto byte-parity with the fixed pool), and the
+    # shared-prefix cache switch.
+    kv_block_size: int = DEFAULT_KV_BLOCK_SIZE
+    kv_blocks: int = 0
+    prefix_cache: bool = True
     # TPU-specific additions
     allreduce_dtype: str = ""          # e.g. "bfloat16" to reduce in bf16
     mesh_axis_name: str = "data"       # default 1-D data-parallel axis
@@ -282,6 +308,10 @@ class Config:
             self.fusion_threshold = DEFAULT_FUSION_THRESHOLD
         self.prefill_chunk_budget = _env_int(
             "HVD_PREFILL_CHUNK_BUDGET", DEFAULT_PREFILL_CHUNK_BUDGET)
+        self.kv_block_size = _env_int("HVD_KV_BLOCK_SIZE",
+                                      DEFAULT_KV_BLOCK_SIZE)
+        self.kv_blocks = _env_int("HVD_KV_BLOCKS", 0)
+        self.prefix_cache = _env_int("HVD_PREFIX_CACHE", 1) != 0
         self.timeline_path = env_str("HOROVOD_TIMELINE")
         self.stall_warning_time = _env_float(
             "HOROVOD_STALL_CHECK_TIME", DEFAULT_STALL_WARNING_TIME)

@@ -10,10 +10,16 @@ The layer that turns concurrent requests into batched device work:
   slots each tick, keeping the decode batch full under load.
 * `slots.SlotPool` — the slot-pool KV cache generalizing the linear
   cache's scalar fill index to per-slot state.
+* `paging.BlockPool` / `paging.PagedSlotPool` — the paged KV cache:
+  device KV carved into refcounted fixed-size blocks (block tables,
+  copy-on-write, LRU-cached shared prompt prefixes) so capacity
+  follows ACTUAL lengths instead of num_slots x max_len, and a
+  cache-hit system prompt skips its prefill
+  (`ServingEngine(paged=True)`).
 * `admission` — bounded queue, deadlines, cancellation, load shedding
   (degrade by shedding, never by hanging).
 * `metrics` — TTFT/TPOT/tokens-per-second with p50/p95, queue depth,
-  slot occupancy.
+  slot occupancy, paged-block occupancy + prefix-cache hit rates.
 
 See docs/serving.md for the architecture and tuning guide.
 """
@@ -24,14 +30,16 @@ from horovod_tpu.serving.admission import (
 )
 from horovod_tpu.serving.engine import RequestHandle, ServingEngine
 from horovod_tpu.serving.metrics import EngineMetrics
+from horovod_tpu.serving.paging import BlockPool, PagedSlotPool
 from horovod_tpu.serving.scheduler import (
     CompletedRequest, ContinuousBatchingScheduler,
 )
-from horovod_tpu.serving.slots import SlotPool
+from horovod_tpu.serving.slots import Admission, SlotPool
 
 __all__ = [
     "ServingEngine", "RequestHandle", "CompletedRequest",
     "SamplingParams", "SlotPool", "ContinuousBatchingScheduler",
     "AdmissionQueue", "EngineMetrics", "ServingError",
     "QueueFullError", "DeadlineExceededError", "EngineClosedError",
+    "Admission", "BlockPool", "PagedSlotPool",
 ]

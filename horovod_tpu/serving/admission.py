@@ -97,6 +97,12 @@ class Request:
     t_submit: float = 0.0
     t_prefill: float = 0.0           # dispatcher: prefill started
     t_first: float = 0.0             # dispatcher: first token emitted
+    # Prompt tokens the paged pool's prefix cache already held at
+    # admission (prefill skipped them); 0 on the fixed pool and on
+    # every cache miss. Set by the dispatcher, surfaced on
+    # CompletedRequest — the per-request cache-hit evidence the bench
+    # and the ci.sh --prefix-check read.
+    prefix_cached: int = 0
     tokens: List[int] = field(default_factory=list)  # generated so far
     _cancel: threading.Event = field(default_factory=threading.Event)
 
@@ -166,24 +172,45 @@ class AdmissionQueue:
         if on_drop is not None:
             on_drop(req, kind)
 
-    def pop_ready(self, now: float, on_drop=None) -> Optional[Request]:
-        """Next live request, resolving cancelled/expired ones inline
-        (``on_drop(req, kind)`` with kind "cancelled"/"timeout" fires
-        for each, for metrics/tracing); None when the queue holds no
-        admissible work."""
+    def _next_ready(self, now: float, on_drop,
+                    pop: bool) -> Optional[Request]:
+        """THE head-drain loop behind both `peek_ready` and
+        `pop_ready`: dead requests (cancelled / deadline-expired) at
+        the head are removed and resolved inline either way; the
+        first live one is returned, removed only when ``pop``.
+        Single-consumer contract (the dispatch thread) — submitters
+        only ever append, so a peeked head stays the head until this
+        thread pops it (or it dies)."""
         while True:
             with self._lock:
                 if not self._q:
                     self._event.clear()
                     return None
-                req = self._q.popleft()
-            if req.cancelled:
-                self._resolve_dead(req, "cancelled", now, on_drop)
-                continue
-            if req.expired(now):
-                self._resolve_dead(req, "timeout", now, on_drop)
-                continue
-            return req
+                req = self._q[0]
+                dead = req.cancelled or req.expired(now)
+                if dead or pop:
+                    self._q.popleft()
+            if not dead:
+                return req
+            self._resolve_dead(
+                req, "cancelled" if req.cancelled else "timeout",
+                now, on_drop)
+
+    def peek_ready(self, now: float, on_drop=None) -> Optional[Request]:
+        """The next live request WITHOUT removing it — the paged
+        pool's admission gate peeks, checks block affordability
+        (`can_admit`), and only then pops, so a request that does not
+        fit yet stays at the queue head (FIFO preserved, no
+        pop/requeue churn) while dead requests ahead of it still
+        resolve inline exactly as `pop_ready` would."""
+        return self._next_ready(now, on_drop, pop=False)
+
+    def pop_ready(self, now: float, on_drop=None) -> Optional[Request]:
+        """Next live request, resolving cancelled/expired ones inline
+        (``on_drop(req, kind)`` with kind "cancelled"/"timeout" fires
+        for each, for metrics/tracing); None when the queue holds no
+        admissible work."""
+        return self._next_ready(now, on_drop, pop=True)
 
     def requeue(self, reqs: List[Request]) -> int:
         """Recovery-path re-admission (engine watchdog restart): put

@@ -174,6 +174,22 @@ class ServingEngine:
         tokens are read, hiding the host sync behind device compute);
         0 syncs every tick immediately (the A/B control
         `bench.py --serving` measures against).
+    paged : use the paged KV cache (docs/serving.md "Paged KV cache"):
+        device KV is a shared block pool (`serving.paging`) instead of
+        a private max_len region per slot, admission gates on BLOCK
+        availability (num_slots becomes cheap program width — more
+        concurrent sequences fit the same KV bytes whenever requests
+        run short of max_len), and shared prompt prefixes are served
+        from the resident block cache instead of re-prefilling.
+        Outputs stay token-exact vs the fixed pool (pinned by tests).
+    kv_block_size : paged block size in tokens (must divide max_len);
+        None reads HVD_KV_BLOCK_SIZE (default 16).
+    kv_blocks : paged device block count — the KV-bytes knob; None
+        reads HVD_KV_BLOCKS, and <= 0 means auto: num_slots x
+        max_len / block_size (+1 null), byte-parity with the fixed
+        pool at the same num_slots.
+    prefix_cache : shared-prefix caching over the paged pool; None
+        reads HVD_PREFIX_CACHE (default on). Ignored unless paged.
     """
 
     def __init__(self, model: TransformerLM, params, *,
@@ -186,7 +202,11 @@ class ServingEngine:
                  stall_warning_s: Optional[float] = None,
                  warmup: bool = False,
                  prefill_chunk_budget: Optional[int] = None,
-                 pipeline_depth: int = 1):
+                 pipeline_depth: int = 1,
+                 paged: bool = False,
+                 kv_block_size: Optional[int] = None,
+                 kv_blocks: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None):
         if eos_id is not None and not 0 <= eos_id < model.vocab_size:
             raise ValueError(
                 f"eos_id must be in [0, vocab_size={model.vocab_size}"
@@ -213,8 +233,27 @@ class ServingEngine:
         self.stall = StallMonitor(warning_time_s=stall_warning_s,
                                   check_every_s=max(
                                       1.0, stall_warning_s / 4))
-        self.pool = SlotPool(model, params, num_slots, mesh=mesh,
-                             eos_id=eos_id)
+        self.paged = bool(paged)
+        if self.paged:
+            from horovod_tpu.serving.paging import PagedSlotPool
+            if kv_blocks is None:
+                from horovod_tpu.runtime.config import config as _cfg
+                kv_blocks = _cfg.kv_blocks
+            self.pool = PagedSlotPool(
+                model, params, num_slots,
+                num_blocks=(int(kv_blocks) if kv_blocks
+                            and int(kv_blocks) > 0 else None),
+                block_size=kv_block_size, mesh=mesh, eos_id=eos_id,
+                prefix_cache=prefix_cache,
+                # Evictions are operator-visible cache pressure: the
+                # allocator reports each one straight into this
+                # engine's metrics (and the shared
+                # hvd_prefix_cache_evictions_total counter).
+                on_evict=lambda: self.metrics.count(
+                    "prefix_evictions"))
+        else:
+            self.pool = SlotPool(model, params, num_slots, mesh=mesh,
+                                 eos_id=eos_id)
         # Warmup runs on the constructor thread BEFORE the dispatch
         # thread exists, so the single-jax-thread contract holds.
         self.warmup_info = None
@@ -318,6 +357,17 @@ class ServingEngine:
             raise ValueError(
                 f"prompt ({P}) + max_new_tokens ({max_new_tokens}) - 1 "
                 f"exceeds max_len={self.model.max_len}")
+        if self.paged and not self.pool.fits(P, max_new_tokens):
+            # A request whose WORST-CASE block need exceeds the whole
+            # pool could never admit — it would park at the queue head
+            # starving everything behind it. Shed at the front door
+            # instead (the degrade-by-shedding contract).
+            raise ValueError(
+                f"prompt ({P}) + max_new_tokens ({max_new_tokens}) "
+                f"needs more KV blocks than the paged pool holds "
+                f"({self.pool.num_blocks - 1} x "
+                f"{self.pool.block_size} tokens); raise kv_blocks "
+                f"(HVD_KV_BLOCKS) or lower the request size")
         sampling = SamplingParams(temperature=temperature, top_p=top_p,
                                   seed=seed)
         sampling.validate()
@@ -380,6 +430,9 @@ class ServingEngine:
                 self.metrics.observe_gauges(
                     len(queue), scheduler.pool.busy_slots,
                     scheduler.pool.num_slots)
+                if self.paged:
+                    self.metrics.observe_kv(
+                        scheduler.pool.kv_stats())
                 if closing:
                     if not drain:
                         scheduler.abort_active()
@@ -499,9 +552,13 @@ class ServingEngine:
                 # replay from the prompt is token-exact (greedy and
                 # seeded sampling are deterministic), and a fresh
                 # tokens list means the old thread limping out of a
-                # hung tick cannot corrupt the replay.
+                # hung tick cannot corrupt the replay. prefix_cached
+                # resets too: the successor pool's cache starts COLD
+                # (untrusted device state), so the replay's own
+                # re-admission decides what it skips.
                 requeued.append(dataclasses.replace(
-                    req, tokens=[], t_prefill=0.0, t_first=0.0))
+                    req, tokens=[], t_prefill=0.0, t_first=0.0,
+                    prefix_cached=0))
         n = self.queue.requeue(requeued)
         self.metrics.count("restarts")
         if n:

@@ -133,10 +133,26 @@ class EngineMetrics:
         self.restarts = 0          # in-place engine restarts
         self.requeued = 0          # in-flight requests replayed
         self.faults_injected = 0   # chaos sites fired inside serving
+        # Paged-KV / shared-prefix counters (docs/serving.md "Paged KV
+        # cache"): block-level prefix-cache accounting plus the TTFT
+        # evidence — prompt tokens admission never had to prefill.
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_evictions = 0
+        self.prefill_tokens_skipped = 0
         # Gauges (set by the engine each loop).
         self.queue_depth = 0
         self.slots_busy = 0
         self.num_slots = 0
+        # High-water mark of concurrently resident sequences (decoding
+        # + mid-prefill) — the paged pool's effective-concurrency
+        # evidence (can exceed a byte-equivalent fixed pool's
+        # num_slots).
+        self.peak_active = 0
+        # Paged-KV block occupancy (None until a paged pool reports).
+        self.kv_blocks_free = None
+        self.kv_blocks_used = None
+        self.kv_blocks_cached = None
         self.pipeline_depth = 0    # engine config (0 = sync ticks)
         self.warmup_s = None       # startup precompile cost, if run
         # Latency series (seconds).
@@ -166,12 +182,38 @@ class EngineMetrics:
             setattr(self, name, getattr(self, name) + n)
         self._obs["events"].inc(n, event=name)
         # The watchdog counters are ALSO the resilience plane's
-        # restarts/requeued families (one source of truth per number;
-        # chaos owns the per-site faults_injected breakdown).
+        # restarts/requeued families, and the prefix-cache counters
+        # the dedicated hvd_prefix_cache_* family (one source of
+        # truth per number; chaos owns the per-site faults_injected
+        # breakdown).
         if name == "restarts":
             self._obs_res["restarts"].inc(n)
         elif name == "requeued":
             self._obs_res["requeued"].inc(n)
+        elif name in ("prefix_hits", "prefix_misses",
+                      "prefix_evictions", "prefill_tokens_skipped"):
+            self._obs[name].inc(n)
+
+    def observe_peak(self, active: int):
+        """High-water mark of concurrently resident sequences."""
+        with self._lock:
+            if active > self.peak_active:
+                self.peak_active = active
+
+    def observe_kv(self, stats: Dict):
+        """Fold one paged-pool block-occupancy report into the gauges
+        (engine loop cadence; `stats` = `PagedSlotPool.kv_stats()`)."""
+        with self._lock:
+            self.kv_blocks_free = stats["blocks_free"]
+            self.kv_blocks_used = stats["blocks_used"]
+            self.kv_blocks_cached = stats["blocks_cached"]
+        eng = self._engine_label
+        self._obs["kv_blocks_free"].set(stats["blocks_free"],
+                                        engine=eng)
+        self._obs["kv_blocks_used"].set(stats["blocks_used"],
+                                        engine=eng)
+        self._obs["kv_blocks_cached"].set(stats["blocks_cached"],
+                                          engine=eng)
 
     def observe_gauges(self, queue_depth: int, slots_busy: int,
                        num_slots: int):
@@ -218,7 +260,9 @@ class EngineMetrics:
         aggregates and stay."""
         eng = self._engine_label
         for name in ("queue_depth", "slots_busy", "slots_total",
-                     "slot_occupancy", "engine_generation"):
+                     "slot_occupancy", "engine_generation",
+                     "kv_blocks_free", "kv_blocks_used",
+                     "kv_blocks_cached"):
             self._obs[name].remove(engine=eng)
 
     def snapshot(self) -> Dict:
@@ -253,6 +297,18 @@ class EngineMetrics:
                 "requeued": self.requeued,
                 "faults_injected": self.faults_injected,
                 "recovery_ms": self.recovery_s.summary(1e3),
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_evictions": self.prefix_evictions,
+                "prefill_tokens_skipped": self.prefill_tokens_skipped,
+                "prefix_hit_rate": (
+                    round(self.prefix_hits
+                          / (self.prefix_hits + self.prefix_misses), 4)
+                    if self.prefix_hits + self.prefix_misses else None),
+                "kv_blocks_free": self.kv_blocks_free,
+                "kv_blocks_used": self.kv_blocks_used,
+                "kv_blocks_cached": self.kv_blocks_cached,
+                "peak_active": self.peak_active,
                 "queue_depth": self.queue_depth,
                 "slots_busy": self.slots_busy,
                 "num_slots": self.num_slots,

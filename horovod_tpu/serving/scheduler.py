@@ -75,6 +75,9 @@ class CompletedRequest:
     tpot_s: Optional[float]       # None for single-token outputs
     e2e_s: float
     trace_id: str = ""            # the request's observability id
+    # Prompt tokens served from the paged pool's shared-prefix cache
+    # (prefill skipped them); 0 on the fixed pool / cache misses.
+    prefix_tokens_cached: int = 0
 
     @property
     def full_sequence(self) -> np.ndarray:
@@ -341,7 +344,15 @@ class ContinuousBatchingScheduler:
                     slot = self._prefill_order[0]
                     job = self.prefilling[slot]
             if job is None:
-                if not self.pool.has_free():
+                # PEEK first: admission gates on the POOL's capacity —
+                # free lanes for both pools, and block availability
+                # (after prefix-cache credit) on the paged pool. A
+                # request that does not fit yet stays at the queue
+                # head, FIFO intact, until retirements free blocks.
+                head = self.queue.peek_ready(now,
+                                             on_drop=self._queue_drop)
+                if head is None or not self.pool.can_admit(
+                        head.prompt, head.max_new_tokens):
                     break
                 req = self.queue.pop_ready(now, on_drop=self._queue_drop)
                 if req is None:
@@ -354,15 +365,46 @@ class ContinuousBatchingScheduler:
                 # the registration happens before the snapshot (the
                 # successor requeues it) or the abandon is visible here
                 # (we hand it straight back to the queue).
+                blocked = None
                 with self._handoff:
                     if self.abandoned:
-                        self.queue.requeue([req])
-                        break
-                    slot = self.pool.alloc()
-                    job = _PrefillJob(req=req, chunks=prefill_schedule(
-                        int(req.prompt.shape[0]), self._max_chunk))
-                    self.prefilling[slot] = job
-                    self._prefill_order.append(slot)
+                        blocked = req
+                    else:
+                        # admit() pins matched prefix blocks and
+                        # reserves the rest; None only if the popped
+                        # request differs from the peeked head (a
+                        # cancel raced in between) AND doesn't fit.
+                        adm = self.pool.admit(req.prompt,
+                                              req.max_new_tokens)
+                        if adm is None:
+                            blocked = req
+                        else:
+                            slot = adm.slot
+                            job = _PrefillJob(
+                                req=req,
+                                chunks=prefill_schedule(
+                                    int(req.prompt.shape[0])
+                                    - adm.skipped, self._max_chunk),
+                                off=adm.skipped)
+                            self.prefilling[slot] = job
+                            self._prefill_order.append(slot)
+                if blocked is not None:
+                    self.queue.requeue([blocked])
+                    break
+                req.prefix_cached = adm.skipped
+                if adm.queried_blocks:
+                    self.metrics.count("prefix_hits",
+                                       adm.matched_blocks)
+                    self.metrics.count(
+                        "prefix_misses",
+                        adm.queried_blocks - adm.matched_blocks)
+                if adm.skipped:
+                    # The TTFT the cache just deleted: these prompt
+                    # tokens never touch a prefill chunk.
+                    self.metrics.count("prefill_tokens_skipped",
+                                       adm.skipped)
+                self.metrics.observe_peak(len(self.active)
+                                          + len(self.prefilling))
                 req.t_prefill = time.time()
                 _span("end_span", req.id, "QUEUE")
                 _span("begin_span", req.id, "PREFILL",
@@ -516,7 +558,8 @@ class ContinuousBatchingScheduler:
                 tpot_s=((now - req.t_first) / (n - 1)
                         if n > 1 else None),
                 e2e_s=now - req.t_submit,
-                trace_id=req.trace_id))
+                trace_id=req.trace_id,
+                prefix_tokens_cached=req.prefix_cached))
         elif reason == "cancelled":
             self.metrics.count("cancelled")
             self._resolve(req.future, exc=CancelledError())

@@ -39,6 +39,8 @@ from __future__ import annotations
 
 import contextlib
 import time
+import warnings
+from dataclasses import dataclass
 from typing import List, Optional
 
 import numpy as np
@@ -67,13 +69,35 @@ def _first_token(logits, temp, top_p, key):
     return tok.astype(jnp.int32), rng
 
 
-# Historical bound on idle-lane fill-index creep (PR 1/2: a FREE slot
-# rode the shared vmapped tick and crept its fill index, so pools
-# re-zeroed long-idle lanes every this-many ticks). The PR-3 tick
-# freezes non-live lanes' indices on device (`slot_decode_tick`'s
-# ``live`` mask), so idle creep is now 0 and no periodic reset runs;
-# the constant remains the documented ceiling tests pin.
-RESET_IDLE_TICKS = 64
+def __getattr__(name):
+    """Deprecation shim for the PR-1/2 idle-reset machinery. The PR-3
+    tick freezes non-live lanes' fill indices ON DEVICE
+    (`slot_decode_tick`'s ``live`` mask), so idle creep is exactly 0,
+    no periodic reset runs, and the old ceiling constant is
+    meaningless — importers get the historical value plus a warning
+    until they migrate."""
+    if name == "RESET_IDLE_TICKS":
+        warnings.warn(
+            "RESET_IDLE_TICKS is obsolete: idle lanes' fill indices "
+            "are frozen on device since the PR-3 tick (live mask) — "
+            "idle creep is 0 and no periodic reset exists to bound",
+            DeprecationWarning, stacklevel=2)
+        return 64
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+@dataclass(frozen=True)
+class Admission:
+    """One granted admission: the decode lane, how many prompt tokens
+    the KV cache already holds (``skipped`` — prefill starts there; 0
+    outside the paged pool's prefix cache), and the block-level hit
+    accounting behind it (`serving.paging`)."""
+
+    slot: int
+    skipped: int = 0          # prompt tokens covered by matched blocks
+    matched_blocks: int = 0   # prefix blocks pinned from the cache
+    queried_blocks: int = 0   # block-aligned prefix blocks looked up
 
 
 class TickHandle:
@@ -204,6 +228,23 @@ class SlotPool:
         if not self._free:
             return None
         return self._free.pop()
+
+    def can_admit(self, prompt, max_new: int) -> bool:
+        """Scheduler admission gate (shared protocol with the paged
+        pool): the fixed pool's only capacity axis is free slots —
+        every slot already reserves max_len KV rows, so prompt/budget
+        never constrain further."""
+        del prompt, max_new
+        return self.has_free()
+
+    def admit(self, prompt, max_new: int) -> Optional[Admission]:
+        """Claim a slot for one request (shared protocol with
+        `serving.paging.PagedSlotPool`, where this is also where
+        blocks are reserved and the prompt's prefix is matched). The
+        fixed pool never skips prefix tokens."""
+        del prompt, max_new
+        slot = self.alloc()
+        return None if slot is None else Admission(slot=slot)
 
     def begin_prefill(self, slot: int):
         """Zero ``slot``'s rows and clear its live/done flags — the

@@ -1,0 +1,627 @@
+"""Paged KV-cache tests: block allocator invariants, paged-vs-linear
+equivalence, shared-prefix caching, copy-on-write, capacity.
+
+The contract stack, bottom to top:
+
+* `BlockPool` — every allocatable block is in exactly one of
+  free / active / cached at all times; refcounts equal chain
+  memberships exactly; LRU eviction is oldest-first and unregisters
+  the hash (`check_invariants` after every operation in the churn
+  fuzz).
+* `PagedSlotPool` — BITWISE the slot pool: the gathered block-table
+  view feeds the identical decode program, so prefill logits and
+  token streams match `SlotPool` (and `generate`) exactly, cold AND
+  across a prefix-cache hit (the skipped span's KV is the same bytes
+  an actual prefill would have produced).
+* `ServingEngine(paged=True)` — token-exact vs `generate` under
+  mixed-length churn; admission blocks on BLOCKS (not just lanes);
+  effective concurrency exceeds the byte-equivalent fixed pool's
+  num_slots; the second identical-prefix request reports
+  prefix_tokens_cached > 0.
+"""
+
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models.transformer import (
+    TransformerLM, generate, paged_cache_spec, prefill_chunks,
+)
+from horovod_tpu.parallel.tensor import unbox
+from horovod_tpu.serving import ServingEngine
+from horovod_tpu.serving.paging import BlockPool, PagedSlotPool
+from horovod_tpu.serving.slots import SlotPool
+
+VOCAB = 64
+MAX_LEN = 32
+BS = 8   # test block size (divides MAX_LEN; 4 blocks per sequence)
+
+
+def _model():
+    return TransformerLM(vocab_size=VOCAB, num_layers=2, num_heads=4,
+                         head_dim=8, max_len=MAX_LEN,
+                         dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def lm(hvd):
+    model = _model()
+    params = unbox(model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 16), jnp.int32))["params"])
+    return model, params
+
+
+def _prompts(n, seed=0, lo=1, hi=8):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, VOCAB, (int(rs.randint(lo, hi)),))
+            for _ in range(n)]
+
+
+def _ref(model, params, prompt, steps, **kw):
+    return np.asarray(generate(model, params,
+                               jnp.asarray(prompt)[None], steps,
+                               **kw))[0]
+
+
+class TestBlockPool:
+    def test_states_and_free_list(self):
+        bp = BlockPool(6, 4)   # 5 usable
+        assert bp.free_blocks == 5 and bp.used_blocks == 0
+        adm = bp.admit(0, np.arange(6), 2)   # ceil(8/4) = 2 blocks
+        assert adm is not None and adm.skipped == 0
+        assert bp.used_blocks == 2 and bp.free_blocks == 3
+        bp.check_invariants()
+        bp.free_seq(0)
+        bp.check_invariants()
+        # Nothing published -> everything returns to the free list.
+        assert bp.free_blocks == 5 and bp.cached_blocks == 0
+
+    def test_admit_rejects_when_short_and_is_atomic(self):
+        bp = BlockPool(4, 4)   # 3 usable
+        assert bp.admit(0, np.arange(4), 12) is None   # needs 4
+        bp.check_invariants()
+        assert bp.free_blocks == 3   # nothing leaked by the refusal
+        assert bp.can_admit(np.arange(4), 8)           # needs 3
+        assert not bp.can_admit(np.arange(4), 9)
+
+    def test_publish_match_pin_and_lru(self):
+        bp = BlockPool(10, 4)
+        prompt = np.arange(10)          # blocks [0:4],[4:8] publishable
+        bp.admit(0, prompt, 2)
+        bp.publish(0, prompt)
+        bp.free_seq(0)
+        bp.check_invariants()
+        assert bp.cached_blocks == 2    # resident, refcount 0
+        ids, queried = bp.match(prompt)
+        assert len(ids) == 2 and queried == 2
+        # An identical-prefix admission pins both cached blocks.
+        adm = bp.admit(1, prompt, 2)
+        assert adm.skipped == 8 and adm.matched_blocks == 2
+        assert bp.cached_blocks == 0 and bp.used_blocks == 3
+        bp.check_invariants()
+        bp.free_seq(1)
+        assert bp.cached_blocks == 2    # back to resident
+        bp.check_invariants()
+
+    def test_match_never_covers_whole_prompt(self):
+        """At least one tail token must re-prefill (its chunk's logits
+        seed the first sampled token), so a fully block-aligned
+        resident prompt matches all but its LAST block."""
+        bp = BlockPool(10, 4)
+        prompt = np.arange(8)           # exactly 2 blocks
+        bp.admit(0, prompt, 2)
+        bp.publish(0, prompt)
+        bp.free_seq(0)
+        ids, queried = bp.match(prompt)
+        assert queried == 1 and len(ids) == 1   # (8-1)//4 == 1
+
+    def test_chain_hash_commits_to_whole_prefix(self):
+        """Block 2 of prompt A must NOT match block 2 of prompt B when
+        their first blocks differ — digests chain."""
+        bp = BlockPool(12, 4)
+        a = np.arange(12)
+        b = np.concatenate([[63], np.arange(1, 12)])   # differs at 0
+        bp.admit(0, a, 2)
+        bp.publish(0, a)
+        bp.free_seq(0)
+        ids, _ = bp.match(b)
+        assert ids == []
+
+    def test_lru_eviction_oldest_first(self):
+        bp = BlockPool(5, 4)   # 4 usable
+        p1, p2 = np.arange(5), np.arange(5) + 20
+        bp.admit(0, p1, 2)     # 2 blocks (1 publishable)
+        bp.publish(0, p1)
+        bp.free_seq(0)
+        bp.admit(1, p2, 2)
+        bp.publish(1, p2)
+        bp.free_seq(1)
+        assert bp.cached_blocks == 2 and bp.free_blocks == 2
+        # Need 3 blocks (disjoint prompt — no accidental prefix hit):
+        # free list (2) + one eviction — p1's block is the LRU oldest
+        # and must be the one evicted.
+        bp.admit(2, np.arange(40, 49), 3)
+        bp.check_invariants()
+        assert bp.evictions == 1
+        assert bp.match(p1)[0] == []        # evicted
+        assert len(bp.match(p2)[0]) == 1    # survived
+        bp.free_seq(2)
+        bp.check_invariants()
+
+    def test_matched_cached_blocks_not_double_counted(self):
+        """Review regression: a matched block sitting in the LRU is
+        simultaneously 'evictable' and about to be pinned — counting
+        it as allocation headroom let a tight admission pass its
+        capacity check, pin the block OUT of the LRU, then die
+        evicting from an empty LRU. The headroom math must exclude
+        matched-in-LRU blocks (and the refusal must leave nothing
+        pinned)."""
+        bp = BlockPool(4, 8)            # 3 usable
+        p1 = np.arange(8)
+        bp.admit(0, p1, 0)              # 1 block, publishable
+        bp.publish(0, p1)
+        bp.free_seq(0)
+        assert bp.cached_blocks == 1 and bp.free_blocks == 2
+        big = np.concatenate([p1, np.arange(8, 16)])   # shares block 1
+        # needed = 4 blocks, matched = 1 (in LRU): true headroom is
+        # free(2) + lru(1) - matched_in_lru(1) = 2 < 3 -> refuse.
+        assert not bp.can_admit(big, 16)
+        assert bp.admit(1, big, 16) is None
+        bp.check_invariants()
+        assert bp.cached_blocks == 1    # refusal pinned nothing
+
+    def test_needed_clamped_to_max_seq_tokens(self):
+        """Review regression: a boundary request the engine accepts
+        (P + max_new - 1 == max_len) must reserve exactly
+        blocks_per_seq blocks, never one more than its table row can
+        hold — positions past max_len are never written."""
+        bp = BlockPool(8, 8, max_seq_tokens=32)
+        assert bp._needed(17, 16) == 4          # min(33, 32) / 8
+        assert bp.fits(17, 16)
+        uncapped = BlockPool(8, 8)
+        assert uncapped._needed(17, 16) == 5    # raw worst case
+
+    def test_prefix_cache_disabled_frees_eagerly(self):
+        bp = BlockPool(6, 4, prefix_cache=False)
+        p = np.arange(8)
+        bp.admit(0, p, 2)
+        bp.publish(0, p)
+        bp.free_seq(0)
+        assert bp.cached_blocks == 0 and bp.free_blocks == 5
+        assert bp.match(p) == ([], 0)
+
+    def test_fork_refcounts_and_cow(self):
+        bp = BlockPool(8, 4)
+        bp.admit(0, np.arange(6), 4)    # ceil(10/4) = 3 blocks
+        bp.fork(0, 1)
+        bp.check_invariants()
+        assert bp.used_blocks == 3      # shared, not duplicated
+        # Appending into the shared tail block splits it.
+        swap = bp.ensure_writable(0, 2)
+        assert swap is not None and bp.cows == 1
+        bp.check_invariants()
+        assert bp.used_blocks == 4
+        # Now exclusively owned: no further copy.
+        assert bp.ensure_writable(0, 2) is None
+        bp.free_seq(0)
+        bp.free_seq(1)
+        bp.check_invariants()
+        assert bp.free_blocks == 7
+
+    def test_cow_on_published_block_unregisters(self):
+        """A sole owner appending into its own PUBLISHED block doesn't
+        copy — it unregisters the hash so no future matcher can pin a
+        block about to be overwritten."""
+        bp = BlockPool(6, 4)
+        p = np.arange(6)
+        bp.admit(0, p, 2)
+        bp.publish(0, p)
+        assert bp.ensure_writable(0, 0) is None
+        assert bp.match(p)[0] == []     # no longer matchable
+        bp.check_invariants()
+
+    def test_cow_without_headroom_raises(self):
+        bp = BlockPool(4, 4)            # 3 usable
+        bp.admit(0, np.arange(8), 4)    # takes all 3
+        bp.fork(0, 1)
+        with pytest.raises(RuntimeError, match="copy-on-write"):
+            bp.ensure_writable(0, 2)
+        bp.check_invariants()
+
+    def test_invariants_under_random_churn(self):
+        """Fuzz: random admit/publish/free/fork/cow over a small pool;
+        the free/active/cached partition and the refcount accounting
+        must hold after every single operation."""
+        rs = np.random.RandomState(7)
+        bp = BlockPool(16, 4)
+        live = {}
+        key = 0
+        for step in range(400):
+            op = rs.randint(4)
+            if op == 0 and len(live) < 6:
+                plen = int(rs.randint(1, 14))
+                prompt = rs.randint(0, 8, (plen,))   # small vocab:
+                new = int(rs.randint(1, 6))          # real collisions
+                if bp.admit(key, prompt, new) is not None:
+                    live[key] = prompt
+                    key += 1
+            elif op == 1 and live:
+                k = list(live)[rs.randint(len(live))]
+                bp.publish(k, live[k])
+            elif op == 2 and live:
+                k = list(live)[rs.randint(len(live))]
+                bp.free_seq(k)
+                del live[k]
+            elif op == 3 and live and len(live) < 6:
+                k = list(live)[rs.randint(len(live))]
+                if bp.available_blocks > 2:
+                    bp.fork(k, key)
+                    live[key] = live[k]
+                    key += 1
+            bp.check_invariants()
+        for k in list(live):
+            bp.free_seq(k)
+        bp.check_invariants()
+        assert bp.used_blocks == 0
+
+
+class TestPagedEquivalence:
+    def test_prefill_logits_bitwise_equal(self, lm):
+        """Same prompt, same chunk schedule: the paged pool's prefill
+        logits are BITWISE the slot pool's — the gathered block-table
+        view feeds the identical compiled attention math."""
+        model, params = lm
+        prompt = np.array([5, 9, 11, 3, 7, 2, 4, 8, 1, 6, 12])
+        ref = SlotPool(model, params, 2)
+        slot = ref.alloc()
+        ref.begin_prefill(slot)
+        paged = PagedSlotPool(model, params, 2, block_size=BS)
+        adm = paged.admit(prompt, 8)
+        paged.begin_prefill(adm.slot)
+        off = 0
+        for c in prefill_chunks(len(prompt)):
+            la = ref.prefill_chunk(slot, prompt[off:off + c])
+            lb = paged.prefill_chunk(adm.slot, prompt[off:off + c])
+            off += c
+            np.testing.assert_array_equal(np.asarray(la),
+                                          np.asarray(lb))
+
+    def test_decode_stream_matches_slot_pool_and_generate(self, lm):
+        """Greedy decode through the paged pool == the linear slot
+        pool == sequential generate, token for token (the acceptance
+        bitwise-equivalence property)."""
+        model, params = lm
+        prompt = _prompts(1, seed=3, lo=4, hi=12)[0]
+        steps = 10
+        ref_pool = SlotPool(model, params, 2)
+        s0 = ref_pool.alloc()
+        a = [ref_pool.prefill(s0, prompt, 0.0, None, 0)]
+        paged = PagedSlotPool(model, params, 2, block_size=BS)
+        adm = paged.admit(prompt, steps)
+        b = [paged.prefill(adm.slot, prompt, 0.0, None, 0)]
+        for _ in range(steps - 1):
+            a.append(int(ref_pool.tick()[s0]))
+            b.append(int(paged.tick()[adm.slot]))
+        assert a == b
+        ref = _ref(model, params, prompt, steps)
+        assert list(ref[len(prompt):]) == b
+
+    def test_sampled_stream_matches_slot_pool(self, lm):
+        """Per-request seeded sampling is reproducible across pool
+        implementations (same `_first_token` split discipline, same
+        per-tick RNG stream)."""
+        model, params = lm
+        prompt = _prompts(1, seed=5, lo=4, hi=10)[0]
+        ref_pool = SlotPool(model, params, 1)
+        s0 = ref_pool.alloc()
+        a = [ref_pool.prefill(s0, prompt, 0.9, 0.8, 42)]
+        paged = PagedSlotPool(model, params, 1, block_size=BS)
+        adm = paged.admit(prompt, 8)
+        b = [paged.prefill(adm.slot, prompt, 0.9, 0.8, 42)]
+        for _ in range(7):
+            a.append(int(ref_pool.tick()[s0]))
+            b.append(int(paged.tick()[adm.slot]))
+        assert a == b
+
+    def test_prefix_hit_stream_matches_cold(self, lm):
+        """A cache-hit admission (prefill starts past the matched
+        span) continues BITWISE like a cold one: the resident blocks
+        hold exactly the bytes a fresh prefill would write."""
+        model, params = lm
+        rs = np.random.RandomState(11)
+        shared = rs.randint(0, VOCAB, (2 * BS,))
+        tails = [rs.randint(0, VOCAB, (3,)) for _ in range(2)]
+        paged = PagedSlotPool(model, params, 2, block_size=BS)
+        steps = 8
+        streams = []
+        for tail in tails:
+            prompt = np.concatenate([shared, tail])
+            adm = paged.admit(prompt, steps)
+            toks = [paged.prefill(adm.slot, prompt, 0.0, None, 0)]
+            for _ in range(steps - 1):
+                toks.append(int(paged.tick()[adm.slot]))
+            streams.append((prompt, adm, toks))
+            paged.free(adm.slot)
+            paged.blocks.check_invariants()
+        assert streams[0][1].skipped == 0          # cold
+        assert streams[1][1].skipped == 2 * BS     # both blocks hit
+        for prompt, _, toks in streams:
+            ref = _ref(model, params, prompt, steps)
+            assert list(ref[len(prompt):]) == toks
+
+    def test_eos_on_device_stop_paged(self, lm):
+        """On-device stop detection carries over: a paged lane that
+        emitted eos keeps re-emitting eos and freezes its fill."""
+        model, params = lm
+        prompt = _prompts(1, seed=3, lo=4, hi=8)[0]
+        probe = _ref(model, params, prompt, 10)
+        eos = int(probe[len(prompt) + 4])
+        pool = PagedSlotPool(model, params, 2, block_size=BS,
+                             eos_id=eos)
+        adm = pool.admit(prompt, 10)
+        seen = [pool.prefill(adm.slot, prompt, 0.0, None, 0)]
+        for _ in range(10):
+            seen.append(int(pool.tick()[adm.slot]))
+        hit = seen.index(eos)
+        assert hit <= 5
+        assert all(t == eos for t in seen[hit:]), seen
+        fills = pool.fill_indices()
+        assert fills[adm.slot] <= len(prompt) + hit + 1
+        assert fills[1 - adm.slot] == 0    # idle lane frozen
+
+    def test_fork_cow_streams_independent(self, lm):
+        """Fork shares the chain; divergent appends split the tail
+        block (COW) and each lane's continuation matches an
+        independent unforked run — without the copy the two lanes
+        would clobber each other's KV at the same position."""
+        model, params = lm
+        prompt = _prompts(1, seed=9, lo=6, hi=12)[0]
+        pool = PagedSlotPool(model, params, 2, block_size=BS)
+        adm = pool.admit(prompt, 6)
+        pool.prefill(adm.slot, prompt, 0.0, None, 0)
+        dst = pool.fork(adm.slot)
+        assert dst is not None
+        pool.blocks.check_invariants()
+        forced = (3, 7)
+        pool._toks = pool._toks.at[adm.slot].set(forced[0])
+        pool._toks = pool._toks.at[dst].set(forced[1])
+        t1 = pool.tick()
+        assert pool.blocks.cows >= 1
+        pool.blocks.check_invariants()
+        t2 = pool.tick()
+        for slot, f in ((adm.slot, forced[0]), (dst, forced[1])):
+            ref = _ref(model, params,
+                       np.concatenate([prompt, [f]]), 3)
+            assert int(t1[slot]) == int(ref[len(prompt) + 1])
+            assert int(t2[slot]) == int(ref[len(prompt) + 2])
+
+    def test_geometry_validation(self, lm):
+        model, params = lm
+        with pytest.raises(ValueError, match="divide"):
+            PagedSlotPool(model, params, 1, block_size=7)
+        with pytest.raises(ValueError, match="null"):
+            PagedSlotPool(model, params, 1, block_size=BS,
+                          num_blocks=1)
+        windowed = _model().clone(window=16, pos_emb="rope")
+        with pytest.raises(ValueError, match="window"):
+            paged_cache_spec(windowed, BS)
+
+
+class TestPagedEngine:
+    def test_mixed_lengths_token_exact(self, lm):
+        """The engine oracle on the paged pool: concurrent
+        mixed-length requests through few lanes == sequential
+        generate, with retire/refill churn exercising block
+        free/realloc."""
+        model, params = lm
+        prompts = _prompts(8, seed=0)
+        steps = 8
+        with ServingEngine(model, params, num_slots=3, max_queue=16,
+                           paged=True, kv_block_size=BS) as eng:
+            handles = [eng.submit(p, steps) for p in prompts]
+            results = [h.result(timeout=300) for h in handles]
+        assert eng.metrics_snapshot()["completed"] == 8
+        for p, r in zip(prompts, results):
+            np.testing.assert_array_equal(
+                r.full_sequence, _ref(model, params, p, steps))
+
+    def test_shared_prefix_skips_prefill_token_exact(self, lm):
+        """Requests sharing a system prompt: the later ones report
+        prefix_tokens_cached > 0 (admission pinned the resident
+        blocks, prefill streamed only the tail) and stay token-exact;
+        the snapshot shows hits and skipped tokens."""
+        model, params = lm
+        rs = np.random.RandomState(2)
+        sysp = rs.randint(0, VOCAB, (2 * BS,))
+        prompts = [np.concatenate([sysp,
+                                   rs.randint(0, VOCAB, (2,))])
+                   for _ in range(4)]
+        steps = 6
+        with ServingEngine(model, params, num_slots=2, max_queue=16,
+                           paged=True, kv_block_size=BS) as eng:
+            # Serialized submits so the first finishes (and publishes)
+            # before the rest admit — deterministic hit pattern.
+            first = eng.submit(prompts[0], steps).result(timeout=300)
+            rest = [eng.submit(p, steps) for p in prompts[1:]]
+            results = [h.result(timeout=300) for h in rest]
+        snap = eng.metrics_snapshot()
+        assert first.prefix_tokens_cached == 0
+        for r in results:
+            assert r.prefix_tokens_cached == 2 * BS
+        assert snap["prefix_hits"] >= 6
+        assert snap["prefill_tokens_skipped"] >= 3 * 2 * BS
+        assert snap["prefix_hit_rate"] > 0.5
+        for p, r in zip(prompts, [first] + results):
+            np.testing.assert_array_equal(
+                r.full_sequence, _ref(model, params, p, steps))
+
+    def test_concurrency_exceeds_fixed_bound_at_equal_bytes(self, lm):
+        """The capacity acceptance leg: at the KV bytes of a FIXED
+        2-slot pool (2 x max_len rows), the paged engine runs 8 short
+        requests CONCURRENTLY (blocks sized to actual lengths), all
+        token-exact."""
+        model, params = lm
+        fixed_equiv_slots = 2
+        kv_blocks = fixed_equiv_slots * (MAX_LEN // BS) + 1   # +null
+        prompts = _prompts(8, seed=4, lo=2, hi=4)
+        with ServingEngine(model, params, num_slots=8, max_queue=32,
+                           paged=True, kv_block_size=BS,
+                           kv_blocks=kv_blocks,
+                           prefix_cache=False) as eng:
+            handles = [eng.submit(p, 4) for p in prompts]
+            results = [h.result(timeout=300) for h in handles]
+        snap = eng.metrics_snapshot()
+        assert snap["completed"] == 8
+        assert snap["peak_active"] > fixed_equiv_slots, snap
+        for p, r in zip(prompts, results):
+            np.testing.assert_array_equal(
+                r.full_sequence, _ref(model, params, p, 4))
+
+    def test_admission_blocks_on_block_availability(self, lm):
+        """Free lanes alone don't admit: with blocks for only one
+        request in flight, the second waits at the queue head (FIFO
+        intact, no shed) and completes after the first retires and
+        frees its blocks at ACTUAL length."""
+        model, params = lm
+        with ServingEngine(model, params, num_slots=2, max_queue=8,
+                           paged=True, kv_block_size=BS, kv_blocks=3,
+                           prefix_cache=False) as eng:
+            # Each request: prompt 6 + 6 new = 12 tokens -> 2 blocks;
+            # the pool holds 2 usable.
+            a = eng.submit(np.arange(1, 7), 6)
+            b = eng.submit(np.arange(2, 8), 6)
+            ra = a.result(timeout=300)
+            rb = b.result(timeout=300)
+        snap = eng.metrics_snapshot()
+        assert snap["completed"] == 2
+        assert snap["peak_active"] == 1      # never concurrent
+        for p, r in ((np.arange(1, 7), ra), (np.arange(2, 8), rb)):
+            np.testing.assert_array_equal(
+                r.full_sequence, _ref(model, params, p, 6))
+
+    def test_cancel_and_expiry_free_blocks(self, lm):
+        """Mid-prefill cancel and queued expiry both release the
+        request's whole chain — the allocator ends empty and the
+        invariants hold (the churn half of the acceptance)."""
+        import horovod_tpu.serving as sv
+        from concurrent.futures import Future
+        from horovod_tpu.serving.admission import (Request,
+                                                   SamplingParams)
+        model, params = lm
+        pool = PagedSlotPool(model, params, 1, block_size=BS)
+        queue = sv.AdmissionQueue(4)
+        metrics = sv.EngineMetrics()
+        sched = sv.ContinuousBatchingScheduler(
+            pool, queue, metrics, prefill_chunk_budget=2)
+        req = Request(id=0, prompt=np.arange(1, 15),
+                      max_new_tokens=8, sampling=SamplingParams(),
+                      deadline=None, future=Future(),
+                      t_submit=time.time())
+        queue.offer(req)
+        sched.step()
+        assert sched.prefilling and pool.blocks.used_blocks > 0
+        req.cancel()
+        sched.step()
+        assert not sched.prefilling
+        assert pool.blocks.used_blocks == 0
+        assert pool.free_slots == 1
+        pool.blocks.check_invariants()
+        # Queued expiry (no slot contact at all) leaks nothing either.
+        r2 = Request(id=1, prompt=np.arange(1, 5), max_new_tokens=4,
+                     sampling=SamplingParams(),
+                     deadline=time.time() - 1.0, future=Future(),
+                     t_submit=time.time())
+        queue.offer(r2)
+        sched.step()
+        assert pool.blocks.used_blocks == 0
+        pool.blocks.check_invariants()
+
+    def test_boundary_length_request_paged(self, lm):
+        """Review regression: a maximal request (P + max_new - 1 ==
+        max_len) through the PAGED engine must work like the fixed
+        pool — the reservation clamps to blocks_per_seq instead of
+        overflowing the block-table row."""
+        model, params = lm
+        prompt = _prompts(1, seed=17, lo=MAX_LEN // 2 + 1,
+                          hi=MAX_LEN // 2 + 2)[0]   # 17 tokens
+        steps = MAX_LEN - len(prompt) + 1            # 16: P+N-1 == 32
+        with ServingEngine(model, params, num_slots=1, paged=True,
+                           kv_block_size=BS) as eng:
+            r = eng.submit(prompt, steps).result(timeout=300)
+        np.testing.assert_array_equal(
+            r.full_sequence, _ref(model, params, prompt, steps))
+        eng.pool.blocks.check_invariants()
+
+    def test_oversized_request_sheds_at_submit(self, lm):
+        """Review regression: a request whose worst-case block need
+        exceeds the WHOLE pool must fail at submit (typed, immediate)
+        — not park at the queue head starving everything behind it."""
+        model, params = lm
+        with ServingEngine(model, params, num_slots=2, paged=True,
+                           kv_block_size=BS, kv_blocks=3) as eng:
+            # needs ceil(20/8) = 3 blocks; pool holds 2 usable.
+            with pytest.raises(ValueError, match="KV blocks"):
+                eng.submit(np.arange(1, 11), 10)
+            # A fitting request behind it is unaffected.
+            r = eng.submit(np.arange(1, 7), 6).result(timeout=300)
+            assert len(r.tokens) == 6
+
+    def test_warmup_precompiles_paged_hot_path(self, lm):
+        """warmup=True on a paged engine: no compile in the serving
+        window, same guarantee as the fixed pool."""
+        model, params = lm
+        with ServingEngine(model, params, num_slots=2, max_queue=16,
+                           warmup=True, paged=True,
+                           kv_block_size=BS) as eng:
+            hs = [eng.submit(p, 6) for p in _prompts(4, seed=13)]
+            for h in hs:
+                h.result(timeout=300)
+            snap = eng.metrics_snapshot()
+        assert snap["compiles"] == 0, snap["compiles"]
+        assert snap["warmup_compiles"] >= 3
+
+    def test_kv_gauges_reported(self, lm):
+        model, params = lm
+        with ServingEngine(model, params, num_slots=2, max_queue=8,
+                           paged=True, kv_block_size=BS) as eng:
+            eng.submit(_prompts(1, seed=80)[0], 4).result(timeout=300)
+            _wait_gauges(eng)
+            snap = eng.metrics_snapshot()
+        assert snap["kv_blocks_free"] is not None
+        assert (snap["kv_blocks_free"] + snap["kv_blocks_used"]
+                + snap["kv_blocks_cached"]
+                == eng.pool.num_blocks - 1)
+
+    def test_env_knobs_reach_engine(self, lm, monkeypatch):
+        from horovod_tpu.runtime.config import config
+        monkeypatch.setenv("HVD_KV_BLOCK_SIZE", str(BS))
+        monkeypatch.setenv("HVD_KV_BLOCKS", "9")
+        monkeypatch.setenv("HVD_PREFIX_CACHE", "0")
+        config.refresh()
+        try:
+            model, params = lm
+            eng = ServingEngine(model, params, num_slots=2,
+                                paged=True)
+            assert eng.pool.block_size == BS
+            assert eng.pool.num_blocks == 9
+            assert not eng.pool.blocks.prefix_cache
+            eng.shutdown()
+        finally:
+            for k in ("HVD_KV_BLOCK_SIZE", "HVD_KV_BLOCKS",
+                      "HVD_PREFIX_CACHE"):
+                monkeypatch.delenv(k)
+            config.refresh()
+
+
+def _wait_gauges(eng, timeout=30.0):
+    """The dispatch loop publishes KV gauges once per iteration; give
+    it a beat after the last retire."""
+    t0 = time.time()
+    while (eng.metrics_snapshot()["kv_blocks_free"] is None
+           and time.time() - t0 < timeout):
+        time.sleep(0.01)

@@ -253,22 +253,31 @@ class TestAdmission:
             c.future.result(timeout=0)
         assert metrics.timed_out == 1 and metrics.cancelled == 1
 
-    def test_idle_slot_fill_index_bounded(self, lm):
-        """A never-allocated free slot rides the shared tick but its
-        fill index must stay bounded (periodic idle reset) — the
-        vmapped prefix-attention loop runs to the MAX lane's trip
-        count, so unbounded creep would tax every active slot
-        forever."""
-        from horovod_tpu.serving.slots import RESET_IDLE_TICKS, SlotPool
+    def test_idle_slot_fill_index_frozen(self, lm):
+        """A never-allocated free slot rides the shared vmapped tick
+        but its fill index must stay FROZEN at 0 (the PR-3 live mask;
+        the vmapped prefix-attention loop runs to the MAX lane's trip
+        count, so any creep would tax every active slot). The old
+        periodic-idle-reset machinery is gone — its RESET_IDLE_TICKS
+        ceiling survives only as a deprecation shim."""
+        from horovod_tpu.serving.slots import SlotPool
         model, params = lm
         pool = SlotPool(model, params, 2)
         slot = pool.alloc()
         pool.prefill(slot, np.array([5, 9]), 0.0, None, 0)
-        for _ in range(RESET_IDLE_TICKS + 16):
+        for _ in range(80):
             pool.tick()
         fills = pool.fill_indices()
-        free_slot = 1 - slot
-        assert fills[free_slot] <= RESET_IDLE_TICKS + 1, fills
+        assert fills[1 - slot] == 0, fills
+
+    def test_reset_idle_ticks_shim_warns(self, hvd):
+        """Importing the obsoleted constant still works (deprecation
+        shim) but warns; anything else raises AttributeError."""
+        import horovod_tpu.serving.slots as slots_mod
+        with pytest.warns(DeprecationWarning, match="RESET_IDLE_TICKS"):
+            assert slots_mod.RESET_IDLE_TICKS == 64
+        with pytest.raises(AttributeError):
+            slots_mod.NOT_A_REAL_NAME
 
     def test_cancel_frees_slot_for_next_request(self, lm):
         """Cancelling a running request retires it at the next tick;
