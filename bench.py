@@ -529,13 +529,15 @@ def _tpot_histogram(results):
 
 def _serve_rate(model, params, args, prompts, rate, *,
                 pipeline_depth, prefill_chunk_budget, chaos_mode,
-                log, paged_cfg=None):
+                log, paged_cfg=None, slo_spec=None):
     """One open-loop Poisson rate point through a fresh (pre-warmed)
     engine; returns the per-rate record. ``pipeline_depth`` /
     ``prefill_chunk_budget`` parameterize the hot path so the same
     harness measures the PR-3 pipeline and its PR-1-shaped control;
     ``paged_cfg`` (num_slots/kv_blocks/kv_block_size) switches the
-    engine to the paged KV cache for the PR-7 paged-vs-fixed A/B."""
+    engine to the paged KV cache for the PR-7 paged-vs-fixed A/B;
+    ``slo_spec`` attaches a burn-rate SLO monitor (obs/slo.py) whose
+    summary lands in the record's ``slo`` block."""
     import numpy as np
 
     from horovod_tpu.serving import ServingEngine
@@ -547,6 +549,11 @@ def _serve_rate(model, params, args, prompts, rate, *,
     if paged_cfg:
         kw = dict(paged=True, kv_blocks=paged_cfg["kv_blocks"],
                   kv_block_size=paged_cfg["kv_block_size"])
+    slo_mon = None
+    if slo_spec:
+        from horovod_tpu.obs.slo import SLOMonitor
+        slo_mon = SLOMonitor.from_spec(slo_spec)
+        kw["slo"] = slo_mon
     if chaos_mode:
         from horovod_tpu.resilience import chaos as chaos_mod
     gaps = np.random.RandomState(7).exponential(1.0 / rate, size=n_req)
@@ -599,6 +606,14 @@ def _serve_rate(model, params, args, prompts, rate, *,
         "peak_active": snap["peak_active"],
         "num_slots": S,
     }
+    if slo_mon is not None:
+        # Burn-rate view of the same window (obs/slo.py): objectives,
+        # fast/slow burn per objective, and whether anything breached.
+        rec["slo"] = slo_mon.summary()
+        burns = {n: b["fast"]
+                 for n, b in rec["slo"]["burn_rates"].items()}
+        log(f"serving rate={rate}/s slo: fast burns {burns}, "
+            f"breaches={rec['slo']['breach_count']}")
     if paged_cfg:
         cold = [r.ttft_s for r in results
                 if r.prefix_tokens_cached == 0]
@@ -859,13 +874,15 @@ def run_serving(args, devices, n_chips, log):
 
     depth = args.serving_pipeline_depth
     budget = args.prefill_chunk_budget
+    slo_spec = getattr(args, "serving_slo", "") or None
     per_rate = {}
     best_tok_s = 0.0
     for rate in rates:
         rec = _serve_rate(model, params, args, prompts, rate,
                           pipeline_depth=depth,
                           prefill_chunk_budget=budget,
-                          chaos_mode=chaos_mode, log=log)
+                          chaos_mode=chaos_mode, log=log,
+                          slo_spec=slo_spec)
         best_tok_s = max(best_tok_s, rec["tok_s"])
         per_rate[str(rate)] = rec
     out = {"tok_s_chip": best_tok_s, "n_params": n_params,
@@ -877,6 +894,11 @@ def run_serving(args, devices, n_chips, log):
            # (event log + Timeline span args + metric exemplar).
            "trace_check": _serving_trace_check(
                model, params, args, prompts, log)}
+    if slo_spec:
+        # The artifact's headline SLO block: the highest rate point's
+        # objectives / burn rates / breach count — the load level
+        # where the burn rates are most informative.
+        out["slo"] = per_rate[str(max(rates))].get("slo")
     if args.serving_ab and not chaos_mode:
         # In-artifact A/B at the highest rate: the PR-1-shaped hot
         # path (synchronous ticks, whole-prompt prefill) vs the PR-3
@@ -1252,6 +1274,18 @@ def main():
                     help="serving: paged-KV block size in tokens for "
                          "the paged A/B leg (HVD_KV_BLOCK_SIZE "
                          "parity)")
+    ap.add_argument("--serving-slo",
+                    default="ttft=30,tpot=5,shed=0.1,target=0.9,"
+                            "fast=5,slow=60,burn=5",
+                    metavar="SPEC",
+                    help="serving: SLO objective spec (HVD_SLO "
+                         "grammar) evaluated per rate point; the "
+                         "artifact's `slo` block records objectives, "
+                         "burn rates and the breach count (default "
+                         "thresholds generous enough to stay green "
+                         "on the CPU proxy, with burn=5 so a breach "
+                         "stays REACHABLE at the 0.1 budgets; empty "
+                         "string disables)")
     ap.add_argument("--out", default=None, metavar="PATH",
                     help="also write the final result JSON to PATH "
                          "(e.g. BENCH_serving_pr3.json)")
@@ -1744,6 +1778,10 @@ def _bench_body(args, devices, n_chips, metric, unit,
             "trace_check": r["trace_check"],
             "arch": args.arch,
         }
+        if "slo" in r:
+            # The SLO acceptance block (obs/slo.py): objectives, burn
+            # rates, breach count at the highest rate point.
+            result["slo"] = r["slo"]
         if "pipeline_ab" in r:
             result["pipeline_ab"] = r["pipeline_ab"]
         if "paged_ab" in r:

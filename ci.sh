@@ -62,6 +62,26 @@ JAX_PLATFORMS=cpu python -m pytest -q --collect-only \
 JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4 \
     --warmup --interleave-check --obs-check --prefix-check
 
+# Fleet-observability smoke (docs/observability.md "Fleet view" /
+# "Flight recorder"): on a 2-engine host, one /fleet scrape must show
+# the fleet-merged hvd_fleet_* histograms (both engines' requests
+# pooled) and hvd_rank_skew_* gauges; then the env-armed chaos fault
+# (serving_dispatch_crash, deferred by the example until a request is
+# in flight) must be healed by the watchdog AND leave a
+# flight-recorder bundle in HVD_FLIGHT_DIR whose pretty-printer
+# output names the ring's newest event and the crashed request's
+# trace_id — the end-to-end post-mortem proof. The module CLI is then
+# exercised on the bundle directly. hvdlint above already proves the
+# new obs modules (aggregate/straggler/flightrec/slo) sit on the
+# EMPTY baseline.
+rm -rf /tmp/hvd_fleet_smoke
+HVD_CHAOS=serving_dispatch_crash:1 HVD_FLIGHT_DIR=/tmp/hvd_fleet_smoke \
+    JAX_PLATFORMS=cpu python examples/transformer_serving.py \
+    --requests 2 --fleet-check
+JAX_PLATFORMS=cpu python -m horovod_tpu.obs.flightrec \
+    "$(ls /tmp/hvd_fleet_smoke/flight_*.json | tail -1)" \
+    | grep -q "trace_id="
+
 # Resume smoke (docs/resilience.md "Exact resume"): a short training
 # run over a sharded shuffled dataset is killed mid-epoch AND
 # mid-checkpoint-save via HVD_CHAOS, restarted with full TrainSnapshot

@@ -34,10 +34,20 @@ snapshot. Two extra CI legs exercise the PR-3 hot-path guarantees:
   (its prefix was served from resident blocks) with TTFT strictly
   below the cold request's, and both must stay token-exact vs
   sequential generate.
+* ``--fleet-check`` is the fleet-observability smoke
+  (docs/observability.md "Fleet view" / "Flight recorder"): with TWO
+  live engines, one ``/fleet`` scrape must show the merged
+  ``hvd_fleet_*`` histograms and ``hvd_rank_skew_*`` gauges; then a
+  chaos fault (the env-armed ``HVD_CHAOS`` spec — e.g.
+  ``serving_dispatch_crash:1`` in ci.sh — deferred until requests
+  are in flight, or a default ``serving_tick_stall``) must leave a
+  flight-recorder bundle in ``HVD_FLIGHT_DIR`` whose pretty-printer
+  output names both the ring's newest event and an in-flight
+  request's trace_id.
 
 Run:  python examples/transformer_serving.py --requests 4 \
           [--warmup] [--interleave-check] [--obs-check] \
-          [--prefix-check]
+          [--prefix-check] [--fleet-check]
 """
 
 import argparse
@@ -210,6 +220,110 @@ def prefix_check(model, params, repeats=3):
         f"{best_cold * 1e3:.2f} ms — prefix skip not paying?")
 
 
+def fleet_check(model, params, deferred_monkey=None):
+    """The CI fleet-observability smoke: merged cross-rank view plus
+    the end-to-end post-mortem path.
+
+    1. TWO engines serve requests in one process; a ``/fleet`` scrape
+       must show the fleet-merged histograms (``hvd_fleet_*``) with
+       BOTH engines' requests pooled, plus ``hvd_rank_skew_*``.
+    2. A chaos fault fires while a request is in flight (the
+       env-armed ``HVD_CHAOS`` monkey handed in via
+       ``deferred_monkey`` — ci.sh arms ``serving_dispatch_crash:1``
+       — or a default ``serving_tick_stall``); the self-healing
+       engine recovers, and the flight-recorder bundle written to
+       ``HVD_FLIGHT_DIR`` must (a) exist, (b) carry the in-flight
+       request's trace_id and a metric snapshot, and (c) render both
+       the ring's newest event and that trace_id through the
+       ``python -m horovod_tpu.obs.flightrec`` pretty-printer.
+    """
+    import re
+    import tempfile
+    import time
+    import urllib.request
+
+    from horovod_tpu import obs
+    from horovod_tpu.obs import flightrec
+    from horovod_tpu.resilience import chaos
+
+    flight_dir = os.environ.get("HVD_FLIGHT_DIR") or tempfile.mkdtemp(
+        prefix="hvd_flight_smoke_")
+    os.environ["HVD_FLIGHT_DIR"] = flight_dir
+    srv = obs.start_exporter(port=0)
+    monkey = deferred_monkey
+    if monkey is None:
+        monkey = chaos.ChaosMonkey("serving_tick_stall:1:delay=2")
+    eng_a = ServingEngine(model, params, num_slots=2, warmup=True)
+    eng_b = ServingEngine(model, params, num_slots=2, warmup=True,
+                          auto_restart=True, max_restarts=4,
+                          tick_deadline_s=0.5)
+    try:
+        # Leg 1: both engines serve; the fleet view pools them.
+        for h in ([eng_a.submit(np.array([3 + i, 5, 7]), 6)
+                   for i in range(3)]
+                  + [eng_b.submit(np.array([9 + i, 2]), 6)
+                     for i in range(3)]):
+            h.result(timeout=600)
+        fleet_text = urllib.request.urlopen(
+            srv.url + "/fleet", timeout=30).read().decode()
+        m = re.search(r'hvd_fleet_serving_ttft_seconds_bucket'
+                      r'\{le="\+Inf"\} (\d+)', fleet_text)
+        assert m and int(m.group(1)) >= 6, (
+            "fleet-merged TTFT histogram missing both engines' "
+            "requests", m and m.group(0))
+        assert "hvd_rank_skew_" in fleet_text, "skew gauges missing"
+        fleet_json = json.loads(urllib.request.urlopen(
+            srv.url + "/fleet.json", timeout=30).read())
+        assert fleet_json["ranks_failed"] == []
+        # Leg 2: the post-mortem path, on eng_b ONLY (eng_a has no
+        # watchdog and would contain on a dispatch crash — shut it
+        # down before arming so the single-count fault cannot land
+        # there).
+        eng_a.shutdown()
+        victim = eng_b.submit(np.arange(2, 18) % 128, 48)
+        deadline = time.time() + 30
+        while eng_b.pool.busy_slots == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        n_before = len(flightrec.list_bundles(flight_dir))
+        chaos.install(monkey)   # the deferred HVD_CHAOS spec, armed NOW
+        while (len(flightrec.list_bundles(flight_dir)) <= n_before
+               and time.time() < deadline):
+            time.sleep(0.05)
+        out = victim.result(timeout=600)   # recovery replayed it
+        bundles = flightrec.list_bundles(flight_dir)
+        assert len(bundles) > n_before, (
+            "chaos fault produced no flight-recorder bundle",
+            flight_dir)
+        bundle = flightrec.load(bundles[-1])
+        assert "hvd_serving_ttft_seconds" in bundle["metrics"]
+        inflight_ids = {st.get("trace_id")
+                        for states in bundle["inflight"].values()
+                        if isinstance(states, list) for st in states}
+        assert victim.trace_id in inflight_ids, (
+            "crashed request's trace_id missing from the bundle",
+            bundle["reason"], sorted(inflight_ids))
+        rendered = flightrec.describe(bundle)
+        newest = bundle["events"][-1]
+        assert f"#{newest['seq']} {newest['kind']}" in rendered, (
+            "newest ring event missing from the pretty-printer",
+            newest)
+        assert victim.trace_id in rendered, (
+            "in-flight trace_id missing from the pretty-printer")
+        snap = eng_b.metrics_snapshot()
+        print(f"fleet check OK: /fleet merged {int(m.group(1))} "
+              f"requests across 2 engines; {len(bundles)} flight "
+              f"bundle(s) in {flight_dir} (newest: "
+              f"{bundle['reason']}), trace {victim.trace_id} "
+              f"recovered end-to-end "
+              f"({snap['restarts']} restart(s), "
+              f"{len(out.tokens)} tokens after replay)")
+    finally:
+        chaos.install(None)
+        eng_a.shutdown()
+        eng_b.shutdown()
+        obs.stop_exporter()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=4)
@@ -232,9 +346,27 @@ def main():
                          "system prompt must skip its prefix's "
                          "prefill and beat the cold TTFT "
                          "(docs/serving.md 'Paged KV cache')")
+    ap.add_argument("--fleet-check", action="store_true",
+                    help="fleet-observability smoke: /fleet must "
+                         "merge 2 engines' histograms, and a chaos "
+                         "fault must leave a flight-recorder bundle "
+                         "whose pretty-printed output names the "
+                         "newest event and an in-flight trace_id "
+                         "(docs/observability.md)")
     ap.add_argument("--prefill-chunk-budget", type=int, default=8,
                     help="prompt tokens streamed per scheduler step")
     args = ap.parse_args()
+
+    deferred_monkey = None
+    if args.fleet_check:
+        # Defer an env-armed HVD_CHAOS spec (ci.sh arms
+        # serving_dispatch_crash:1) until the fleet check has
+        # requests in flight — armed at import it would fire on the
+        # FIRST engine's dispatch loop, before any request exists,
+        # and the bundle would have nothing in flight to prove.
+        from horovod_tpu.resilience import chaos as _chaos
+        deferred_monkey = _chaos.active()
+        _chaos.install(None)
 
     model = TransformerLM(vocab_size=128, num_layers=2, num_heads=4,
                           head_dim=16, max_len=64, dtype=jnp.float32)
@@ -280,6 +412,8 @@ def main():
         obs_check(model, params)
     if args.prefix_check:
         prefix_check(model, params)
+    if args.fleet_check:
+        fleet_check(model, params, deferred_monkey)
 
 
 if __name__ == "__main__":

@@ -160,13 +160,26 @@ def _obs_step(step_fn, *, tokens_per_step=None, flops_per_step=None,
     MFU gauges (obs/profiling.StepProfiler). Failed steps (a chaos
     `step_exception`, a real fault) are NOT recorded — the cadence
     histogram is the healthy-step distribution."""
+    import time as _time
+
+    from horovod_tpu.obs import straggler as _straggler
     from horovod_tpu.obs.profiling import StepProfiler
     prof = StepProfiler(name, tokens_per_step=tokens_per_step,
                         flops_per_step=flops_per_step)
 
     def stepped(state, batch, rng):
+        t_enter = _time.time()
         with prof.step():
-            return step_fn(state, batch, rng)
+            out = step_fn(state, batch, rng)
+        # The fusion-buffer cycle's straggler leg (obs/straggler.py):
+        # each step hosts one bucketed-allreduce cycle, and its
+        # host-side enter/exit pair is the per-rank timestamp the
+        # cross-rank skew report is built from. Failed steps (the
+        # chaos step_exception above raised) are skipped, like the
+        # cadence histogram.
+        _straggler.tracker().record("fusion_cycle",
+                                    _time.time() - t_enter)
+        return out
 
     stepped.__wrapped__ = getattr(step_fn, "__wrapped__", step_fn)
     stepped.__obs_profiler__ = prof

@@ -181,12 +181,62 @@ def training_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
 
 def collective_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
     """Eager-collective dispatch counts by op (SPMD in-graph
-    collectives are compiled away and invisible to the host)."""
+    collectives are compiled away and invisible to the host), plus the
+    straggler-attribution family (obs/straggler.py): per-exchange
+    cross-rank skew of host-side dispatch time and the rank it
+    accuses."""
     reg = reg or registry()
     return {
         "dispatched": reg.counter(
             "hvd_collectives_total",
             "Eager collective dispatches by op", ("op",)),
+        "skew": reg.histogram(
+            "hvd_collective_skew_seconds",
+            "Cross-rank skew of mean collective/fusion-cycle dispatch "
+            "time per straggler exchange (slowest rank's mean minus "
+            "fastest's; obs/straggler.py)"),
+        "straggler_rank": reg.gauge(
+            "hvd_collective_straggler_rank",
+            "Slowest rank in the newest straggler exchange (reads 0 "
+            "before any exchange — gate on "
+            "hvd_collective_exchanges_total)"),
+        "exchanges": reg.counter(
+            "hvd_collective_exchanges_total",
+            "Straggler timing-window exchanges completed "
+            "(every HVD_STRAGGLER_CYCLES dispatches)"),
+    }
+
+
+def slo_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """The SLO plane (obs/slo.py): multi-window burn rates per
+    objective and the breach transitions that flip /healthz."""
+    reg = reg or registry()
+    return {
+        "burn_rate": reg.gauge(
+            "hvd_slo_burn_rate",
+            "Error-budget burn rate per objective and window (1.0 = "
+            "burning exactly the budget; >= the configured threshold "
+            "on BOTH windows = fast burn)", ("objective", "window")),
+        "breaching": reg.gauge(
+            "hvd_slo_breaching",
+            "1 while the objective is fast-burning (both windows over "
+            "the burn threshold); /healthz reads 503 meanwhile",
+            ("objective",)),
+        "breaches": reg.counter(
+            "hvd_slo_breaches_total",
+            "Fast-burn breach TRANSITIONS per objective (entering "
+            "breach, not per evaluation)", ("objective",)),
+    }
+
+
+def flight_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """The crash flight recorder's own accounting (obs/flightrec.py)."""
+    reg = reg or registry()
+    return {
+        "bundles": reg.counter(
+            "hvd_flightrec_bundles_total",
+            "Flight-recorder bundles written to HVD_FLIGHT_DIR, by "
+            "trigger reason", ("reason",)),
     }
 
 
@@ -211,5 +261,7 @@ def declare_standard_metrics(
         "resilience": resilience_metrics(reg),
         "training": training_metrics(reg),
         "collectives": collective_metrics(reg),
+        "slo": slo_metrics(reg),
+        "flightrec": flight_metrics(reg),
         "events": event_metrics(reg),
     }

@@ -10,11 +10,13 @@ fields (``trace_id`` whenever the event belongs to a request, the
 tracing leg of docs/observability.md).
 
 Bounded on BOTH sides: the in-memory ring keeps the newest ``maxlen``
-events for `/metrics.json` / `tail()`, and the JSONL file (enabled by
-``HVD_EVENTS_LOG=/path``) rotates once past ``max_bytes`` (one ``.1``
-generation) so an incident log can never fill a disk. File faults
-warn-and-disable, the Timeline's contract: observability must never
-cost the workload.
+events for `/metrics.json` / `tail()` / the flight recorder's bundle
+(``maxlen`` defaults to the ``HVD_EVENTS_RING`` knob, 2048 — size it
+to how much run-up a post-mortem should capture), and the JSONL file
+(enabled by ``HVD_EVENTS_LOG=/path``) rotates once past ``max_bytes``
+(one ``.1`` generation) so an incident log can never fill a disk.
+File faults warn-and-disable, the Timeline's contract: observability
+must never cost the workload.
 """
 
 from __future__ import annotations
@@ -32,10 +34,23 @@ from horovod_tpu.obs import catalog
 __all__ = ["EventLog", "emit", "tail", "get", "configure"]
 
 
+DEFAULT_RING = 2048
+
+
+def _ring_capacity() -> int:
+    """The in-memory ring size: the registered ``HVD_EVENTS_RING``
+    knob (floor 1 — a zero/negative value must not silently create an
+    unbounded deque)."""
+    from horovod_tpu.runtime.config import env_int
+    return max(1, env_int("HVD_EVENTS_RING", DEFAULT_RING))
+
+
 class EventLog:
     def __init__(self, path: Optional[str] = None, *,
-                 maxlen: int = 2048,
+                 maxlen: Optional[int] = None,
                  max_bytes: int = 8 * 1024 * 1024):
+        if maxlen is None:
+            maxlen = _ring_capacity()
         self._lock = threading.Lock()
         self._ring: collections.deque = collections.deque(maxlen=maxlen)
         self._seq = 0
@@ -130,7 +145,8 @@ def get() -> EventLog:
         return _LOG
 
 
-def configure(path: Optional[str] = None, *, maxlen: int = 2048,
+def configure(path: Optional[str] = None, *,
+              maxlen: Optional[int] = None,
               max_bytes: int = 8 * 1024 * 1024) -> EventLog:
     """Install a fresh global log (programmatic twin of
     ``HVD_EVENTS_LOG``; bench and tests point it at a temp file).

@@ -9,10 +9,23 @@ rule as the rest of the repo) serving three endpoints:
   ``curl`` reads.
 * ``/metrics.json`` — the registry's full JSON snapshot (histogram
   quantile estimates + exemplars included) plus the newest structured
-  events; what `bench.py` and humans read.
+  events, this process's rank and its collective timing window; what
+  `bench.py`, the fleet aggregator and humans read.
 * ``/healthz`` — liveness + the registered health providers (the
   serving engine reports its dispatch generation here, so a prober
-  can tell an in-place watchdog restart from a process restart).
+  can tell an in-place watchdog restart from a process restart; an
+  SLO monitor in fast burn reads ``healthy: false`` and degrades it).
+* ``/fleet`` / ``/fleet.json`` — the cross-rank aggregated view
+  (`obs.aggregate`): fleet-merged histograms (``hvd_fleet_*``),
+  per-metric cross-rank skew gauges (``hvd_rank_skew_*``) and the
+  collective straggler report.
+
+``/metrics`` additionally speaks OpenMetrics when the scraper asks
+(``Accept: application/openmetrics-text`` or ``?exemplars=1``):
+histogram ``_bucket`` lines then carry their exemplar (the last
+observation's ``trace_id``) in the ``# {...} value ts`` syntax, and
+the exposition ends with ``# EOF``. The classic 0.0.4 text format —
+what an un-negotiated scrape gets — is byte-identical to before.
 
 Enable with ``HVD_METRICS_PORT`` (0 = ephemeral, the CI smoke's
 choice) or programmatically via `start_exporter(port=...)`.
@@ -33,6 +46,8 @@ __all__ = ["render_prometheus", "MetricsServer", "start_exporter",
            "stop_exporter"]
 
 CONTENT_TYPE_PROM = "text/plain; version=0.0.4; charset=utf-8"
+CONTENT_TYPE_OPENMETRICS = ("application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8")
 
 
 def _escape_help(s: str) -> str:
@@ -65,26 +80,75 @@ def _labels_str(labels: dict, extra: Optional[dict] = None) -> str:
     return "{" + body + "}"
 
 
-def render_prometheus(reg: Optional[MetricRegistry] = None) -> str:
-    """The registry in Prometheus text exposition format 0.0.4."""
+def _exemplar_suffix(exemplar: Optional[dict]) -> str:
+    """The OpenMetrics exemplar tail for one bucket line:
+    `` # {trace_id="..."} value ts``. Empty for no exemplar."""
+    if not exemplar or "value" not in exemplar:
+        return ""
+    labels = {k: v for k, v in exemplar.items()
+              if k not in ("value", "ts")}
+    body = ",".join(f'{k}="{_escape_label(str(v))}"'
+                    for k, v in sorted(labels.items()))
+    out = f" # {{{body}}} {_fmt(exemplar['value'])}"
+    if "ts" in exemplar:
+        out += f" {_fmt(exemplar['ts'])}"
+    return out
+
+
+def render_prometheus(reg: Optional[MetricRegistry] = None, *,
+                      exemplars: bool = False) -> str:
+    """The registry in Prometheus text exposition format 0.0.4.
+
+    ``exemplars=True`` is the OpenMetrics flavor: each histogram
+    child's stored exemplar (the last observation's trace context —
+    the metrics leg of request tracing) rides the ``_bucket`` line
+    whose range contains it, and the exposition closes with
+    ``# EOF``. Off by default — classic 0.0.4 scrapers reject the
+    exemplar syntax."""
     reg = reg or registry()
     lines = []
     for m in reg.collect():
-        lines.append(f"# HELP {m.name} {_escape_help(m.doc)}")
-        lines.append(f"# TYPE {m.name} {m.kind}")
+        # OpenMetrics names a counter FAMILY without the _total
+        # suffix (samples keep it): '# TYPE x counter' + 'x_total 5'.
+        # Emitting the 0.0.4 shape ('# TYPE x_total counter') under
+        # the OpenMetrics content type makes a stock Prometheus —
+        # which negotiates OpenMetrics by default — reject the whole
+        # scrape on the family/sample name mismatch.
+        fam = m.name
+        if (exemplars and m.kind == "counter"
+                and fam.endswith("_total")):
+            fam = fam[:-len("_total")]
+        lines.append(f"# HELP {fam} {_escape_help(m.doc)}")
+        lines.append(f"# TYPE {fam} {m.kind}")
         for labels, child in m.samples():
             if m.kind == "histogram":
+                ex = child.exemplar if exemplars else None
+                ex_i = None
+                if ex is not None and "value" in ex:
+                    # The bucket the exemplar's value falls in — the
+                    # only line OpenMetrics allows it on.
+                    v = float(ex["value"])
+                    ex_i = len(m.buckets)
+                    for i, edge in enumerate(m.buckets):
+                        if v <= edge:
+                            ex_i = i
+                            break
                 cum = 0
                 for i, edge in enumerate(m.buckets):
                     cum += child.counts[i]
+                    suffix = (_exemplar_suffix(ex)
+                              if ex_i == i else "")
                     lines.append(
                         f"{m.name}_bucket"
                         f"{_labels_str(labels, {'le': _fmt(edge)})} "
-                        f"{cum}")
+                        f"{cum}{suffix}")
                 cum += child.counts[len(m.buckets)]
+                suffix = (_exemplar_suffix(ex)
+                          if ex_i == len(m.buckets) else "")
                 lines.append(
                     f"{m.name}_bucket"
-                    f"{_labels_str(labels, {'le': '+Inf'})} {cum}")
+                    f"{_labels_str(labels, {'le': '+Inf'})} "
+                    f"{cum}{suffix}")
                 lines.append(f"{m.name}_sum{_labels_str(labels)} "
                              f"{_fmt(child.sum)}")
                 lines.append(f"{m.name}_count{_labels_str(labels)} "
@@ -92,6 +156,8 @@ def render_prometheus(reg: Optional[MetricRegistry] = None) -> str:
             else:
                 lines.append(
                     f"{m.name}{_labels_str(labels)} {_fmt(child)}")
+    if exemplars:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
 
@@ -124,18 +190,44 @@ class MetricsServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 if path == "/metrics":
+                    # OpenMetrics (exemplars on _bucket lines, # EOF
+                    # terminator) only when the scraper negotiates it
+                    # — classic 0.0.4 consumers reject the syntax.
+                    om = ("application/openmetrics-text"
+                          in (self.headers.get("Accept") or "")
+                          or "exemplars=1" in query)
                     body = render_prometheus(
-                        server_ref.registry).encode()
-                    self._send(200, body, CONTENT_TYPE_PROM)
+                        server_ref.registry, exemplars=om).encode()
+                    self._send(200, body,
+                               CONTENT_TYPE_OPENMETRICS if om
+                               else CONTENT_TYPE_PROM)
                 elif path == "/metrics.json":
                     from horovod_tpu.obs import events
+                    from horovod_tpu.obs import straggler
+                    tr = straggler.tracker()
                     body = json.dumps({
+                        # The fleet aggregator's pull shape
+                        # (obs/aggregate.rank_snapshot over HTTP).
+                        "rank": tr.rank,
                         "metrics": server_ref.registry.to_json(),
+                        "collectives": tr.window_snapshot(),
                         "events": events.tail(100),
                     }, default=repr).encode()
                     self._send(200, body, "application/json")
+                elif path in ("/fleet", "/fleet.json"):
+                    from horovod_tpu.obs import aggregate
+                    snap = aggregate.default_aggregator().collect()
+                    if path == "/fleet":
+                        self._send(200,
+                                   snap.render_prometheus().encode(),
+                                   CONTENT_TYPE_PROM)
+                    else:
+                        self._send(200,
+                                   json.dumps(snap.to_json(),
+                                              default=repr).encode(),
+                                   "application/json")
                 elif path in ("/healthz", "/health"):
                     health = server_ref.registry.health()
                     body = json.dumps(health, default=repr).encode()

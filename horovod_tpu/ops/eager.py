@@ -400,6 +400,19 @@ def _collectives_counter():
     return _COLLECTIVES_COUNTER
 
 
+# Same caching rule for the straggler tracker's module (the tracker
+# itself may be swapped by tests — resolve it per dispatch, cheaply).
+_STRAGGLER_MOD = None
+
+
+def _straggler():
+    global _STRAGGLER_MOD
+    if _STRAGGLER_MOD is None:
+        from horovod_tpu.obs import straggler
+        _STRAGGLER_MOD = straggler
+    return _STRAGGLER_MOD
+
+
 def _run_collective(st, key, fn, data, *, mesh=None, in_specs=None,
                     out_specs=None):
     """Dispatch a cached shard_map'd collective over the framework mesh
@@ -411,7 +424,15 @@ def _run_collective(st, key, fn, data, *, mesh=None, in_specs=None,
     `data` is either a host [world, ...] stack (single-controller) or an
     already-placed global jax.Array (multi-controller).
     """
+    import time as _time
+
     from horovod_tpu.resilience import chaos
+    # Straggler attribution (obs/straggler.py): per-dispatch host-side
+    # enter/exit timestamps around the WHOLE dispatch — the chaos
+    # slow-site delay, compile-cache misses and a blocked rendezvous
+    # all land inside the bracket, which is exactly the per-rank skew
+    # the fleet view attributes.
+    t_enter = _time.time()
     # The slow/hung-collective fault at the eager dispatch boundary
     # (the traced twin in ops/collectives.py fires at trace time): the
     # host thread blocks exactly as it would waiting on a dead peer's
@@ -437,7 +458,9 @@ def _run_collective(st, key, fn, data, *, mesh=None, in_specs=None,
         st.op_cache[key] = jitted
     if not isinstance(data, jax.Array):
         data = _shard_over_mesh(st, data)
-    return jitted(data)
+    out = jitted(data)
+    _straggler().tracker().record(key[0], _time.time() - t_enter)
+    return out
 
 
 def allreduce(tensor, average: bool = True, name: Optional[str] = None,

@@ -199,9 +199,15 @@ def _record_fire(site: str):
     zero-overhead-when-disarmed contract of `fires` is untouched."""
     from horovod_tpu.obs import catalog as _obs_catalog
     from horovod_tpu.obs import events as _events
+    from horovod_tpu.obs import flightrec as _flightrec
     _obs_catalog.resilience_metrics()["faults_injected"].inc(
         site=site)
     _events.emit("chaos.fire", site=site)
+    # A chaos fire is an incident by construction — capture the state
+    # the fault lands in (no-op unless HVD_FLIGHT_DIR is set). The
+    # chaos.fire event above is in the ring BEFORE the dump, so the
+    # bundle's newest event names its own trigger.
+    _flightrec.trigger("chaos.fire", site=site)
 
 
 def fires(site: str) -> bool:

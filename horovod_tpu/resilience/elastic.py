@@ -501,6 +501,14 @@ class ElasticTrainer:
         _obs_catalog.resilience_metrics()["rollbacks"].inc()
         _events.emit("training.rollback", step=step, loss=loss,
                      restored_step=int(good_step))
+        # Post-mortem capture (obs/flightrec.py, no-op unless
+        # HVD_FLIGHT_DIR is set): a divergence rollback is exactly the
+        # incident whose run-up (loss stream, chaos fires, step
+        # cadence) the bundle preserves.
+        from horovod_tpu.obs import flightrec as _flightrec
+        _flightrec.trigger("training.rollback", step=step,
+                           loss=float(loss),
+                           restored_step=int(good_step))
         sys.stderr.write(
             f"horovod_tpu: step {step} diverged (loss={loss}); rolled "
             f"back to checkpoint step {good_step} "

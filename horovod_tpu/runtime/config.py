@@ -257,6 +257,36 @@ register_knob(
     "Opt-in jax.profiler trace session directory "
     "(obs.profiling.profiler_session); analyze captures with "
     "utils/profile_analysis.py")
+register_knob(
+    "HVD_EVENTS_RING", "int", "2048", "obs/events.py",
+    "In-memory structured-event ring capacity (the /metrics.json "
+    "tail window and the flight-recorder bundle's run-up depth), "
+    "docs/observability.md")
+register_knob(
+    "HVD_FLIGHT_DIR", "str", "(unset)", "obs/flightrec.py",
+    "Crash flight recorder: dump a post-mortem bundle (event ring + "
+    "metric snapshot + in-flight trace_ids + config) here on watchdog "
+    "restarts, chaos fires, stall trips, NaN rollbacks and dispatch "
+    "crashes; unset disables, docs/observability.md")
+register_knob(
+    "HVD_FLIGHT_KEEP", "int", "8", "obs/flightrec.py",
+    "Flight-recorder retention: newest N bundles kept, oldest pruned "
+    "(0 = keep all)")
+register_knob(
+    "HVD_SLO", "str", "(unset)", "obs/slo.py",
+    "SLO objectives as burn-rate spec, e.g. 'ttft=0.5,tpot=0.1,"
+    "shed=0.02,target=0.99,fast=60,slow=600'; a fast-burn breach "
+    "flips /healthz to 503, docs/observability.md")
+register_knob(
+    "HVD_FLEET_RANKS", "str", "(unset)", "obs/aggregate.py",
+    "Comma-separated per-rank exporter base URLs (host:port) the "
+    "/fleet endpoint aggregates; unset = this process's registry "
+    "alone, docs/observability.md")
+register_knob(
+    "HVD_STRAGGLER_CYCLES", "int", "64", "obs/straggler.py",
+    "Collective dispatches per straggler timing-window exchange "
+    "(0 disables the periodic exchange; windows still accumulate "
+    "for the fleet collector)")
 
 
 # ---------------------------------------------------------------------------

@@ -145,6 +145,14 @@ class AdmissionQueue:
     def __len__(self) -> int:
         return len(self._q)
 
+    def snapshot(self) -> List[Request]:
+        """The queued requests, oldest first — a consistent copy for
+        read-only introspection (the flight recorder's in-flight
+        provider). The Requests themselves stay live; callers must
+        not mutate them."""
+        with self._lock:
+            return list(self._q)
+
     @property
     def closed(self) -> bool:
         return self._closed

@@ -47,6 +47,7 @@ import numpy as np
 
 from horovod_tpu.obs import catalog as _obs_catalog
 from horovod_tpu.obs import events as _events
+from horovod_tpu.obs import flightrec as _flightrec
 from horovod_tpu.obs import tracing as _tracing
 from horovod_tpu.obs.registry import registry as _obs_registry
 from horovod_tpu.resilience import chaos
@@ -190,6 +191,12 @@ class ServingEngine:
         pool at the same num_slots.
     prefix_cache : shared-prefix caching over the paged pool; None
         reads HVD_PREFIX_CACHE (default on). Ignored unless paged.
+    slo : an `obs.slo.SLOMonitor` evaluating this engine's TTFT /
+        TPOT / shed-rate objectives as multi-window burn rates; None
+        reads the ``HVD_SLO`` spec knob (unset = SLO monitoring off).
+        While an objective fast-burns, the monitor's health provider
+        flips ``/healthz`` to 503 (docs/observability.md "SLO
+        monitoring").
     """
 
     def __init__(self, model: TransformerLM, params, *,
@@ -206,7 +213,8 @@ class ServingEngine:
                  paged: bool = False,
                  kv_block_size: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
-                 prefix_cache: Optional[bool] = None):
+                 prefix_cache: Optional[bool] = None,
+                 slo=None):
         if eos_id is not None and not 0 <= eos_id < model.vocab_size:
             raise ValueError(
                 f"eos_id must be in [0, vocab_size={model.vocab_size}"
@@ -217,8 +225,12 @@ class ServingEngine:
         # Process-unique engine number: the /healthz provider key and
         # the `engine` label on the shared engine-scoped gauges.
         self._engine_id = next(_ENGINE_IDS)
+        if slo is None:
+            from horovod_tpu.obs.slo import SLOMonitor
+            slo = SLOMonitor.from_env()
+        self.slo = slo
         self.metrics = EngineMetrics(
-            engine_label=str(self._engine_id))
+            engine_label=str(self._engine_id), slo=slo)
         self.auto_restart = auto_restart
         self.max_restarts = max_restarts
         self.tick_deadline_s = tick_deadline_s
@@ -297,6 +309,18 @@ class ServingEngine:
         self._obs_gen.set(0, engine=str(self._engine_id))
         _obs_registry().register_health(
             f"serving_engine_{self._engine_id}", self._health)
+        # The SLO monitor is its own /healthz component: a fast-burn
+        # breach reads healthy=false there, flipping the endpoint to
+        # 503 while the dispatch thread is still perfectly alive —
+        # "up but missing its objectives" is a drainable state.
+        if self.slo is not None:
+            _obs_registry().register_health(
+                f"serving_slo_{self._engine_id}", self.slo.health)
+        # Flight-recorder in-flight provider (obs/flightrec.py): at
+        # dump time the bundle lists this engine's decoding /
+        # mid-prefill / queued requests with their trace_ids.
+        _flightrec.register_inflight(
+            f"serving_engine_{self._engine_id}", self._inflight_states)
         # Env-gated exporter bring-up (no-op unless HVD_METRICS_PORT
         # is set): a serving process that never calls hvd.init() still
         # honors the knob.
@@ -309,6 +333,35 @@ class ServingEngine:
                 target=self._watchdog_loop, name="serving-watchdog",
                 daemon=True)
             self._watchdog.start()
+
+    def _inflight_states(self) -> list:
+        """Flight-recorder provider: every request this engine
+        currently owes an answer for, with its trace_id — decoding,
+        mid-prefill, and queued. Read WITHOUT the scheduler's locks
+        (dump time may be mid-crash; the recorder contains any racing
+        mutation error, and a slightly torn list beats a deadlocked
+        post-mortem)."""
+        sched = self.scheduler
+        out = []
+
+        def rec(req, phase, slot=None):
+            out.append({
+                "phase": phase, "slot": slot,
+                "request_id": req.id, "trace_id": req.trace_id,
+                "tokens": len(req.tokens),
+                "prompt_tokens": int(req.prompt.shape[0]),
+                "max_new_tokens": req.max_new_tokens,
+                "deadline": req.deadline,
+                "t_submit": req.t_submit,
+            })
+
+        for slot, req in list(sched.active.items()):
+            rec(req, "decode", slot)
+        for slot, job in list(sched.prefilling.items()):
+            rec(job.req, "prefill", slot)
+        for req in self.queue.snapshot():
+            rec(req, "queued")
+        return out
 
     def _health(self) -> dict:
         with self._lock:
@@ -386,6 +439,7 @@ class ServingEngine:
             self.queue.offer(req)
         except QueueFullError:
             self.metrics.count("rejected")
+            self.metrics.observe_admission(False)
             _span("end_span", req.id, "QUEUE")
             _events.emit("serving.shed", request_id=req.id,
                          trace_id=req.trace_id,
@@ -394,6 +448,7 @@ class ServingEngine:
         except EngineClosedError:
             _span("end_span", req.id, "QUEUE")
             raise
+        self.metrics.observe_admission(True)
         _events.emit("serving.submit", request_id=req.id,
                      trace_id=req.trace_id,
                      prompt_tokens=P, max_new_tokens=max_new_tokens)
@@ -477,6 +532,13 @@ class ServingEngine:
             # futures carry the failure to callers).
             with self._lock:
                 self._closing = True
+            # Flight-recorder dump BEFORE the futures are failed: the
+            # unhandled dispatch exception is precisely the incident
+            # whose in-flight trace_ids the post-mortem bundle exists
+            # to preserve (no-op unless HVD_FLIGHT_DIR is set).
+            _flightrec.trigger(
+                "serving.dispatch_crash", engine=self._engine_id,
+                error=repr(e))
             scheduler.fail_inflight(lambda req: EngineClosedError(
                 f"serving dispatch thread died: {e!r}"))
             queue.close(drain=False)  # fails queued futures too
@@ -572,6 +634,16 @@ class ServingEngine:
             generation=epoch, requeued=n,
             failed=len(inflight) - len(requeued),
             requeued_trace_ids=[r.trace_id for r in requeued])
+        # Post-mortem bundle (obs/flightrec.py, no-op unless
+        # HVD_FLIGHT_DIR is set), cut AFTER the requeue and the
+        # restart event: the ring's newest event is the restart
+        # itself, and the re-queued requests — the crash's survivors,
+        # original trace_ids — are captured by the in-flight provider
+        # as "queued".
+        _flightrec.trigger(
+            "serving.restart", engine=self._engine_id, reason=reason,
+            generation=epoch,
+            requeued_trace_ids=[r.trace_id for r in requeued])
         # Fresh device state: the old pool's cache is mid-unknown-
         # tick; compiled programs are shared so this is cheap.
         self.pool = self.pool.clone_fresh()
@@ -599,6 +671,11 @@ class ServingEngine:
         degrade-by-shedding contract)."""
         with self._lock:
             self._closing = True
+        # Dump BEFORE the futures fail: containment is the terminal
+        # incident, and the bundle is the only record of what was in
+        # flight when the engine gave up.
+        _flightrec.trigger("serving.contain",
+                           engine=self._engine_id, reason=why)
         sched = self.scheduler
         for req in sched.abandon():
             sched._resolve(req.future, exc=EngineClosedError(
@@ -659,8 +736,13 @@ class ServingEngine:
         # The engine is gone from /healthz AND its labeled gauge rows
         # leave the registry (idempotent: double shutdown removes
         # missing keys harmlessly) — scrape cardinality tracks live
-        # engines only.
+        # engines only. Same for the SLO component and the
+        # flight-recorder provider.
         _obs_registry().unregister_health(
+            f"serving_engine_{self._engine_id}")
+        _obs_registry().unregister_health(
+            f"serving_slo_{self._engine_id}")
+        _flightrec.unregister_inflight(
             f"serving_engine_{self._engine_id}")
         self.metrics.close()
 

@@ -94,9 +94,23 @@ class EngineMetrics:
     never sees a torn update.
     """
 
-    def __init__(self, engine_label: str = "0"):
+    def __init__(self, engine_label: str = "0", slo=None):
         self._lock = threading.Lock()
         self._t0 = time.time()
+        # Optional obs.slo.SLOMonitor: this class is the single point
+        # every finished request and every shed decision already flows
+        # through, so it is also the SLO feed — TTFT/TPOT latencies
+        # and the admitted-vs-shed stream land in the burn-rate rings
+        # without a second instrumentation site.
+        self._slo = slo
+        # Set by close(): once the engine's labeled gauge rows have
+        # been removed from the shared registry, a dispatch thread
+        # still draining must not re-create them (zombie rows would
+        # defeat the live-engines-only cardinality contract). The
+        # flag is read/flipped and the gauge writes/removals happen
+        # UNDER self._lock, so a write and the close can never
+        # interleave remove-then-set.
+        self._closed = False
         # Monotonic per-snapshot sequence: lets a scraper distinguish
         # an engine RESTART (scrape_seq keeps climbing, uptime_s keeps
         # climbing, engine_generation bumps) from a counter RESET
@@ -194,6 +208,16 @@ class EngineMetrics:
                       "prefix_evictions", "prefill_tokens_skipped"):
             self._obs[name].inc(n)
 
+    def observe_admission(self, admitted: bool):
+        """One admission decision into the SLO shed-rate objective
+        (bad = shed). Called by `submit` AFTER the queue answered, so
+        a shed request contributes exactly one (bad) event — counting
+        from `submitted`/`rejected` would double-count sheds.
+        (record() of an undeclared objective is a no-op, so a
+        ttft-only monitor costs nothing here.)"""
+        if self._slo is not None:
+            self._slo.record("shed", good=admitted)
+
     def observe_peak(self, active: int):
         """High-water mark of concurrently resident sequences."""
         with self._lock:
@@ -202,32 +226,41 @@ class EngineMetrics:
 
     def observe_kv(self, stats: Dict):
         """Fold one paged-pool block-occupancy report into the gauges
-        (engine loop cadence; `stats` = `PagedSlotPool.kv_stats()`)."""
+        (engine loop cadence; `stats` = `PagedSlotPool.kv_stats()`).
+        The shared-registry writes stay under this object's lock so
+        they exclude `close()`'s row removal (see `_closed`)."""
+        eng = self._engine_label
         with self._lock:
             self.kv_blocks_free = stats["blocks_free"]
             self.kv_blocks_used = stats["blocks_used"]
             self.kv_blocks_cached = stats["blocks_cached"]
-        eng = self._engine_label
-        self._obs["kv_blocks_free"].set(stats["blocks_free"],
-                                        engine=eng)
-        self._obs["kv_blocks_used"].set(stats["blocks_used"],
-                                        engine=eng)
-        self._obs["kv_blocks_cached"].set(stats["blocks_cached"],
-                                          engine=eng)
+            if self._closed:
+                return
+            self._obs["kv_blocks_free"].set(stats["blocks_free"],
+                                            engine=eng)
+            self._obs["kv_blocks_used"].set(stats["blocks_used"],
+                                            engine=eng)
+            self._obs["kv_blocks_cached"].set(stats["blocks_cached"],
+                                              engine=eng)
 
     def observe_gauges(self, queue_depth: int, slots_busy: int,
                        num_slots: int):
+        eng = self._engine_label
         with self._lock:
             self.queue_depth = queue_depth
             self.slots_busy = slots_busy
             self.num_slots = num_slots
-        eng = self._engine_label
-        self._obs["queue_depth"].set(queue_depth, engine=eng)
-        self._obs["slots_busy"].set(slots_busy, engine=eng)
-        self._obs["slots_total"].set(num_slots, engine=eng)
-        if num_slots:
-            self._obs["slot_occupancy"].set(slots_busy / num_slots,
-                                            engine=eng)
+            if self._closed:
+                # A dispatch thread draining through shutdown races
+                # close(): its gauge write after the row removal
+                # would resurrect a dead engine's rows on /metrics.
+                return
+            self._obs["queue_depth"].set(queue_depth, engine=eng)
+            self._obs["slots_busy"].set(slots_busy, engine=eng)
+            self._obs["slots_total"].set(num_slots, engine=eng)
+            if num_slots:
+                self._obs["slot_occupancy"].set(
+                    slots_busy / num_slots, engine=eng)
 
     def observe_request(self, *, t_submit: float, t_prefill: float,
                         t_first: float, t_done: float, n_tokens: int,
@@ -250,6 +283,13 @@ class EngineMetrics:
             self._obs["tpot"].observe(
                 (t_done - t_first) / (n_tokens - 1), exemplar=ex)
         self._obs["e2e"].observe(t_done - t_submit, exemplar=ex)
+        if self._slo is not None:
+            # The latency objectives' feed (obs/slo.py): each retired
+            # request is one good/bad event per declared objective.
+            self._slo.record("ttft", t_first - t_submit)
+            if n_tokens > 1:
+                self._slo.record(
+                    "tpot", (t_done - t_first) / (n_tokens - 1))
 
     def close(self):
         """Drop this engine's labeled gauge rows from the shared
@@ -257,13 +297,19 @@ class EngineMetrics:
         must not linger on /metrics forever, and per-engine series
         cardinality must track live engines, not every engine the
         process ever built. Counters/histograms are process-lifetime
-        aggregates and stay."""
+        aggregates and stay. Runs under the lock WITH the `_closed`
+        flip so a concurrent `observe_gauges`/`observe_kv` (the
+        dispatch thread mid-drain) either lands wholly before the
+        removal or is rejected — never remove-then-set (a scrape
+        would see a dead engine's rows forever)."""
         eng = self._engine_label
-        for name in ("queue_depth", "slots_busy", "slots_total",
-                     "slot_occupancy", "engine_generation",
-                     "kv_blocks_free", "kv_blocks_used",
-                     "kv_blocks_cached"):
-            self._obs[name].remove(engine=eng)
+        with self._lock:
+            self._closed = True
+            for name in ("queue_depth", "slots_busy", "slots_total",
+                         "slot_occupancy", "engine_generation",
+                         "kv_blocks_free", "kv_blocks_used",
+                         "kv_blocks_cached"):
+                self._obs[name].remove(engine=eng)
 
     def snapshot(self) -> Dict:
         """One JSON-ready dict: counters, gauges, p50/p95/p99

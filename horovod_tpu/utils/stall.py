@@ -112,12 +112,27 @@ class StallMonitor:
         if stalled:
             from horovod_tpu.obs import catalog as _obs_catalog
             from horovod_tpu.obs import events as _events
+            from horovod_tpu.obs import flightrec as _flightrec
+            from horovod_tpu.obs import straggler as _straggler
             _obs_catalog.resilience_metrics()["stalls"].inc(
                 len(stalled))
+            # The straggler link (obs/straggler.py): a stall warning
+            # arrives with the newest cross-rank attribution attached
+            # — "serving_tick_41 stalled" plus "rank 5 has been 3x
+            # slower than the fleet" is an actionable incident line;
+            # either alone is a mystery.
+            rep = _straggler.last_report()
+            extra = ({"straggler": rep} if rep else {})
             for name in stalled:
                 _events.emit(
                     "stall", op=name,
-                    threshold_s=self._warning_time)
+                    threshold_s=self._warning_time, **extra)
+            # A stall trip is a flight-recorder trigger (no-op unless
+            # HVD_FLIGHT_DIR is set): the bundle captures the pending
+            # ops, the in-flight requests and the metric state the
+            # post-mortem needs.
+            _flightrec.trigger("stall", ops=list(stalled),
+                               threshold_s=self._warning_time)
         return stalled
 
     def _loop(self):

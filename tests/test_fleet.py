@@ -1,0 +1,785 @@
+"""Fleet-observability tests (the fleet PR, docs/observability.md
+"Fleet view" / "Flight recorder" / "SLO monitoring").
+
+Four proof layers:
+
+* **Cross-rank aggregation** — the merge PROPERTY (fleet quantiles
+  from K simulated rank snapshots equal the pooled-stream quantiles,
+  +Inf edge included), counter/gauge skew gauges, source-failure
+  tolerance, and the `/fleet` HTTP endpoint.
+* **Straggler attribution** — per-rank timing windows (slowed via the
+  existing ``collective_slow`` chaos site) merge into a report naming
+  the slow rank; the StallMonitor links the report into stall events.
+* **Flight recorder** — the end-to-end post-mortem: a chaos
+  ``serving_dispatch_crash`` under a watchdog engine must leave a
+  bundle carrying the crashed request's trace_id, the restart event
+  and a metric snapshot, all recoverable through the pretty-printer;
+  plus retention and the CLI.
+* **SLO burn rates** — window math, breach transitions, the spec
+  grammar, and /healthz degradation through a live engine.
+"""
+
+import json
+import math
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.obs import aggregate, catalog, events, flightrec
+from horovod_tpu.obs import slo as slo_mod
+from horovod_tpu.obs import straggler
+from horovod_tpu.obs.exporter import MetricsServer, render_prometheus
+from horovod_tpu.obs.registry import MetricRegistry, registry
+
+
+def _wait(cond, timeout=120.0, dt=0.005):
+    t0 = time.time()
+    while not cond():
+        if time.time() - t0 > timeout:
+            raise AssertionError("condition not reached in time")
+        time.sleep(dt)
+
+
+@pytest.fixture(scope="module")
+def lm(hvd):
+    from horovod_tpu.models.transformer import TransformerLM
+    from horovod_tpu.parallel.tensor import unbox
+    model = TransformerLM(vocab_size=64, num_layers=2, num_heads=4,
+                          head_dim=8, max_len=32, dtype=jnp.float32)
+    params = unbox(model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 16), jnp.int32))["params"])
+    return model, params
+
+
+@pytest.fixture
+def event_log(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = events.EventLog(path)
+    prev = events.install(log)
+    yield log
+    events.install(prev)
+
+
+# ---------------------------------------------------------------------------
+# Cross-rank aggregation
+# ---------------------------------------------------------------------------
+
+def _slow_rank_window(rank: int, slow: bool, n: int = 6):
+    """One simulated rank's collective timing window, the slow rank
+    delayed via the EXISTING collective_slow chaos site — the same
+    fault `dryrun_multichip` scaling drills arm."""
+    from horovod_tpu.resilience import chaos
+    tr = straggler.StragglerTracker(rank=rank, window=0)
+    spec = "collective_slow:-1:delay=0.02" if slow else ""
+    with chaos.armed(spec):
+        for _ in range(n):
+            t0 = time.time()
+            chaos.slow_site("collective_slow")
+            tr.record("allreduce", time.time() - t0 + 1e-4)
+    return tr.window_snapshot()
+
+
+class TestFleetAggregation:
+    def test_merged_quantiles_match_pooled_stream(self):
+        """The merge PROPERTY (satellite): fleet quantiles from K
+        rank snapshots equal the quantiles of the pooled sample
+        stream — exactly, since both sides estimate from the same
+        fixed buckets — including samples past the last edge (the
+        +Inf bucket)."""
+        rs = np.random.RandomState(7)
+        K = 5
+        agg = aggregate.FleetAggregator()
+        pooled = MetricRegistry().histogram(
+            "hvd_serving_ttft_seconds", "pooled oracle")
+        per_rank_samples = []
+        for k in range(K):
+            reg = MetricRegistry()
+            h = reg.histogram("hvd_serving_ttft_seconds", "ttft")
+            xs = list(rs.lognormal(mean=-3 + k, sigma=1.2, size=40))
+            if k % 2 == 0:
+                xs += [500.0, 1e4]     # beyond the last edge -> +Inf
+            for v in xs:
+                h.observe(v)
+                pooled.observe(v)
+            per_rank_samples.append(xs)
+            agg.add_registry(reg, rank=k)
+        snap = agg.collect()
+        merged = snap.registry.get("hvd_fleet_serving_ttft_seconds")
+        assert merged is not None
+        child = merged.samples()[0][1]
+        oracle = pooled.samples()[0][1]
+        assert child.counts == oracle.counts     # +Inf edge included
+        assert child.count == sum(len(xs) for xs in per_rank_samples)
+        assert child.sum == pytest.approx(oracle.sum)
+        for q in (0.25, 0.5, 0.9, 0.99):
+            assert merged.quantile(q) == pytest.approx(
+                pooled.quantile(q))
+        # Per-rank skew gauge populated: rank means differ by
+        # construction (lognormal mean shifts per rank).
+        skew = snap.registry.get("hvd_rank_skew_serving_ttft_seconds")
+        assert skew is not None and skew.value() > 0
+
+    def test_counter_sum_gauge_mean_and_skew(self):
+        agg = aggregate.FleetAggregator()
+        for k, (c, g) in enumerate([(1, 2.0), (2, 4.0), (7, 9.0)]):
+            reg = MetricRegistry()
+            reg.counter("hvd_x_total", "doc").inc(c)
+            reg.gauge("hvd_g", "doc").set(g)
+            agg.add_registry(reg, rank=k)
+        snap = agg.collect()
+        freg = snap.registry
+        assert freg.get("hvd_fleet_x_total").value() == 10
+        assert freg.get("hvd_rank_skew_x_total").value() == 6
+        assert freg.get("hvd_fleet_g").value() == pytest.approx(5.0)
+        assert freg.get("hvd_rank_skew_g").value() == pytest.approx(
+            7.0)
+        assert freg.get("hvd_fleet_ranks").value() == 3
+
+    def test_labeled_families_merge_per_labelset(self):
+        agg = aggregate.FleetAggregator()
+        for k in range(2):
+            reg = MetricRegistry()
+            c = reg.counter("hvd_ev_total", "doc", ("kind",))
+            c.inc(3, kind="a")
+            if k == 0:
+                c.inc(5, kind="b")    # only rank 0 has this labelset
+            agg.add_registry(reg, rank=k)
+        freg = agg.collect().registry
+        assert freg.get("hvd_fleet_ev_total").value(kind="a") == 6
+        assert freg.get("hvd_fleet_ev_total").value(kind="b") == 5
+        assert freg.get("hvd_rank_skew_ev_total").value(kind="a") == 0
+
+    def test_dead_source_costs_only_its_rank(self):
+        reg = MetricRegistry()
+        reg.counter("hvd_x_total", "doc").inc(4)
+        agg = aggregate.FleetAggregator()
+        agg.add_registry(reg, rank=0)
+        # A port nothing listens on: the pull fails, the collect
+        # doesn't.
+        agg.add_endpoint("http://127.0.0.1:9", timeout_s=0.5)
+        snap = agg.collect()
+        assert len(snap.failed) == 1
+        assert snap.registry.get("hvd_fleet_ranks").value() == 1
+        assert snap.registry.get("hvd_fleet_ranks_failed").value() == 1
+        assert snap.registry.get("hvd_fleet_x_total").value() == 4
+
+    def test_in_process_fleet_snapshot_with_straggler(self):
+        """The acceptance composite (the in-process flavor of the
+        dryrun_multichip(8) criterion): 8 simulated rank snapshots —
+        merged latency histograms matching pooled data, skew gauges
+        populated, and the straggler report naming the rank the
+        collective_slow chaos site artificially slowed."""
+        rs = np.random.RandomState(3)
+        agg = aggregate.FleetAggregator()
+        pooled = MetricRegistry().histogram("hvd_step_seconds", "o")
+        for rank in range(8):
+            reg = MetricRegistry()
+            h = reg.histogram("hvd_step_seconds", "step")
+            for v in rs.lognormal(-2 + 0.1 * rank, 0.5, size=16):
+                h.observe(float(v))
+                pooled.observe(float(v))
+            window = _slow_rank_window(rank, slow=(rank == 5), n=4)
+            agg.add_snapshot_fn(
+                lambda reg=reg, rank=rank, window=window:
+                aggregate.rank_snapshot(reg, rank=rank,
+                                        collectives=window),
+                name=f"rank:{rank}")
+        snap = agg.collect()
+        merged = snap.registry.get("hvd_fleet_step_seconds")
+        assert merged.quantile(0.5) == pytest.approx(
+            pooled.quantile(0.5))
+        assert merged.samples()[0][1].count == 8 * 16
+        assert snap.registry.get(
+            "hvd_rank_skew_step_seconds").value() > 0
+        assert snap.straggler is not None
+        assert snap.straggler["slowest_rank"] == 5
+        assert snap.straggler["straggler"] is True
+        assert snap.registry.get(
+            "hvd_fleet_straggler_rank").value() == 5
+        assert snap.to_json()["straggler"]["slowest_rank"] == 5
+
+    def test_fleet_http_endpoints(self):
+        """/fleet (Prometheus text) and /fleet.json on a live
+        exporter, default local aggregator (the one-host fleet)."""
+        h = registry().histogram(
+            "hvd_fleet_http_test_seconds", "fleet http test family")
+        h.observe(0.01)
+        h.observe(0.2)
+        prev = aggregate.install(
+            aggregate.FleetAggregator().add_registry(registry()))
+        try:
+            with MetricsServer(port=0) as srv:
+                text = urllib.request.urlopen(
+                    srv.url + "/fleet", timeout=10).read().decode()
+                assert re.search(
+                    r'hvd_fleet_fleet_http_test_seconds_bucket'
+                    r'\{le="\+Inf"\} 2', text)
+                assert "hvd_fleet_ranks 1" in text
+                full = json.loads(urllib.request.urlopen(
+                    srv.url + "/fleet.json", timeout=10).read())
+                assert full["ranks_failed"] == []
+                assert ("hvd_fleet_fleet_http_test_seconds"
+                        in full["metrics"])
+                # /metrics.json now carries the aggregator's pull
+                # shape: rank + the collective timing window.
+                mj = json.loads(urllib.request.urlopen(
+                    srv.url + "/metrics.json", timeout=10).read())
+                assert "rank" in mj and "collectives" in mj
+        finally:
+            aggregate.install(prev)
+
+
+# ---------------------------------------------------------------------------
+# Straggler attribution
+# ---------------------------------------------------------------------------
+
+class TestStraggler:
+    def test_merge_windows_names_chaos_slowed_rank(self):
+        windows = [_slow_rank_window(r, slow=(r == 5))
+                   for r in range(8)]
+        report = straggler.merge_windows(windows)
+        assert report["ranks"] == 8
+        assert report["slowest_rank"] == 5
+        assert report["straggler"] is True
+        assert report["skew_s"] > 0.01
+        assert report["per_rank"][5]["mean_s"] > (
+            2 * report["per_rank"][0]["mean_s"])
+
+    def test_merge_windows_empty(self):
+        assert straggler.merge_windows([]) is None
+        assert straggler.merge_windows([{"rank": 0, "n": 0}]) is None
+
+    def test_window_exchange_publishes_metrics(self, event_log):
+        m = catalog.collective_metrics()
+        before = m["exchanges"].value()
+        tr = straggler.StragglerTracker(rank=0, window=4)
+        for _ in range(4):
+            tr.record("allreduce", 0.001)   # 4th record -> exchange
+        assert m["exchanges"].value() == before + 1
+        assert tr.last_report() is not None
+        assert tr.window_snapshot()["n"] == 0   # window reset
+        # A multi-rank exchange with a real straggler emits the event
+        # and moves the skew histogram + rank gauge.
+        skew_before = m["skew"].samples()[0][1].count
+        report = tr.exchange(
+            windows=[_slow_rank_window(r, slow=(r == 2))
+                     for r in range(3)])
+        assert report["slowest_rank"] == 2
+        assert m["skew"].samples()[0][1].count == skew_before + 1
+        assert m["straggler_rank"].value() == 2
+        assert any(e["kind"] == "collective.straggler"
+                   and e["slowest_rank"] == 2
+                   for e in events.tail(20))
+
+    def test_exchange_reentrancy_is_thread_scoped(self):
+        """Only the exchanging THREAD's own recursive dispatch is
+        skipped; a concurrent thread's collective during a (slow)
+        exchange is a real sample and must land — dropping it would
+        bias the skew report on exactly the slow ranks being
+        diagnosed."""
+        tr = straggler.StragglerTracker(rank=0, window=2)
+        seen = {}
+
+        def exchange_fn(local):
+            tr.record("allreduce", 9.9)      # recursive: skipped
+            t = threading.Thread(
+                target=lambda: tr.record("other", 0.01))
+            t.start()
+            t.join()
+            seen["win"] = tr.window_snapshot()
+            return [local]
+
+        tr.exchange_fn = exchange_fn
+        tr.record("allreduce", 0.001)
+        tr.record("allreduce", 0.001)        # window full -> exchange
+        win = seen["win"]
+        assert win["ops"].get("other", {}).get("n") == 1
+        assert "allreduce" not in win["ops"]   # 9.9 was skipped
+
+    def test_eager_collective_records_into_tracker(self, hvd):
+        """The instrumentation seam: a real eager collective dispatch
+        lands in the process tracker's window."""
+        prev = straggler.install(
+            straggler.StragglerTracker(rank=0, window=0))
+        try:
+            # per_rank forces the _run_collective dispatch path (a
+            # plain replicated array short-circuits host-side).
+            hvd.allreduce(hvd.per_rank(
+                [np.ones(4, np.float32)] * hvd.size()),
+                name="straggler_t")
+            snap = straggler.tracker().window_snapshot()
+            assert snap["n"] >= 1
+            assert any(op.startswith("allreduce")
+                       for op in snap["ops"])
+        finally:
+            straggler.install(prev)
+
+    def test_train_step_records_fusion_cycle(self, hvd):
+        from horovod_tpu.models.train import _obs_step
+        prev = straggler.install(
+            straggler.StragglerTracker(rank=0, window=0))
+        try:
+            stepped = _obs_step(lambda s, b, r: (s, 0.5),
+                                name="fleet_unit_step")
+            stepped({}, None, None)
+            snap = straggler.tracker().window_snapshot()
+            assert snap["ops"].get("fusion_cycle", {}).get("n") == 1
+        finally:
+            straggler.install(prev)
+
+    def test_stall_event_carries_straggler_report(self, event_log):
+        from horovod_tpu.utils.stall import StallMonitor
+        tr = straggler.StragglerTracker(rank=0, window=0)
+        tr.exchange(windows=[_slow_rank_window(r, slow=(r == 1), n=3)
+                             for r in range(2)])
+        prev = straggler.install(tr)
+        mon = StallMonitor(warning_time_s=60.0, check_every_s=3600.0)
+        try:
+            mon.begin("fleet_stall_op")
+            stalled = mon.check_once(now=time.time() + 120.0)
+        finally:
+            mon.stop()
+            straggler.install(prev)
+        assert stalled == ["fleet_stall_op"]
+        recs = [e for e in events.tail(50)
+                if e["kind"] == "stall" and e["op"] == "fleet_stall_op"]
+        assert recs and recs[-1]["straggler"]["slowest_rank"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_trigger_noop_without_dir(self, monkeypatch):
+        monkeypatch.delenv("HVD_FLIGHT_DIR", raising=False)
+        assert flightrec.trigger("unit.test") is None
+
+    def test_dump_bundle_shape_and_retention(self, tmp_path,
+                                             event_log):
+        d = str(tmp_path / "flights")
+        events.emit("unit.marker", value=42)
+        c = catalog.flight_metrics()["bundles"]
+        before = c.value(reason="unit.test")
+        paths = [flightrec.dump("unit.test", dirpath=d, keep=2,
+                                detail=i)
+                 for i in range(4)]
+        assert all(paths)
+        assert c.value(reason="unit.test") == before + 4
+        kept = flightrec.list_bundles(d)
+        assert len(kept) == 2                 # retention pruned
+        assert kept[-1] == paths[-1]          # newest survives
+        b = flightrec.load(kept[-1])
+        assert b["schema"] == flightrec.SCHEMA
+        assert b["reason"] == "unit.test"
+        assert b["context"]["detail"] == 3
+        assert any(e["kind"] == "unit.marker" and e["value"] == 42
+                   for e in b["events"])
+        assert "hvd_serving_ttft_seconds" in b["metrics"]
+        assert "HVD_FLIGHT_DIR" in b["config"]["knobs"]
+        assert "fusion_threshold" in b["config"]["resolved"]
+
+    def test_reason_keyword_context_is_legal(self, tmp_path):
+        # The restart path passes reason=... as CONTEXT; the
+        # positional-only signature must route it there.
+        p = flightrec.dump("unit.ctx", dirpath=str(tmp_path),
+                           reason="inner")
+        assert flightrec.load(p)["context"]["reason"] == "inner"
+
+    def test_provider_fault_contained(self, tmp_path):
+        flightrec.register_inflight(
+            "broken", lambda: {}["missing"])
+        try:
+            p = flightrec.dump("unit.broken", dirpath=str(tmp_path))
+            b = flightrec.load(p)
+            assert "error" in b["inflight"]["broken"]
+        finally:
+            flightrec.unregister_inflight("broken")
+
+    def test_dispatch_crash_postmortem_end_to_end(
+            self, lm, tmp_path, monkeypatch, event_log):
+        """The acceptance path: serving_dispatch_crash under a
+        watchdog engine -> the restart writes a bundle carrying the
+        crashed request's trace_id, the restart event, and a metric
+        snapshot; the pretty-printer surfaces the newest event and
+        the trace_id."""
+        from horovod_tpu.resilience import chaos
+        from horovod_tpu.serving import ServingEngine
+        d = str(tmp_path / "flights")
+        monkeypatch.setenv("HVD_FLIGHT_DIR", d)
+        model, params = lm
+        eng = ServingEngine(model, params, num_slots=2, max_queue=16,
+                            auto_restart=True, max_restarts=2)
+        try:
+            handles = [eng.submit(p, 10) for p in
+                       (np.array([3, 5, 7]), np.array([2, 4]))]
+            _wait(lambda: eng.pool.busy_slots > 0)
+            with chaos.armed("serving_dispatch_crash:1"):
+                _wait(lambda:
+                      eng.metrics_snapshot()["restarts"] == 1)
+                for h in handles:
+                    h.result(timeout=300)
+        finally:
+            eng.shutdown()
+        bundles = flightrec.list_bundles(d)
+        # chaos.fire bundle at the crash + serving.restart bundle.
+        reasons = [flightrec.load(p)["reason"] for p in bundles]
+        assert "chaos.fire" in reasons and "serving.restart" in reasons
+        b = flightrec.load(bundles[reasons.index("serving.restart")])
+        ids = {st["trace_id"]
+               for states in b["inflight"].values()
+               if isinstance(states, list) for st in states}
+        assert ids & {h.trace_id for h in handles}
+        assert b["context"]["requeued_trace_ids"]
+        assert any(e["kind"] == "serving.restart"
+                   for e in b["events"])
+        assert "hvd_serving_events_total" in b["metrics"]
+        rendered = flightrec.describe(b)
+        newest = b["events"][-1]
+        assert f"#{newest['seq']} {newest['kind']}" in rendered
+        assert (set(b["context"]["requeued_trace_ids"])
+                & set(re.findall(r"trace_id=(\w+)", rendered)))
+
+    def test_cli(self, tmp_path, capsys):
+        d = str(tmp_path)
+        p = flightrec.dump("unit.cli", dirpath=d)
+        assert flightrec.main([p]) == 0
+        out = capsys.readouterr().out
+        assert "reason:  unit.cli" in out
+        assert flightrec.main([d]) == 0       # directory listing
+        assert "unit.cli" in capsys.readouterr().out
+        assert flightrec.main(
+            [str(tmp_path / "missing.json")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rates
+# ---------------------------------------------------------------------------
+
+class TestSLO:
+    def _monitor(self, **kw):
+        kw.setdefault("fast_window_s", 10.0)
+        kw.setdefault("slow_window_s", 100.0)
+        kw.setdefault("fast_burn", 2.0)
+        return slo_mod.SLOMonitor(
+            [slo_mod.Objective("ttft", "latency", threshold_s=0.1,
+                               budget=0.1),
+             slo_mod.Objective("shed", "rate", budget=0.1)], **kw)
+
+    def test_burn_rate_math(self):
+        mon = self._monitor()
+        t0 = 1000.0
+        # 20 events in both windows, 4 bad -> bad_frac .2, budget .1
+        # -> burn 2.0 on both windows -> breaching at threshold 2.0.
+        for i in range(20):
+            mon.record("ttft", 0.2 if i % 5 == 0 else 0.01,
+                       now=t0 + i * 0.1)
+        state = mon.evaluate(now=t0 + 2.0)
+        assert state["ttft"]["burn_rate_fast"] == pytest.approx(2.0)
+        assert state["ttft"]["burn_rate_slow"] == pytest.approx(2.0)
+        assert state["ttft"]["breaching"] is True
+        assert mon.breach_count == 1
+        g = catalog.slo_metrics()["burn_rate"]
+        assert g.value(objective="ttft",
+                       window="fast") == pytest.approx(2.0)
+
+    def test_fast_burn_needs_both_windows(self, event_log):
+        """An incident that already stopped must not page: old badness
+        keeps the SLOW window hot, but the fast window has recovered
+        -> no breach. (The short-window condition of the multi-window
+        alert.)"""
+        mon = self._monitor()
+        t0 = 2000.0
+        for i in range(30):                     # old, all bad
+            mon.record("ttft", 1.0, now=t0 + i)
+        for i in range(30):                     # recent, all good
+            mon.record("ttft", 0.01, now=t0 + 60 + i * 0.2)
+        state = mon.evaluate(now=t0 + 66.0)
+        assert state["ttft"]["burn_rate_slow"] >= 2.0
+        assert state["ttft"]["burn_rate_fast"] == 0.0
+        assert state["ttft"]["breaching"] is False
+
+    def test_breach_transition_events_and_clear(self, event_log):
+        mon = self._monitor()
+        # Wall-clock-anchored: health() evaluates at the REAL now, so
+        # synthetic ancient timestamps would age out of both windows.
+        t0 = time.time()
+        for i in range(10):
+            mon.record("ttft", 1.0, now=t0 + i * 0.1)
+        assert mon.evaluate(now=t0 + 1.0)["ttft"]["breaching"]
+        assert mon.breaching() == ["ttft"]
+        assert not mon.health()["healthy"]
+        kinds = [e["kind"] for e in events.tail(10)]
+        assert "slo.breach" in kinds
+        c = catalog.slo_metrics()["breaches"]
+        assert c.value(objective="ttft") >= 1
+        # Recovery: the bad window ages out entirely -> clear event.
+        state = mon.evaluate(now=t0 + 500.0)
+        assert state["ttft"]["breaching"] is False
+        assert any(e["kind"] == "slo.clear"
+                   for e in events.tail(10))
+
+    def test_shed_rate_objective(self):
+        mon = self._monitor()
+        t0 = 4000.0
+        for i in range(10):
+            mon.record("shed", good=(i != 0), now=t0 + i * 0.1)
+        state = mon.evaluate(now=t0 + 1.0)
+        assert state["shed"]["burn_rate_fast"] == pytest.approx(1.0)
+        assert state["shed"]["breaching"] is False
+
+    def test_spec_grammar(self):
+        mon = slo_mod.SLOMonitor.from_spec(
+            "ttft=0.5,tpot=0.1,shed=0.02,target=0.999,fast=60,"
+            "slow=600,burn=10")
+        assert set(mon.objectives) == {"ttft", "tpot", "shed"}
+        assert mon.objectives["ttft"].threshold_s == 0.5
+        assert mon.objectives["ttft"].budget == pytest.approx(0.001)
+        assert mon.objectives["shed"].budget == 0.02
+        assert mon.fast_window_s == 60 and mon.slow_window_s == 600
+        assert mon.fast_burn == 10
+        assert slo_mod.SLOMonitor.from_spec("") is None
+        with pytest.raises(ValueError, match="unknown"):
+            slo_mod.SLOMonitor.from_spec("nope=1")
+        with pytest.raises(ValueError, match="number"):
+            slo_mod.SLOMonitor.from_spec("ttft=abc")
+        with pytest.raises(ValueError, match="no objective"):
+            slo_mod.SLOMonitor.from_spec("target=0.9")
+
+    def test_unreachable_breach_warns(self, capsys):
+        """budget x fast_burn > 1 means the max possible burn rate
+        (1/budget, 100% bad) can never reach the threshold — a
+        silently dead 503 path must warn at construction."""
+        slo_mod.SLOMonitor(
+            [slo_mod.Objective("ttft", "latency", threshold_s=0.5,
+                               budget=0.1)],
+            fast_burn=14.4)
+        err = capsys.readouterr().err
+        assert "can never fire" in err and "'ttft'" in err
+        slo_mod.SLOMonitor(
+            [slo_mod.Objective("ttft", "latency", threshold_s=0.5,
+                               budget=0.01)],
+            fast_burn=14.4)
+        assert "can never fire" not in capsys.readouterr().err
+
+    def test_slow_window_survives_high_rate(self):
+        """The rings bucket by SECOND, not by raw event count: a
+        sustained high request rate must not silently truncate the
+        slow window (which would collapse the two-window breach
+        semantics into one short window)."""
+        mon = self._monitor()   # fast 10s / slow 100s
+        t0 = 5000.0
+        # 90s of 200 good events/s = 18000 events (an event-bounded
+        # ring of a few thousand would have dropped most of it),
+        # then 5s of all-bad.
+        for sec in range(90):
+            for k in range(200):
+                mon.record("ttft", 0.01, now=t0 + sec + k / 200.0)
+        for sec in range(5):
+            for k in range(200):
+                mon.record("ttft", 1.0, now=t0 + 90 + sec + k / 200.0)
+        state = mon.evaluate(now=t0 + 95.0)
+        assert state["ttft"]["n_slow"] == 19000   # nothing truncated
+        # Fast window (10s) = 5 good + 5 bad seconds -> bad frac 0.5
+        # -> burn 5.0; slow-window bad fraction 1000/19000 -> ~0.53,
+        # well under it — the long window correctly refuses to
+        # confirm a 5-second spike as a sustained burn.
+        assert state["ttft"]["burn_rate_fast"] == pytest.approx(
+            5.0, rel=0.05)
+        assert state["ttft"]["burn_rate_slow"] < 1.0
+        assert state["ttft"]["breaching"] is False
+
+    def test_engine_fast_burn_degrades_healthz(self, lm):
+        """The wiring acceptance: a live engine missing an absurd
+        TTFT objective must read degraded at /healthz while its
+        dispatch thread is perfectly alive."""
+        from horovod_tpu.serving import ServingEngine
+        model, params = lm
+        mon = slo_mod.SLOMonitor(
+            [slo_mod.Objective("ttft", "latency",
+                               threshold_s=1e-9, budget=0.01)],
+            fast_window_s=30.0, slow_window_s=300.0, fast_burn=2.0)
+        eng = ServingEngine(model, params, num_slots=2, slo=mon)
+        key = f"serving_slo_{eng._engine_id}"
+        try:
+            for i in range(3):
+                eng.submit(np.array([3 + i, 5]), 4).result(
+                    timeout=300)
+            health = registry().health()
+            assert health["status"] == "degraded"
+            assert health["components"][key]["healthy"] is False
+            assert "ttft" in health["components"][key]["breaching"]
+            # The engine itself is fine — only the SLO component
+            # degrades the plane.
+            eng_key = f"serving_engine_{eng._engine_id}"
+            assert health["components"][eng_key]["healthy"] is True
+        finally:
+            eng.shutdown()
+        assert key not in registry().health().get("components", {})
+
+
+# ---------------------------------------------------------------------------
+# Satellites: exemplars, events ring knob, churn-under-scrape
+# ---------------------------------------------------------------------------
+
+class TestExemplars:
+    def _reg(self):
+        reg = MetricRegistry()
+        h = reg.histogram("lat_seconds", "latency",
+                          buckets=(0.1, 1.0, 10.0))
+        h.observe(0.5, exemplar={"trace_id": "abcd1234"})
+        h.observe(50.0)
+        return reg
+
+    def test_openmetrics_bucket_exemplar(self):
+        text = render_prometheus(self._reg(), exemplars=True)
+        # The exemplar rides exactly the bucket containing 0.5
+        # (le="1"), in the OpenMetrics `# {labels} value ts` syntax.
+        lines = [l for l in text.splitlines() if " # {" in l]
+        assert len(lines) == 1
+        assert lines[0].startswith('lat_seconds_bucket{le="1"}')
+        assert re.search(
+            r'# \{trace_id="abcd1234"\} 0\.5 \d+', lines[0])
+        assert text.rstrip().endswith("# EOF")
+
+    def test_classic_format_unchanged(self):
+        text = render_prometheus(self._reg())
+        assert "# {" not in text and "# EOF" not in text
+
+    def test_exemplar_beyond_last_edge_rides_inf_bucket(self):
+        reg = MetricRegistry()
+        h = reg.histogram("h_seconds", "doc", buckets=(0.1,))
+        h.observe(5.0, exemplar={"trace_id": "ffff0000"})
+        text = render_prometheus(reg, exemplars=True)
+        (line,) = [l for l in text.splitlines() if " # {" in l]
+        assert 'le="+Inf"' in line
+
+    def test_openmetrics_counter_family_drops_total_suffix(self):
+        """OpenMetrics names a counter FAMILY without _total (samples
+        keep it); emitting the 0.0.4 shape under the OpenMetrics
+        content type makes a stock Prometheus reject the scrape."""
+        reg = MetricRegistry()
+        reg.counter("hvd_req_total", "doc").inc(5)
+        om = render_prometheus(reg, exemplars=True)
+        assert "# TYPE hvd_req counter" in om
+        assert "# TYPE hvd_req_total" not in om
+        assert "\nhvd_req_total 5" in om        # sample keeps _total
+        classic = render_prometheus(reg)
+        assert "# TYPE hvd_req_total counter" in classic
+
+    def test_http_accept_negotiation(self):
+        reg = self._reg()
+        with MetricsServer(reg, port=0) as srv:
+            req = urllib.request.Request(
+                srv.url + "/metrics",
+                headers={"Accept": "application/openmetrics-text"})
+            om = urllib.request.urlopen(req, timeout=10)
+            body = om.read().decode()
+            assert "application/openmetrics-text" in om.headers[
+                "Content-Type"]
+            assert 'trace_id="abcd1234"' in body
+            assert body.rstrip().endswith("# EOF")
+            classic = urllib.request.urlopen(
+                srv.url + "/metrics", timeout=10).read().decode()
+            assert "# {" not in classic and "# EOF" not in classic
+
+
+class TestEventsRingKnob:
+    def test_ring_capacity_from_env(self, monkeypatch):
+        monkeypatch.setenv("HVD_EVENTS_RING", "8")
+        log = events.EventLog()
+        for i in range(20):
+            log.emit("k", i=i)
+        assert len(log) == 8
+        monkeypatch.setenv("HVD_EVENTS_RING", "0")   # floor: 1
+        assert events.EventLog()._ring.maxlen == 1
+        monkeypatch.delenv("HVD_EVENTS_RING")
+        assert events.EventLog()._ring.maxlen == events.DEFAULT_RING
+
+    def test_explicit_maxlen_wins(self, monkeypatch):
+        monkeypatch.setenv("HVD_EVENTS_RING", "8")
+        assert events.EventLog(maxlen=3)._ring.maxlen == 3
+
+    def test_new_knobs_registered(self):
+        from horovod_tpu.runtime.config import KNOBS
+        for name in ("HVD_EVENTS_RING", "HVD_FLIGHT_DIR",
+                     "HVD_FLIGHT_KEEP", "HVD_SLO",
+                     "HVD_FLEET_RANKS", "HVD_STRAGGLER_CYCLES"):
+            assert name in KNOBS, name
+
+
+class TestChurnUnderScrape:
+    def test_scrape_loop_survives_engine_churn(self, lm):
+        """The satellite fix's regression guard: exporters scraping
+        (Prometheus render, JSON snapshot, fleet rank snapshot,
+        /healthz) in a tight loop while engines construct and shut
+        down concurrently must never raise — and a shut-down engine's
+        gauge rows must not resurrect (the close-vs-observe race)."""
+        from horovod_tpu.serving import ServingEngine
+        model, params = lm
+        churned_ids = []
+        errors = []
+
+        def churn():
+            try:
+                for i in range(4):
+                    eng = ServingEngine(model, params, num_slots=1)
+                    churned_ids.append(str(eng._engine_id))
+                    eng.submit(np.array([3, 5 + i]), 3).result(
+                        timeout=300)
+                    eng.shutdown()
+            except Exception as e:   # noqa: BLE001 — reported below
+                errors.append(e)
+
+        t = threading.Thread(target=churn)
+        t.start()
+        reg = registry()
+        while t.is_alive():
+            text = render_prometheus(reg)
+            assert "hvd_serving_queue_depth" in text
+            reg.to_json()
+            aggregate.rank_snapshot(reg)
+            reg.health()
+        t.join()
+        assert not errors, errors
+        assert len(churned_ids) == 4
+        # No zombie rows: every churned engine's labeled gauges are
+        # gone after its shutdown (the _closed fix — a draining
+        # dispatch thread's gauge write can no longer land after the
+        # close removed the rows).
+        time.sleep(0.1)
+        for fam in ("queue_depth", "slots_busy", "slot_occupancy",
+                    "engine_generation"):
+            live = {labels.get("engine") for labels, _ in
+                    catalog.serving_metrics()[fam].samples()}
+            assert not (set(churned_ids) & live), (fam, live)
+
+    def test_engine_snapshot_during_shutdown_races(self, lm):
+        """metrics_snapshot() racing shutdown() must not raise."""
+        from horovod_tpu.serving import ServingEngine
+        model, params = lm
+        eng = ServingEngine(model, params, num_slots=1)
+        eng.submit(np.array([2, 4]), 3)
+        errors = []
+
+        def snap_loop():
+            try:
+                for _ in range(200):
+                    eng.metrics_snapshot()
+            except Exception as e:   # noqa: BLE001 — reported below
+                errors.append(e)
+
+        t = threading.Thread(target=snap_loop)
+        t.start()
+        eng.shutdown()
+        t.join()
+        assert not errors, errors
