@@ -658,6 +658,128 @@ def _serve_rate(model, params, args, prompts, rate, *,
     return rec
 
 
+def _router_leg(model, params, args, prompts, rate, *, replicas,
+                kill, log, refs=None):
+    """One serving-fleet leg for the --router A/B: Poisson arrivals
+    through a `ServingRouter` over ``replicas`` engine replicas;
+    ``kill=True`` arms the ``router.replica_kill`` chaos site a third
+    of the way into the arrival stream (abrupt replica death with
+    streams mid-decode). Returns (record, streams) — ``refs`` (the
+    matching no-chaos leg's streams) pins the token-exact-failover
+    bit recorded in the artifact."""
+    import numpy as np
+
+    from horovod_tpu.resilience import chaos as chaos_mod
+    from horovod_tpu.serving import ServingEngine, ServingRouter
+
+    steps, n_req = args.decode_steps, len(prompts)
+    S = args.serving_slots
+
+    def factory():
+        return ServingEngine(
+            model, params, num_slots=S, max_queue=2 * n_req,
+            warmup=True, pipeline_depth=args.serving_pipeline_depth,
+            prefill_chunk_budget=args.prefill_chunk_budget)
+
+    gaps = np.random.RandomState(7).exponential(1.0 / rate,
+                                                size=n_req)
+    router = ServingRouter(factory, num_replicas=replicas,
+                           health_poll_s=0.01)
+    monkey = None
+    # A previously armed monkey (e.g. env HVD_CHAOS) must survive
+    # this leg: install() returns the NEW value, so the previous one
+    # comes from active() (the PR-6 equivalence-harness lesson).
+    prev_monkey = chaos_mod.active()
+    t0 = time.time()
+    handles = []
+    try:
+        for i, p in enumerate(prompts):
+            handles.append(router.submit(p, steps, temperature=0.7,
+                                         seed=i))
+            if kill and i == n_req // 3:
+                # Seeded chaos once the fleet is demonstrably busy.
+                monkey = chaos_mod.ChaosMonkey("router.replica_kill:1")
+                chaos_mod.install(monkey)
+            if i < n_req - 1:
+                time.sleep(float(gaps[i]))
+        results = [h.result() for h in handles]
+        if kill:
+            # The cold replacement lands >= one monitor sweep after
+            # the migrations; wait for it so the artifact records the
+            # restored fleet, not the race.
+            t_end = time.time() + 10
+            while (router.metrics_snapshot()["replacements"] < 1
+                   and time.time() < t_end):
+                time.sleep(0.02)
+    finally:
+        if monkey is not None:
+            chaos_mod.install(prev_monkey)
+        snap = router.metrics_snapshot()
+        router.shutdown()
+    dt = time.time() - t0
+    streams = [list(r.tokens) for r in results]
+    ttfts = sorted(r.ttft_s for r in results)
+    e2es = sorted(r.e2e_s for r in results)
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)) * 1e3, 3)
+
+    rec = {
+        "replicas": replicas,
+        "chaos": bool(kill),
+        "tok_s": round(sum(len(s) for s in streams) / dt, 2),
+        "completed": snap["completed"],
+        "failed": snap["failed"],
+        "ttft_ms_p50": pct(ttfts, 50), "ttft_ms_p95": pct(ttfts, 95),
+        "e2e_ms_p50": pct(e2es, 50), "e2e_ms_p95": pct(e2es, 95),
+        "migrations": snap["migrations"],
+        "migrated_tokens": snap["migrated_tokens"],
+        "replica_deaths": snap["replica_deaths"],
+        "replacements": snap["replacements"],
+        "retries": snap["retries"], "hedges": snap["hedges"],
+    }
+    if kill:
+        rec["kills_fired"] = (monkey.fired("router.replica_kill")
+                              if monkey else 0)
+    if refs is not None:
+        # THE failover acceptance bit: chaos-leg streams bitwise equal
+        # the no-chaos leg's (same prompts + seeds => deterministic).
+        rec["token_exact_vs_no_chaos"] = streams == refs
+    log(f"router leg replicas={replicas} chaos={kill}: "
+        f"{rec['tok_s']} tok/s, ttft p50/p95 {rec['ttft_ms_p50']}/"
+        f"{rec['ttft_ms_p95']} ms, {rec['migrations']} migration(s), "
+        f"{rec['replica_deaths']} death(s)"
+        + (f", token-exact={rec['token_exact_vs_no_chaos']}"
+           if refs is not None else ""))
+    return rec, streams
+
+
+def _router_ab(model, params, args, prompts, rate, log):
+    """--serving --router: the fleet-failover A/B (docs/serving.md
+    "Fleet failover") — 1 vs N replicas, each with and without the
+    seeded router.replica_kill chaos. The single-replica chaos leg
+    exercises recovery-by-cold-replacement (the kill leaves no
+    sibling, so migrated streams wait for the factory replacement);
+    the fleet chaos leg is the headline: replica death invisible and
+    token-exact."""
+    n = args.router_replicas
+    single, s_streams = _router_leg(
+        model, params, args, prompts, rate, replicas=1, kill=False,
+        log=log)
+    single_chaos, _ = _router_leg(
+        model, params, args, prompts, rate, replicas=1, kill=True,
+        log=log, refs=s_streams)
+    fleet, f_streams = _router_leg(
+        model, params, args, prompts, rate, replicas=n, kill=False,
+        log=log)
+    fleet_chaos, _ = _router_leg(
+        model, params, args, prompts, rate, replicas=n, kill=True,
+        log=log, refs=f_streams)
+    return {"rate": rate, "single": single,
+            "single_chaos": single_chaos, "fleet": fleet,
+            "fleet_chaos": fleet_chaos}
+
+
 def _serving_trace_check(model, params, args, prompts, log):
     """Observability acceptance evidence: run a few requests with the
     event log, the (Python-writer) Timeline and the shared metric
@@ -964,6 +1086,11 @@ def run_serving(args, devices, n_chips, log):
             f"skipped {p['prefill_tokens_skipped']}, peak concurrency "
             f"{f['peak_active']} (cap {f['num_slots']}) -> "
             f"{p['peak_active']}{ttft}")
+    if getattr(args, "router", False):
+        # Fleet-failover A/B (1 vs N replicas, with and without the
+        # seeded router.replica_kill chaos) at the highest rate.
+        out["router_ab"] = _router_ab(model, params, args, prompts,
+                                      max(rates), log)
     return out
 
 
@@ -1274,6 +1401,17 @@ def main():
                     help="serving: paged-KV block size in tokens for "
                          "the paged A/B leg (HVD_KV_BLOCK_SIZE "
                          "parity)")
+    ap.add_argument("--router", action="store_true",
+                    help="serving: add the fleet-failover A/B — "
+                         "ServingRouter over 1 vs --router-replicas "
+                         "engine replicas, each with and without the "
+                         "seeded router.replica_kill chaos; records "
+                         "migrations, failover counts and the "
+                         "token-exact-vs-no-chaos bit "
+                         "(docs/serving.md 'Fleet failover')")
+    ap.add_argument("--router-replicas", type=int, default=3,
+                    help="serving: fleet width for the --router A/B "
+                         "(HVD_ROUTER_REPLICAS parity)")
     ap.add_argument("--serving-slo",
                     default="ttft=30,tpot=5,shed=0.1,target=0.9,"
                             "fast=5,slow=60,burn=5",
@@ -1787,6 +1925,12 @@ def _bench_body(args, devices, n_chips, metric, unit,
         if "paged_ab" in r:
             result["paged_ab"] = r["paged_ab"]
             result["serving_shared_prefix"] = args.serving_shared_prefix
+        if "router_ab" in r:
+            # The fleet-failover A/B (docs/serving.md "Fleet
+            # failover"): 1 vs N replicas, each +/- the seeded
+            # router.replica_kill chaos, incl. the token-exact bit.
+            result["router_ab"] = r["router_ab"]
+            result["router_replicas"] = args.router_replicas
         _set_best(result)
         emit(_BEST_RESULT)
         write_out(args)

@@ -82,6 +82,17 @@ JAX_PLATFORMS=cpu python -m horovod_tpu.obs.flightrec \
     "$(ls /tmp/hvd_fleet_smoke/flight_*.json | tail -1)" \
     | grep -q "trace_id="
 
+# Serving-fleet failover smoke (docs/serving.md "Fleet failover"):
+# three in-process ServingEngine replicas behind a ServingRouter; the
+# router.replica_kill chaos site hard-kills the busiest replica while
+# streams are mid-decode. All requests must complete, migrated
+# streams must be BITWISE a no-chaos run's (token-exact migration:
+# already-generated tokens resubmitted as a forced prefix, sample
+# stream resumed at the right ordinal), and the fleet must be back at
+# full strength via a cold replacement.
+JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4 \
+    --failover-check
+
 # Resume smoke (docs/resilience.md "Exact resume"): a short training
 # run over a sharded shuffled dataset is killed mid-epoch AND
 # mid-checkpoint-save via HVD_CHAOS, restarted with full TrainSnapshot

@@ -6,6 +6,7 @@
         [--baseline .hvdlint-baseline.json] [--write-baseline]
         [--json] [--rules HVD001,HVD004] [--list-rules]
         [--write-env-table [docs/troubleshooting.md]]
+        [--write-chaos-table [docs/resilience.md]]
 
 Exit codes: 0 clean (all findings baselined), 1 findings, 2 usage or
 analysis error. Default target: the installed ``horovod_tpu`` package
@@ -28,6 +29,8 @@ from horovod_tpu.analysis.rules import ALL_RULES, BY_ID
 
 _ENV_TABLE_BEGIN = "<!-- hvdlint:env-table:begin -->"
 _ENV_TABLE_END = "<!-- hvdlint:env-table:end -->"
+_CHAOS_TABLE_BEGIN = "<!-- hvdlint:chaos-table:begin -->"
+_CHAOS_TABLE_END = "<!-- hvdlint:chaos-table:end -->"
 
 
 def _package_root() -> str:
@@ -49,28 +52,45 @@ def analyze(paths, rules=None, root=None):
     return run_rules(project, rules or ALL_RULES), len(files)
 
 
-def write_env_table(doc_path: str) -> bool:
-    """Regenerate the environment-knob table between the hvdlint
-    markers in ``doc_path`` from the live config registry. Returns
-    True when the file changed."""
-    from horovod_tpu.runtime.config import env_table_md
+def _write_marked_table(doc_path: str, begin: str, end: str,
+                        table_md: str) -> bool:
+    """Replace the span between ``begin``/``end`` markers in
+    ``doc_path`` with ``table_md``. Returns True when the file
+    changed."""
     with open(doc_path, "r", encoding="utf-8") as fh:
         text = fh.read()
     try:
-        head, rest = text.split(_ENV_TABLE_BEGIN, 1)
-        _, tail = rest.split(_ENV_TABLE_END, 1)
+        head, rest = text.split(begin, 1)
+        _, tail = rest.split(end, 1)
     except ValueError:
         raise SystemExit(
-            f"{doc_path}: missing {_ENV_TABLE_BEGIN} / "
-            f"{_ENV_TABLE_END} markers")
-    new = (f"{head}{_ENV_TABLE_BEGIN}\n"
-           f"{env_table_md()}"
-           f"{_ENV_TABLE_END}{tail}")
+            f"{doc_path}: missing {begin} / {end} markers")
+    new = f"{head}{begin}\n{table_md}{end}{tail}"
     if new != text:
         with open(doc_path, "w", encoding="utf-8") as fh:
             fh.write(new)
         return True
     return False
+
+
+def write_env_table(doc_path: str) -> bool:
+    """Regenerate the environment-knob table between the hvdlint
+    markers in ``doc_path`` from the live config registry. Returns
+    True when the file changed."""
+    from horovod_tpu.runtime.config import env_table_md
+    return _write_marked_table(doc_path, _ENV_TABLE_BEGIN,
+                               _ENV_TABLE_END, env_table_md())
+
+
+def write_chaos_table(doc_path: str) -> bool:
+    """Regenerate the chaos-site table between the hvdlint markers in
+    ``doc_path`` from a source scan (`chaos.site_table_md`) — the
+    docs cannot name a site the code no longer instruments, and a new
+    site cannot ship undocumented. Returns True when the file
+    changed."""
+    from horovod_tpu.resilience.chaos import site_table_md
+    return _write_marked_table(doc_path, _CHAOS_TABLE_BEGIN,
+                               _CHAOS_TABLE_END, site_table_md())
 
 
 def main(argv=None) -> int:
@@ -100,6 +120,11 @@ def main(argv=None) -> int:
                                        "troubleshooting.md"),
                     help="regenerate the env-knob table in DOC from "
                          "the config registry, then exit")
+    ap.add_argument("--write-chaos-table", nargs="?", metavar="DOC",
+                    const=os.path.join(_repo_root(), "docs",
+                                       "resilience.md"),
+                    help="regenerate the chaos-site table in DOC from "
+                         "a source scan, then exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -113,6 +138,13 @@ def main(argv=None) -> int:
         print(f"hvdlint: env table "
               f"{'updated' if changed else 'already current'} in "
               f"{args.write_env_table}")
+        return 0
+
+    if args.write_chaos_table:
+        changed = write_chaos_table(args.write_chaos_table)
+        print(f"hvdlint: chaos-site table "
+              f"{'updated' if changed else 'already current'} in "
+              f"{args.write_chaos_table}")
         return 0
 
     rules = ALL_RULES

@@ -105,6 +105,66 @@ def serving_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
     }
 
 
+def router_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """The serving-fleet plane (serving/router.py, docs/serving.md
+    "Fleet failover"): replica-level routing, retry-budget spend,
+    hedging, and token-exact request migration across replica
+    deaths."""
+    reg = reg or registry()
+    return {
+        "requests": reg.counter(
+            "hvd_router_requests_total",
+            "Router-level request outcomes (completed, failed, "
+            "cancelled, timed_out, shed)", ("outcome",)),
+        "retries": reg.counter(
+            "hvd_router_retries_total",
+            "Submit retries on another replica after a shed/closed "
+            "first answer (token-bucket gated, HVD_RETRY_BUDGET)"),
+        "retry_budget": reg.gauge(
+            "hvd_router_retry_budget_tokens",
+            "Retry-budget tokens currently available (refills at "
+            "capacity/60 per second)"),
+        "hedges": reg.counter(
+            "hvd_router_hedges_total",
+            "Slow-to-first-token requests duplicated on a second "
+            "replica (delay = the HVD_HEDGE_QUANTILE TTFT quantile)"),
+        "hedge_wins": reg.counter(
+            "hvd_router_hedge_wins_total",
+            "Hedged requests whose DUPLICATE answered first (the "
+            "primary was cancelled)"),
+        "migrations": reg.counter(
+            "hvd_router_migrations_total",
+            "In-flight requests moved off a dead replica via "
+            "forced-prefix resubmission (token-exact)"),
+        "migrated_tokens": reg.counter(
+            "hvd_router_migrated_tokens_total",
+            "Already-generated tokens carried across migrations as "
+            "forced prefixes (decode work the failover did NOT "
+            "redo at the client's expense)"),
+        "replica_deaths": reg.counter(
+            "hvd_router_replica_deaths_total",
+            "Replicas the router declared dead (dispatch gone or "
+            "engine closed outside a drain)"),
+        "replacements": reg.counter(
+            "hvd_router_replacements_total",
+            "Cold replacement engines built for dead/drained "
+            "replicas (HVD_ROUTER_REPLACEMENTS budget)"),
+        "replicas": reg.gauge(
+            "hvd_router_replicas",
+            "Fleet size by replica state (up, draining, dead)",
+            ("state",)),
+        "failover": reg.histogram(
+            "hvd_router_failover_seconds",
+            "Replica-death detection to the migrated request "
+            "re-queued on a healthy replica, per request"),
+        "ttft": reg.histogram(
+            "hvd_router_ttft_seconds",
+            "Client-visible time to first token THROUGH the router "
+            "(includes retries, hedges and failovers; "
+            "hvd_serving_ttft_seconds is per-engine)"),
+    }
+
+
 def resilience_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
     """The resilience plane: every recovery path's counters
     (docs/resilience.md), StallMonitor trips included."""
@@ -258,6 +318,7 @@ def declare_standard_metrics(
     reg = reg or registry()
     return {
         "serving": serving_metrics(reg),
+        "router": router_metrics(reg),
         "resilience": resilience_metrics(reg),
         "training": training_metrics(reg),
         "collectives": collective_metrics(reg),

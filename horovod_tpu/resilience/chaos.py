@@ -49,7 +49,14 @@ serving_tick_stall      `serving/scheduler.py` inside the tick bracket
                         (cooperative: ends early once abandoned)
 serving_deadline_storm  `serving/scheduler.py` — expires every queued
                         request's deadline at once
+router.replica_kill     `serving/router.py` monitor sweep — hard-kills
+                        the busiest replica (no drain)
 ======================  ==================================================
+
+The authoritative site list is GENERATED from source (`scan_sites` /
+`site_table_md` below — docs/resilience.md's table is written by
+``python -m horovod_tpu.analysis --write-chaos-table`` and drift-pinned
+by a test), so a new site cannot ship undocumented.
 """
 
 from __future__ import annotations
@@ -269,6 +276,98 @@ def armed(spec: str, *, seed: int = 0):
         yield monkey
     finally:
         install(prev)
+
+
+# ---------------------------------------------------------------------------
+# The generated site table (docs/resilience.md). `_SITE_DOCS` holds the
+# one-line fault model per site; WHERE each site is instrumented is
+# scanned from source, so the docs table cannot drift from the code —
+# a site added without a `_SITE_DOCS` entry fails the drift test, and a
+# `_SITE_DOCS` entry whose site no longer exists is dropped from the
+# table (and fails the test too).
+# ---------------------------------------------------------------------------
+
+_SITE_DOCS: Dict[str, str] = {
+    "ckpt_write_fail": "checkpoint I/O failure (GCS 5xx, ENOSPC)",
+    "ckpt_kill": "process death DURING a save — after the staging "
+                 "write, before the atomic rename",
+    "train_crash": "process death mid-epoch — step done, nothing "
+                   "checkpointed yet",
+    "data_read_fail": "input-pipeline shard-open fault (read mode)",
+    "data_write_fail": "dataset-write shard-open fault "
+                       "(`write_shards`)",
+    "collective_slow": "slow/hung collective (dead peer rendezvous)",
+    "step_exception": "worker exception mid-step",
+    "grad_nan": "NaN gradients poisoning loss+params",
+    "serving_dispatch_crash": "serving dispatch thread dies",
+    "serving_tick_stall": "hung decode tick (cooperative: ends early "
+                          "once abandoned)",
+    "serving_deadline_storm": "every queued request's deadline "
+                              "expires at once",
+    "router.replica_kill": "abrupt replica death mid-stream — the "
+                           "router must migrate its in-flight "
+                           "requests token-exactly",
+}
+
+_SITE_CALL_RE = (r'(?:chaos\s*\.\s*)?(?:fires|slow_site)\(\s*'
+                 r'[\'"]([\w.]+)[\'"]')
+
+# Sites whose name is BUILT at runtime (the literal-call regex cannot
+# see them); only these get the quoted-name fallback in `scan_sites` —
+# scanning every documented name would let a mere mention of another
+# site in a hook-calling file fabricate an "instrumented in" row.
+_VARIABLE_SITES = ("data_read_fail", "data_write_fail")
+
+
+def scan_sites(root: Optional[str] = None) -> Dict[str, list]:
+    """{site: sorted relative paths that instrument it}, scanned from
+    the package source: literal ``chaos.fires("x")`` /
+    ``chaos.slow_site("x")`` calls, plus — for documented sites whose
+    name is built at runtime (the data read/write pair) — quoted
+    occurrences of the site name in files that call the hooks."""
+    import os
+    import re
+    if root is None:
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+    me = os.path.abspath(__file__)
+    sources = {}
+    for dirpath, _dirnames, filenames in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if os.path.abspath(path) == me:
+                continue   # this module's own docs/defs are not sites
+            with open(path, "r", encoding="utf-8") as f:
+                sources[os.path.relpath(path, root)] = f.read()
+    out: Dict[str, list] = {}
+    for rel, text in sources.items():
+        for site in re.findall(_SITE_CALL_RE, text):
+            out.setdefault(site, set()).add(rel)
+        if "chaos.fires(" in text or "chaos.slow_site(" in text:
+            for site in _VARIABLE_SITES:
+                if f'"{site}"' in text or f"'{site}'" in text:
+                    out.setdefault(site, set()).add(rel)
+    return {site: sorted(files) for site, files in sorted(out.items())}
+
+
+def site_table_md() -> str:
+    """The chaos-site table as GitHub markdown — the generated section
+    of docs/resilience.md (``python -m horovod_tpu.analysis
+    --write-chaos-table``; a drift test pins the doc to this exact
+    output). Undocumented scanned sites render loudly so the drift
+    test, not a reader, catches them first."""
+    rows = ["| site | instrumented in | fault modeled |",
+            "| --- | --- | --- |"]
+    for site, files in scan_sites().items():
+        doc = _SITE_DOCS.get(
+            site, "(UNDOCUMENTED — add to chaos._SITE_DOCS)")
+        where = ", ".join(f"`horovod_tpu/{f}`" for f in files)
+        rows.append(f"| `{site}` | {where} | {doc} |")
+    return "\n".join(rows) + "\n"
 
 
 def _env_seed() -> int:

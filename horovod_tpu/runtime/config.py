@@ -40,6 +40,16 @@ DEFAULT_PREFILL_CHUNK_BUDGET = 128
 # (num_slots x max_len / block_size — byte-parity with the fixed slot
 # pool); prefix cache on by default when paging is on.
 DEFAULT_KV_BLOCK_SIZE = 16
+# Serving fleet (docs/serving.md "Fleet failover"): the ServingRouter's
+# defaults — replica count, monitor sweep cadence (failover-detection
+# latency floor), cold-replacement budget, the TTFT quantile deriving
+# the hedge delay (<= 0 disables hedging), and the retry-budget token
+# bucket capacity for shed/failed submits.
+DEFAULT_ROUTER_REPLICAS = 2
+DEFAULT_ROUTER_POLL_S = 0.02
+DEFAULT_ROUTER_REPLACEMENTS = 4
+DEFAULT_HEDGE_QUANTILE = 0.95
+DEFAULT_RETRY_BUDGET = 16
 
 
 # ---------------------------------------------------------------------------
@@ -287,6 +297,37 @@ register_knob(
     "Collective dispatches per straggler timing-window exchange "
     "(0 disables the periodic exchange; windows still accumulate "
     "for the fleet collector)")
+register_knob(
+    "HVD_ROUTER_REPLICAS", "int", str(DEFAULT_ROUTER_REPLICAS),
+    "runtime/config.py",
+    "Serving fleet: ServingRouter replica count when the caller "
+    "doesn't pass num_replicas (bench --router / examples), "
+    "docs/serving.md 'Fleet failover'")
+register_knob(
+    "HVD_ROUTER_POLL", "float", str(DEFAULT_ROUTER_POLL_S),
+    "runtime/config.py",
+    "Serving fleet: router monitor sweep interval in seconds "
+    "(health checks, hedge scans, migration processing, chaos "
+    "kills) — the failover-detection latency floor")
+register_knob(
+    "HVD_ROUTER_REPLACEMENTS", "int", str(DEFAULT_ROUTER_REPLACEMENTS),
+    "runtime/config.py",
+    "Serving fleet: cold replacements the router may build for "
+    "dead/drained replicas over its lifetime (the factory-call "
+    "budget; the fleet shrinks once spent)")
+register_knob(
+    "HVD_HEDGE_QUANTILE", "float", str(DEFAULT_HEDGE_QUANTILE),
+    "runtime/config.py",
+    "Serving fleet: TTFT quantile (0, 1] deriving the hedge delay — "
+    "a request with no first token after the fleet's q-th TTFT "
+    "quantile is duplicated on a second replica and the loser "
+    "cancelled; <= 0 disables hedging")
+register_knob(
+    "HVD_RETRY_BUDGET", "int", str(DEFAULT_RETRY_BUDGET),
+    "runtime/config.py",
+    "Serving fleet: router retry-budget token-bucket capacity for "
+    "shed/failed submits (refills at capacity/60 per second; 0 "
+    "disables retries — first answer wins)")
 
 
 # ---------------------------------------------------------------------------
@@ -312,6 +353,12 @@ class Config:
     kv_block_size: int = DEFAULT_KV_BLOCK_SIZE
     kv_blocks: int = 0
     prefix_cache: bool = True
+    # Serving fleet (ServingRouter, docs/serving.md "Fleet failover").
+    router_replicas: int = DEFAULT_ROUTER_REPLICAS
+    router_poll_s: float = DEFAULT_ROUTER_POLL_S
+    router_replacements: int = DEFAULT_ROUTER_REPLACEMENTS
+    hedge_quantile: float = DEFAULT_HEDGE_QUANTILE
+    retry_budget: int = DEFAULT_RETRY_BUDGET
     # TPU-specific additions
     allreduce_dtype: str = ""          # e.g. "bfloat16" to reduce in bf16
     mesh_axis_name: str = "data"       # default 1-D data-parallel axis
@@ -342,6 +389,16 @@ class Config:
                                       DEFAULT_KV_BLOCK_SIZE)
         self.kv_blocks = _env_int("HVD_KV_BLOCKS", 0)
         self.prefix_cache = _env_int("HVD_PREFIX_CACHE", 1) != 0
+        self.router_replicas = _env_int("HVD_ROUTER_REPLICAS",
+                                        DEFAULT_ROUTER_REPLICAS)
+        self.router_poll_s = _env_float("HVD_ROUTER_POLL",
+                                        DEFAULT_ROUTER_POLL_S)
+        self.router_replacements = _env_int(
+            "HVD_ROUTER_REPLACEMENTS", DEFAULT_ROUTER_REPLACEMENTS)
+        self.hedge_quantile = _env_float("HVD_HEDGE_QUANTILE",
+                                         DEFAULT_HEDGE_QUANTILE)
+        self.retry_budget = _env_int("HVD_RETRY_BUDGET",
+                                     DEFAULT_RETRY_BUDGET)
         self.timeline_path = env_str("HOROVOD_TIMELINE")
         self.stall_warning_time = _env_float(
             "HOROVOD_STALL_CHECK_TIME", DEFAULT_STALL_WARNING_TIME)

@@ -16,6 +16,10 @@ The layer that turns concurrent requests into batched device work:
   follows ACTUAL lengths instead of num_slots x max_len, and a
   cache-hit system prompt skips its prefill
   (`ServingEngine(paged=True)`).
+* `router.ServingRouter` — fleet failover over N engine replicas:
+  health-gated + load-aware routing, retry budgets, hedged
+  slow-starters, and token-exact migration of in-flight streams off
+  dead replicas (docs/serving.md "Fleet failover").
 * `admission` — bounded queue, deadlines, cancellation, load shedding
   (degrade by shedding, never by hanging).
 * `metrics` — TTFT/TPOT/tokens-per-second with p50/p95, queue depth,
@@ -31,6 +35,9 @@ from horovod_tpu.serving.admission import (
 from horovod_tpu.serving.engine import RequestHandle, ServingEngine
 from horovod_tpu.serving.metrics import EngineMetrics
 from horovod_tpu.serving.paging import BlockPool, PagedSlotPool
+from horovod_tpu.serving.router import (
+    RetryBudget, RouterHandle, ServingRouter,
+)
 from horovod_tpu.serving.scheduler import (
     CompletedRequest, ContinuousBatchingScheduler,
 )
@@ -42,4 +49,5 @@ __all__ = [
     "AdmissionQueue", "EngineMetrics", "ServingError",
     "QueueFullError", "DeadlineExceededError", "EngineClosedError",
     "Admission", "BlockPool", "PagedSlotPool",
+    "ServingRouter", "RouterHandle", "RetryBudget",
 ]

@@ -16,9 +16,11 @@ from __future__ import annotations
 import collections
 import threading
 import time
-from concurrent.futures import CancelledError
+from concurrent.futures import CancelledError, InvalidStateError
 from dataclasses import dataclass, field
 from typing import Any, List, Optional
+
+import numpy as np
 
 
 class ServingError(RuntimeError):
@@ -103,15 +105,46 @@ class Request:
     # CompletedRequest — the per-request cache-hit evidence the bench
     # and the ci.sh --prefix-check read.
     prefix_cached: int = 0
+    # Token-exact continuation (docs/serving.md "Fleet failover"): a
+    # request migrated off a dead replica is resubmitted with the
+    # tokens it had already generated as a FORCED prefix — prefilled
+    # (teacher-forced) into the cache after the prompt, counted
+    # against max_new_tokens, and pre-seeded into ``tokens`` so the
+    # caller's stream continues without a seam. The sample stream
+    # resumes at ordinal len(forced) (`SlotPool.finish_prefill`'s
+    # rng_skip), so the continuation is bitwise the original's.
+    forced: tuple = ()
     tokens: List[int] = field(default_factory=list)  # generated so far
     _cancel: threading.Event = field(default_factory=threading.Event)
+    # Set by AdmissionQueue.offer/requeue: lets cancel() release the
+    # queue slot IMMEDIATELY instead of at the next dispatcher sweep
+    # (hedging cancels queued losers and needs the capacity back now).
+    _on_cancel: Any = field(default=None, repr=False, compare=False)
+
+    @property
+    def full_prompt(self) -> np.ndarray:
+        """prompt ++ forced — what actually prefills into the cache
+        (and what the paged pool's prefix matcher sees)."""
+        if not self.forced:
+            return np.asarray(self.prompt)
+        return np.concatenate([
+            np.asarray(self.prompt),
+            np.asarray(self.forced, np.asarray(self.prompt).dtype)])
+
+    @property
+    def remaining_new(self) -> int:
+        """Decode budget left after the forced prefix."""
+        return self.max_new_tokens - len(self.forced)
 
     def cancel(self):
-        """Request cancellation. Queued requests are dropped at the
-        next queue pop; running requests retire (and free their slot)
-        at the next decode tick. The future then raises
-        `concurrent.futures.CancelledError`."""
+        """Request cancellation. Queued requests are dropped (and
+        their admission slot released) immediately; running requests
+        retire (freeing their slot) at the next decode tick. The
+        future then raises `concurrent.futures.CancelledError`."""
         self._cancel.set()
+        cb = self._on_cancel
+        if cb is not None:
+            cb(self)
 
     @property
     def cancelled(self) -> bool:
@@ -141,6 +174,11 @@ class AdmissionQueue:
         self._lock = threading.Lock()
         self._event = threading.Event()
         self._closed = False
+        # Metrics/tracing hook for drops resolved OUTSIDE a dispatcher
+        # call (the cancel fast path below); the scheduler installs
+        # its `_queue_drop` here so a cancel-released request is
+        # counted exactly like a swept one.
+        self.on_drop = None
 
     def __len__(self) -> int:
         return len(self._q)
@@ -167,16 +205,36 @@ class AdmissionQueue:
                     f"admission queue full ({self.max_depth} requests "
                     f"waiting); request {req.id} shed")
             self._q.append(req)
+            # Armed under the lock so a cancel landing after submit
+            # returns finds the request already discardable.
+            req._on_cancel = self._discard_cancelled
         self._event.set()
+
+    def _discard_cancelled(self, req: Request):
+        """`Request.cancel()`'s fast path: drop a still-queued request
+        and release its admission slot NOW, not at the dispatcher's
+        next sweep — a hedge's cancelled loser must not hold queue
+        capacity against live traffic. No-op if the dispatcher already
+        popped it (the running-request cancel path retires it at the
+        next tick as before)."""
+        with self._lock:
+            try:
+                self._q.remove(req)
+            except ValueError:
+                return   # already popped/swept — the dispatcher owns it
+        self._resolve_dead(req, "cancelled", time.time(), self.on_drop)
 
     @staticmethod
     def _resolve_dead(req: Request, kind: str, now: float, on_drop):
-        if kind == "cancelled":
-            req.future.set_exception(CancelledError())
-        else:
-            req.future.set_exception(DeadlineExceededError(
-                f"request {req.id}: deadline passed after "
-                f"{now - req.t_submit:.3f}s in queue"))
+        try:
+            if kind == "cancelled":
+                req.future.set_exception(CancelledError())
+            else:
+                req.future.set_exception(DeadlineExceededError(
+                    f"request {req.id}: deadline passed after "
+                    f"{now - req.t_submit:.3f}s in queue"))
+        except InvalidStateError:
+            return   # cancel raced another resolver; first one counted
         if on_drop is not None:
             on_drop(req, kind)
 
@@ -235,6 +293,7 @@ class AdmissionQueue:
             if not self._closed:
                 for r in reversed(reqs):
                     self._q.appendleft(r)
+                    r._on_cancel = self._discard_cancelled
         for req in doomed:
             if not req.future.done():
                 req.future.set_exception(EngineClosedError(

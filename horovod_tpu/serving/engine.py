@@ -383,13 +383,27 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0,
                top_p: Optional[float] = None, seed: int = 0,
-               timeout_s: Optional[float] = None) -> RequestHandle:
+               timeout_s: Optional[float] = None,
+               forced_prefix=None,
+               trace_id: Optional[str] = None) -> RequestHandle:
         """Enqueue one generation request; returns immediately.
 
         Raises `QueueFullError` when the admission queue is at
         capacity (load shedding — never blocks the caller) and
         `EngineClosedError` after shutdown. Validation errors raise
         before the request is queued.
+
+        ``forced_prefix`` is the token-exact continuation hook
+        (docs/serving.md "Fleet failover"): tokens a previous engine
+        already generated for this request. They are teacher-forced
+        into the KV cache after the prompt (never re-sampled), count
+        against ``max_new_tokens``, pre-seed the handle's
+        ``tokens_so_far()``/result stream, and the sample stream
+        resumes at ordinal len(forced_prefix) — so the completed
+        stream is bitwise what an uninterrupted run would have
+        produced. ``trace_id`` overrides the minted observability id
+        so a migrated/hedged request keeps its original identity
+        across engines.
         """
         prompt = np.asarray(prompt)
         if prompt.ndim != 1 or prompt.shape[0] == 0:
@@ -403,6 +417,22 @@ class ServingEngine:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        forced = ()
+        if forced_prefix is not None and len(forced_prefix):
+            fp = np.asarray(forced_prefix)
+            if fp.ndim != 1 or not np.issubdtype(fp.dtype, np.integer):
+                raise ValueError(
+                    f"forced_prefix must be a 1-D integer token "
+                    f"array, got shape {fp.shape} dtype {fp.dtype}")
+            if fp.shape[0] >= max_new_tokens:
+                raise ValueError(
+                    f"forced_prefix ({fp.shape[0]} tokens) leaves no "
+                    f"decode budget (max_new_tokens={max_new_tokens})")
+            if self.eos_id is not None and self.eos_id in fp:
+                raise ValueError(
+                    f"forced_prefix contains eos_id={self.eos_id} — "
+                    f"the original stream already finished")
+            forced = tuple(int(t) for t in fp)
         P = int(prompt.shape[0])
         unbounded = (self.model.pos_emb == "rope"
                      and self.model.window is not None)
@@ -410,7 +440,8 @@ class ServingEngine:
             raise ValueError(
                 f"prompt ({P}) + max_new_tokens ({max_new_tokens}) - 1 "
                 f"exceeds max_len={self.model.max_len}")
-        if self.paged and not self.pool.fits(P, max_new_tokens):
+        if self.paged and not self.pool.fits(
+                P + len(forced), max_new_tokens - len(forced)):
             # A request whose WORST-CASE block need exceeds the whole
             # pool could never admit — it would park at the queue head
             # starving everything behind it. Shed at the front door
@@ -431,8 +462,9 @@ class ServingEngine:
             id=next(self._ids), prompt=prompt,
             max_new_tokens=max_new_tokens, sampling=sampling,
             deadline=None if timeout_s is None else now + timeout_s,
-            future=Future(), trace_id=_tracing.new_trace_id(),
-            t_submit=now)
+            future=Future(),
+            trace_id=trace_id or _tracing.new_trace_id(),
+            t_submit=now, forced=forced, tokens=list(forced))
         self.metrics.count("submitted")
         _span("begin_span", req.id, "QUEUE", trace_id=req.trace_id)
         try:
@@ -617,10 +649,13 @@ class ServingEngine:
                 # hung tick cannot corrupt the replay. prefix_cached
                 # resets too: the successor pool's cache starts COLD
                 # (untrusted device state), so the replay's own
-                # re-admission decides what it skips.
+                # re-admission decides what it skips. A forced-prefix
+                # continuation re-seeds its tokens with the forced
+                # span — those were generated by an earlier engine
+                # and are part of the stream contract, not replayed.
                 requeued.append(dataclasses.replace(
-                    req, tokens=[], t_prefill=0.0, t_first=0.0,
-                    prefix_cached=0))
+                    req, tokens=list(req.forced), t_prefill=0.0,
+                    t_first=0.0, prefix_cached=0))
         n = self.queue.requeue(requeued)
         self.metrics.count("restarts")
         if n:

@@ -657,13 +657,15 @@ class PagedSlotPool:
             self.maybe_compiling = False
 
     def finish_prefill(self, slot: int, logits, temperature: float,
-                       top_p: Optional[float], seed: int) -> int:
+                       top_p: Optional[float], seed: int, *,
+                       rng_skip: int = 0) -> int:
         """Close a prefill exactly as the slot pool does (same
         `_first_token` split discipline — request streams are
-        reproducible wherever they land), then PUBLISH the prompt's
-        full blocks to the prefix index: from this moment an identical
-        block-aligned prefix is a cache hit, even while this request
-        is still decoding."""
+        reproducible wherever they land, and ``rng_skip`` resumes a
+        forced-prefix continuation's stream mid-way), then PUBLISH the
+        prompt's full blocks to the prefix index: from this moment an
+        identical block-aligned prefix is a cache hit, even while this
+        request is still decoding."""
         self.maybe_compiling = (
             ("first_token",) not in self._seen_shapes)
         try:
@@ -671,7 +673,8 @@ class PagedSlotPool:
                 temp = jnp.float32(temperature)
                 tp = jnp.float32(1.0 if top_p is None else top_p)
                 tok, rng = _first_token(logits, temp, tp,
-                                        jax.random.PRNGKey(seed))
+                                        jax.random.PRNGKey(seed),
+                                        jnp.int32(rng_skip))
                 self._note_shape(("first_token",))
                 self._toks = self._toks.at[slot].set(tok)
                 self._temps = self._temps.at[slot].set(temp)

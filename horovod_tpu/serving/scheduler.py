@@ -89,9 +89,13 @@ class CompletedRequest:
 class _PrefillJob:
     """One partially prefilled slot: the request, its remaining chunk
     schedule, and the last chunk's logits (device array — the first
-    token is sampled from them when the schedule drains)."""
+    token is sampled from them when the schedule drains). ``prompt``
+    is the FULL prefill stream — the submitted prompt plus any forced
+    continuation prefix (token-exact migration) — computed once at
+    admission."""
 
     req: Request
+    prompt: Any                   # np.ndarray: req.full_prompt
     chunks: List[int]             # remaining chunk token counts
     off: int = 0                  # prompt tokens already streamed
     logits: Any = None
@@ -173,6 +177,10 @@ class ContinuousBatchingScheduler:
         self.active: Dict[int, Request] = {}   # slot -> request
         self.prefilling: Dict[int, _PrefillJob] = {}
         self._prefill_order: List[int] = []    # FIFO over prefilling
+        # Cancel fast path (admission.py): a cancelled QUEUED request
+        # resolves and releases its slot immediately, and its drop
+        # must count exactly like a swept one.
+        queue.on_drop = self._queue_drop
         self._pending: Optional[_PendingTick] = None
         # Set (only through `abandon()`) by the engine watchdog when
         # this scheduler's dispatch thread is declared dead/stuck and
@@ -352,7 +360,7 @@ class ContinuousBatchingScheduler:
                 head = self.queue.peek_ready(now,
                                              on_drop=self._queue_drop)
                 if head is None or not self.pool.can_admit(
-                        head.prompt, head.max_new_tokens):
+                        head.full_prompt, head.remaining_new):
                     break
                 req = self.queue.pop_ready(now, on_drop=self._queue_drop)
                 if req is None:
@@ -366,6 +374,10 @@ class ContinuousBatchingScheduler:
                 # successor requeues it) or the abandon is visible here
                 # (we hand it straight back to the queue).
                 blocked = None
+                # The prefill stream: prompt plus any forced
+                # continuation prefix (token-exact migration) — the
+                # prefix matcher and the chunk schedule both see it.
+                full = req.full_prompt
                 with self._handoff:
                     if self.abandoned:
                         blocked = req
@@ -374,16 +386,15 @@ class ContinuousBatchingScheduler:
                         # reserves the rest; None only if the popped
                         # request differs from the peeked head (a
                         # cancel raced in between) AND doesn't fit.
-                        adm = self.pool.admit(req.prompt,
-                                              req.max_new_tokens)
+                        adm = self.pool.admit(full, req.remaining_new)
                         if adm is None:
                             blocked = req
                         else:
                             slot = adm.slot
                             job = _PrefillJob(
-                                req=req,
+                                req=req, prompt=full,
                                 chunks=prefill_schedule(
-                                    int(req.prompt.shape[0])
+                                    int(full.shape[0])
                                     - adm.skipped, self._max_chunk),
                                 off=adm.skipped)
                             self.prefilling[slot] = job
@@ -426,7 +437,7 @@ class ContinuousBatchingScheduler:
                                   or job.chunks[0] <= left):
                 c = job.chunks.pop(0)
                 job.logits = self.pool.prefill_chunk(
-                    slot, job.req.prompt[job.off:job.off + c])
+                    slot, job.prompt[job.off:job.off + c])
                 job.off += c
                 self.metrics.count("prefill_chunks")
                 self.metrics.count("prefill_tokens", c)
@@ -447,9 +458,16 @@ class ContinuousBatchingScheduler:
         (atomically vs a watchdog abandon), handle instant retirement
         (first token is eos, budget of 1, expired mid-prefill)."""
         req = job.req
+        # A forced-prefix continuation resumes the request's sample
+        # stream at ordinal len(forced): the tokens teacher-forced
+        # into the cache each consumed one rng split in the original
+        # stream, so the first token sampled HERE is the original's
+        # token len(forced)+1, bitwise (rng_skip; docs/serving.md
+        # "Fleet failover").
         first = self.pool.finish_prefill(
             slot, job.logits, req.sampling.temperature,
-            req.sampling.top_p, req.sampling.seed)
+            req.sampling.top_p, req.sampling.seed,
+            rng_skip=len(req.forced))
         self.metrics.count("host_syncs")
         with self._handoff:
             if self.abandoned:

@@ -58,12 +58,23 @@ from horovod_tpu.parallel.mesh import use
 
 
 @jax.jit
-def _first_token(logits, temp, top_p, key):
+def _first_token(logits, temp, top_p, key, skips):
     """First-token sample closing the prefill: split the request key
     exactly as `generate` does (``rng, r0 = split(key)``; the tick
     keeps splitting ``rng``), so a request's sample stream is
     reproducible from its seed regardless of which slot it lands in or
-    what else shares the batch."""
+    what else shares the batch.
+
+    ``skips`` (traced int32, normally 0) advances the key by that many
+    carry-splits FIRST — the forced-prefix continuation hook
+    (docs/serving.md "Fleet failover"): a request resubmitted with its
+    first k generated tokens folded into the prompt must sample token
+    k+1 from the SAME r_k the original stream would have used, since
+    the per-request stream is keyed by token ordinal (each token
+    consumes one ``rng, r = split(rng)``), not by position. A traced
+    bound keeps this one compiled program for every k."""
+    key = jax.lax.fori_loop(
+        0, skips, lambda i, k: jax.random.split(k)[0], key)
     rng, r0 = jax.random.split(key)
     tok = sample_token(logits, temp, top_p, r0)
     return tok.astype(jnp.int32), rng
@@ -285,11 +296,15 @@ class SlotPool:
             self.maybe_compiling = False
 
     def finish_prefill(self, slot: int, logits, temperature: float,
-                       top_p: Optional[float], seed: int) -> int:
+                       top_p: Optional[float], seed: int, *,
+                       rng_skip: int = 0) -> int:
         """Close a prefill: sample the request's FIRST token from the
         final chunk's ``logits``, install the slot's tick-side
         sampling state, and mark the lane live. The int() readback is
-        the one per-request host sync (TTFT wants the token now)."""
+        the one per-request host sync (TTFT wants the token now).
+        ``rng_skip`` (default 0) resumes the request's sample stream
+        ``rng_skip`` tokens in — the forced-prefix continuation used
+        by token-exact request migration (`_first_token`)."""
         self.maybe_compiling = (
             ("first_token",) not in self._seen_shapes)
         try:
@@ -297,7 +312,8 @@ class SlotPool:
                 temp = jnp.float32(temperature)
                 tp = jnp.float32(1.0 if top_p is None else top_p)
                 tok, rng = _first_token(logits, temp, tp,
-                                        jax.random.PRNGKey(seed))
+                                        jax.random.PRNGKey(seed),
+                                        jnp.int32(rng_skip))
                 self._note_shape(("first_token",))
                 self._toks = self._toks.at[slot].set(tok)
                 self._temps = self._temps.at[slot].set(temp)

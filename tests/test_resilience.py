@@ -19,7 +19,9 @@ rank-0-only solo-save path (`_solo_mp_options`) is pinned.
 
 import os
 import signal
+import threading
 import time
+from concurrent.futures import CancelledError
 
 import numpy as np
 import pytest
@@ -784,3 +786,91 @@ class TestServingSelfHealing:
         assert snap["restarts"] == 0
         assert snap["requeued"] == 0
         assert snap["completed"] == 4
+
+
+class TestShutdownDuringRestart:
+    def test_drain_shutdown_racing_watchdog_restart(self, lm):
+        """Race pin (docs/serving.md 'Fleet failover' satellite):
+        `shutdown(drain=True)` issued WHILE the watchdog is healing a
+        dispatch crash must neither deadlock nor drop the requeued
+        requests — every future resolves (completed, or failed with a
+        typed error), and the join never hangs. Stressed across
+        several crash timings; unbounded crash injection (count=-1,
+        p<1) makes some iterations exhaust the restart budget and
+        contain, which must ALSO resolve every future."""
+        from horovod_tpu.serving import ServingEngine
+        from horovod_tpu.serving.admission import (
+            DeadlineExceededError as DE, EngineClosedError as ECE,
+        )
+        model, params = lm
+        prompts = _prompts(4, seed=11)
+        for trial, spec in enumerate((
+                "serving_dispatch_crash:1",
+                "serving_dispatch_crash:2",
+                "serving_dispatch_crash:-1:p=0.4")):
+            eng = ServingEngine(model, params, num_slots=2,
+                                max_queue=16, auto_restart=True,
+                                max_restarts=2)
+            handles = [eng.submit(p, 12) for p in prompts]
+            _wait(lambda: eng.pool.busy_slots > 0)
+            with chaos.armed(spec, seed=trial):
+                # Give the crash a beat to land mid-flight, then
+                # shut down WHILE the watchdog may be mid-restart.
+                time.sleep(0.02 * (trial + 1))
+                done = threading.Event()
+
+                def _shutdown():
+                    eng.shutdown(drain=True, timeout=120)
+                    done.set()
+
+                t = threading.Thread(target=_shutdown, daemon=True)
+                t.start()
+                t.join(timeout=180)
+                assert done.is_set(), (
+                    f"trial {trial}: shutdown(drain=True) deadlocked "
+                    f"racing the watchdog restart")
+            for h in handles:
+                # Resolved, one way or another — never dangling.
+                try:
+                    out = h.result(timeout=60)
+                    assert out.finish_reason in ("eos", "length")
+                except (ECE, DE, CancelledError):
+                    pass   # typed failure = resolved, contract held
+
+
+class TestChaosSiteTable:
+    def test_every_scanned_site_documented(self, hvd):
+        """A chaos site added to the code without a `_SITE_DOCS`
+        entry must fail here, not ship undocumented."""
+        table = chaos.site_table_md()
+        assert "UNDOCUMENTED" not in table, table
+        sites = set(chaos.scan_sites())
+        assert sites == set(chaos._SITE_DOCS), (
+            "chaos._SITE_DOCS out of sync with the scanned sites",
+            sites ^ set(chaos._SITE_DOCS))
+
+    def test_known_sites_scanned(self, hvd):
+        sites = chaos.scan_sites()
+        for site in ("serving_dispatch_crash", "router.replica_kill",
+                     "train_crash", "ckpt_kill", "data_read_fail",
+                     "collective_slow"):
+            assert site in sites, (site, sorted(sites))
+        assert any("router.py" in f
+                   for f in sites["router.replica_kill"])
+
+    def test_docs_table_pinned_to_generator(self, hvd):
+        """docs/resilience.md's generated section == the live
+        generator output (regenerate with `python -m
+        horovod_tpu.analysis --write-chaos-table`)."""
+        import os
+        doc = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "docs", "resilience.md")
+        with open(doc, encoding="utf-8") as fh:
+            text = fh.read()
+        begin = "<!-- hvdlint:chaos-table:begin -->"
+        end = "<!-- hvdlint:chaos-table:end -->"
+        assert begin in text and end in text
+        span = text.split(begin, 1)[1].split(end, 1)[0]
+        assert span == "\n" + chaos.site_table_md(), (
+            "docs/resilience.md chaos-site table drifted; run "
+            "python -m horovod_tpu.analysis --write-chaos-table")

@@ -1,0 +1,632 @@
+"""Serving fleet failover tests (`serving/router.py`).
+
+Two test surfaces:
+
+* **Real engines** — the robustness heart: replica death mid-decode
+  must be invisible AND token-exact. The oracle is a no-chaos run of
+  the same (prompt, seed) set: deterministic decode means the chaos
+  leg's streams must be bitwise identical, whatever the kill point
+  (the migration-equivalence property test sweeps prompts x kill
+  points).
+* **Scripted fake replicas** — the policy half (health gating, load
+  awareness, retry budget, hedging) needs failures on demand that a
+  real engine only produces probabilistically; the fakes implement
+  exactly the engine surface the router consumes (`submit`,
+  `_health`, `queue_depth`, `pool.busy_slots`, `slo`, `shutdown`)
+  with scripted sheds/delays/deaths.
+"""
+
+import threading
+import time
+from concurrent.futures import CancelledError, Future
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.resilience import chaos
+from horovod_tpu.models.transformer import TransformerLM
+from horovod_tpu.parallel.tensor import unbox
+from horovod_tpu.serving import (
+    CompletedRequest, DeadlineExceededError, EngineClosedError,
+    QueueFullError, RetryBudget, ServingEngine, ServingRouter,
+)
+from horovod_tpu.serving.router import (
+    REPLICA_DEAD, REPLICA_DRAINING, REPLICA_UP,
+)
+
+VOCAB = 64
+MAX_LEN = 64
+
+
+def _model():
+    return TransformerLM(vocab_size=VOCAB, num_layers=2, num_heads=4,
+                         head_dim=8, max_len=MAX_LEN,
+                         dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def lm(hvd):
+    model = _model()
+    params = unbox(model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 16), jnp.int32))["params"])
+    return model, params
+
+
+def _prompts(n, seed=0, lo=2, hi=8):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, VOCAB, (int(rs.randint(lo, hi)),))
+            for _ in range(n)]
+
+
+def _wait(cond, timeout=120.0, dt=0.005):
+    t0 = time.time()
+    while not cond():
+        if time.time() - t0 > timeout:
+            raise AssertionError("condition not reached in time")
+        time.sleep(dt)
+
+
+def _reference_streams(model, params, prompts, steps, temperature,
+                       seeds):
+    """No-chaos oracle: one plain engine serves the same requests."""
+    refs = []
+    with ServingEngine(model, params, num_slots=2,
+                       max_queue=2 * len(prompts) + 2) as eng:
+        hs = [eng.submit(p, steps, temperature=temperature, seed=s)
+              for p, s in zip(prompts, seeds)]
+        for h in hs:
+            refs.append(list(h.result(timeout=300).tokens))
+    return refs
+
+
+def _factory(model, params, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_queue", 16)
+    return lambda: ServingEngine(model, params, **kw)
+
+
+class TestRouterOracle:
+    def test_fleet_token_exact_and_load_spread(self, lm):
+        """N=2 replicas serve a mixed batch token-exactly, and both
+        replicas actually take work (load-aware placement)."""
+        model, params = lm
+        prompts = _prompts(8, seed=0)
+        seeds = list(range(8))
+        refs = _reference_streams(model, params, prompts, 10, 0.7,
+                                  seeds)
+        with ServingRouter(_factory(model, params), num_replicas=2,
+                           health_poll_s=0.01) as router:
+            hs = [router.submit(p, 10, temperature=0.7, seed=s)
+                  for p, s in zip(prompts, seeds)]
+            results = [h.result(timeout=300) for h in hs]
+            spread = [router.engine_of(rid).metrics_snapshot()
+                      ["submitted"] for rid in router.replicas()]
+        for r, ref in zip(results, refs):
+            assert list(r.tokens) == ref
+        snap = router.metrics_snapshot()
+        assert snap["completed"] == 8
+        assert snap["migrations"] == 0
+        assert all(n > 0 for n in spread), (
+            "a replica took no work — load-aware routing broken",
+            spread)
+
+    def test_kill_mid_decode_migrates_token_exact(self, lm):
+        """Abrupt replica death with streams mid-decode: every
+        request completes, migrated streams are bitwise the no-chaos
+        oracle's, trace_ids survive, the dead replica is
+        cold-replaced."""
+        model, params = lm
+        prompts = _prompts(6, seed=3)
+        seeds = list(range(6))
+        steps = 30
+        refs = _reference_streams(model, params, prompts, steps, 0.7,
+                                  seeds)
+        with ServingRouter(_factory(model, params), num_replicas=2,
+                           health_poll_s=0.01) as router:
+            hs = [router.submit(p, steps, temperature=0.7, seed=s)
+                  for p, s in zip(prompts, seeds)]
+            _wait(lambda: any(len(h.tokens_so_far()) >= 3
+                              for h in hs))
+            victim = max(
+                router.replicas(),
+                key=lambda rid: router.engine_of(rid).pool.busy_slots)
+            router.kill_replica(victim)
+            results = [h.result(timeout=300) for h in hs]
+            # Migrations land before the cold replacement (streams
+            # are prioritized over the factory build) — wait for the
+            # fleet to restore before asserting on it.
+            _wait(lambda: router.metrics_snapshot()
+                  ["replacements"] == 1)
+            snap = router.metrics_snapshot()
+        for h, r, ref in zip(hs, results, refs):
+            assert list(r.tokens) == ref
+            assert r.trace_id == h.trace_id
+        assert snap["completed"] == 6
+        assert snap["replica_deaths"] == 1
+        assert snap["migrations"] >= 1
+        assert snap["replacements"] == 1
+        migrated = [h for h in hs if h.migrations() > 0]
+        assert migrated, "the kill caught no stream mid-flight"
+
+    @pytest.mark.parametrize("kill_at", [1, 4, 9])
+    def test_migration_equivalence_property(self, lm, kill_at):
+        """The acceptance property (prompts x kill points): kill the
+        victim's replica once its stream reaches ``kill_at`` tokens;
+        the final streams — all of them, not just the victim's — must
+        be bitwise the no-chaos oracle's. Seeded sampling, so the
+        continuation must resume the per-request RNG mid-stream."""
+        model, params = lm
+        prompts = _prompts(3, seed=40 + kill_at)
+        seeds = [7, 11, 13]
+        # Plenty of decode runway past the last kill point (plus a
+        # sub-tick _wait poll below): the kill must land while the
+        # victim is demonstrably mid-stream, not racing completion.
+        steps = 24
+        refs = _reference_streams(model, params, prompts, steps, 0.9,
+                                  seeds)
+        with ServingRouter(_factory(model, params), num_replicas=2,
+                           health_poll_s=0.01) as router:
+            hs = [router.submit(p, steps, temperature=0.9, seed=s)
+                  for p, s in zip(prompts, seeds)]
+            victim = hs[0]
+            _wait(lambda: len(victim.tokens_so_far()) >= kill_at,
+                  dt=0.0005)
+            with router._lock:
+                rid = router._requests[
+                    victim.id].attempts[0].replica_id
+            router.kill_replica(rid)
+            results = [h.result(timeout=300) for h in hs]
+            snap = router.metrics_snapshot()
+        for r, ref in zip(results, refs):
+            assert list(r.tokens) == ref
+        assert snap["completed"] == 3
+        assert snap["migrations"] >= 1
+
+    def test_chaos_site_kills_and_streams_survive(self, lm):
+        """The HVD_CHAOS path: arming ``router.replica_kill`` once
+        streams are in flight kills the busiest replica from the
+        monitor loop; all requests still complete token-exactly."""
+        model, params = lm
+        prompts = _prompts(6, seed=9)
+        seeds = list(range(6))
+        steps = 24
+        refs = _reference_streams(model, params, prompts, steps, 0.6,
+                                  seeds)
+        with ServingRouter(_factory(model, params), num_replicas=2,
+                           health_poll_s=0.01) as router:
+            hs = [router.submit(p, steps, temperature=0.6, seed=s)
+                  for p, s in zip(prompts, seeds)]
+            _wait(lambda: any(len(h.tokens_so_far()) >= 2
+                              for h in hs))
+            with chaos.armed("router.replica_kill:1") as monkey:
+                _wait(lambda: monkey.fired("router.replica_kill") == 1)
+                results = [h.result(timeout=300) for h in hs]
+            snap = router.metrics_snapshot()
+        for r, ref in zip(results, refs):
+            assert list(r.tokens) == ref
+        assert monkey.fired("router.replica_kill") == 1
+        assert snap["replica_deaths"] == 1
+        assert snap["completed"] == 6
+
+    def test_last_replica_death_recovers_via_replacement(self, lm):
+        """Killing the ONLY replica mid-stream: the migration defers
+        until the cold replacement comes up (never failing the
+        stream), and the continuation stays bitwise-exact."""
+        model, params = lm
+        prompt = _prompts(1, seed=31)[0]
+        refs = _reference_streams(model, params, [prompt], 20, 0.5,
+                                  [1])
+        with ServingRouter(_factory(model, params), num_replicas=1,
+                           health_poll_s=0.01) as router:
+            h = router.submit(prompt, 20, temperature=0.5, seed=1)
+            _wait(lambda: len(h.tokens_so_far()) >= 4)
+            router.kill_replica(list(router.replicas())[0])
+            res = h.result(timeout=300)
+            snap = router.metrics_snapshot()
+            # New work lands on the replacement too.
+            router.submit(_prompts(1, seed=32)[0], 4).result(
+                timeout=300)
+        assert list(res.tokens) == refs[0]
+        assert snap["migrations"] == 1
+        assert snap["migrated_tokens"] >= 4
+        assert snap["replacements"] == 1
+
+    def test_drain_cold_replaces_and_takes_no_new_work(self, lm):
+        """`drain()`: the draining replica takes no NEW work, its
+        in-flight request finishes (never aborted), and it is shut
+        down + cold-replaced once idle."""
+        model, params = lm
+        with ServingRouter(_factory(model, params), num_replicas=2,
+                           health_poll_s=0.01) as router:
+            ids0 = set(router.replicas())
+            h0 = router.submit(_prompts(1, seed=1)[0], 20)
+            _wait(lambda: len(h0.tokens_so_far()) >= 1)
+            with router._lock:
+                drain_rid = router._requests[
+                    h0.id].attempts[0].replica_id
+            router.drain(drain_rid)
+            assert router.replicas()[drain_rid] == REPLICA_DRAINING
+            # New work avoids the draining replica.
+            other = next(r for r in ids0 if r != drain_rid)
+            hs = [router.submit(p, 4) for p in _prompts(3, seed=2)]
+            for h in hs:
+                h.result(timeout=300)
+            assert router.engine_of(other).metrics_snapshot()[
+                "submitted"] >= 3
+            assert h0.result(timeout=300).finish_reason in (
+                "eos", "length")
+            _wait(lambda: drain_rid not in router.replicas())
+            snap = router.metrics_snapshot()
+            assert snap["replacements"] == 1
+            assert snap["replica_deaths"] == 0   # drain is not death
+            states = router.replicas()
+            assert len(states) == 2
+            assert all(s == REPLICA_UP for s in states.values())
+
+    def test_deadline_propagates_through_router(self, lm):
+        model, params = lm
+        with ServingRouter(_factory(model, params, num_slots=1),
+                           num_replicas=1,
+                           health_poll_s=0.01) as router:
+            blocker = router.submit(_prompts(1, seed=5)[0], 40)
+            h = router.submit(_prompts(1, seed=6)[0], 40,
+                              timeout_s=0.05)
+            with pytest.raises(DeadlineExceededError):
+                h.result(timeout=120)
+            blocker.result(timeout=300)
+
+    def test_cancel_through_router(self, lm):
+        model, params = lm
+        with ServingRouter(_factory(model, params, num_slots=1),
+                           num_replicas=1,
+                           health_poll_s=0.01) as router:
+            blocker = router.submit(_prompts(1, seed=5)[0], 30)
+            queued = router.submit(_prompts(1, seed=6)[0], 30)
+            queued.cancel()
+            with pytest.raises(CancelledError):
+                queued.result(timeout=120)
+            blocker.result(timeout=300)
+            assert router.metrics_snapshot()["cancelled"] == 1
+
+    def test_submit_after_shutdown_rejected(self, lm):
+        model, params = lm
+        router = ServingRouter(_factory(model, params),
+                               num_replicas=1, health_poll_s=0.01)
+        router.shutdown()
+        with pytest.raises(EngineClosedError):
+            router.submit(_prompts(1)[0], 4)
+
+
+# ---------------------------------------------------------------------------
+# Scripted fake replicas: the policy half.
+# ---------------------------------------------------------------------------
+
+def _fake_stream(prompt, seed, n):
+    """The deterministic stream every fake computes — same
+    (prompt, seed) => same tokens, like real decode."""
+    base = int(np.asarray(prompt).sum()) + 31 * seed
+    return [(base + i) % 97 for i in range(n)]
+
+
+class _FakeHandle:
+    def __init__(self, req):
+        self._req = req
+
+    @property
+    def future(self):
+        return self._req["future"]
+
+    @property
+    def trace_id(self):
+        return self._req["trace_id"]
+
+    def tokens_so_far(self):
+        return list(self._req["tokens"])
+
+    def cancel(self):
+        self._req["cancelled"] = True
+        self._req["engine"].cancels += 1
+        fut = self._req["future"]
+        if not fut.done():
+            fut.set_exception(CancelledError())
+
+
+class _FakePool:
+    busy_slots = 0
+
+
+class FakeEngine:
+    """Exactly the engine surface the router consumes, scripted:
+    ``ttft_s`` delays the first token, ``shed_next`` sheds that many
+    submits, ``healthy``/``die()`` drive the health probe, and a
+    worker thread feeds tokens at ``tpot_s`` cadence."""
+
+    def __init__(self, *, ttft_s=0.0, tpot_s=0.001, shed_next=0,
+                 healthy=True):
+        self.ttft_s = ttft_s
+        self.tpot_s = tpot_s
+        self.shed_next = shed_next
+        self.healthy = healthy
+        self.slo = None
+        self.pool = _FakePool()
+        self.submitted = 0
+        self.cancels = 0
+        self._reqs = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    @property
+    def queue_depth(self):
+        with self._lock:
+            return len([r for r in self._reqs
+                        if not r["future"].done()])
+
+    def _health(self):
+        return {"healthy": self.healthy and not self._stop.is_set()}
+
+    def submit(self, prompt, max_new_tokens, *, temperature=0.0,
+               top_p=None, seed=0, timeout_s=None, forced_prefix=None,
+               trace_id=None):
+        with self._lock:
+            if self._stop.is_set():
+                raise EngineClosedError("fake closed")
+            if self.shed_next > 0:
+                self.shed_next -= 1
+                raise QueueFullError("fake shed")
+            self.submitted += 1
+            forced = list(forced_prefix or [])
+            req = {
+                "prompt": np.asarray(prompt), "max_new": max_new_tokens,
+                "seed": seed, "tokens": list(forced),
+                "forced": len(forced), "future": Future(),
+                "trace_id": trace_id or "fake", "t0": time.time(),
+                "cancelled": False, "engine": self,
+            }
+            self._reqs.append(req)
+        return _FakeHandle(req)
+
+    def _run(self):
+        while not self._stop.wait(0.0005):
+            now = time.time()
+            with self._lock:
+                reqs = list(self._reqs)
+            for r in reqs:
+                if r["future"].done() or r["cancelled"]:
+                    continue
+                age = now - r["t0"]
+                if age < self.ttft_s:
+                    continue
+                want = min(r["max_new"],
+                           r["forced"] + 1
+                           + int((age - self.ttft_s) / self.tpot_s))
+                stream = _fake_stream(r["prompt"], r["seed"],
+                                      r["max_new"])
+                r["tokens"] = stream[:want]
+                if want >= r["max_new"]:
+                    r["future"].set_result(CompletedRequest(
+                        request_id=0, prompt=r["prompt"],
+                        tokens=np.asarray(stream, np.int64),
+                        finish_reason="length",
+                        ttft_s=self.ttft_s, tpot_s=self.tpot_s,
+                        e2e_s=now - r["t0"],
+                        trace_id=r["trace_id"]))
+
+    def shutdown(self, *, drain=True, timeout=None):
+        del drain, timeout
+        self._stop.set()
+        self._worker.join()
+        with self._lock:
+            for r in self._reqs:
+                if not r["future"].done():
+                    r["future"].set_exception(
+                        EngineClosedError("fake killed"))
+
+    def die(self):
+        """Abrupt death: unhealthy + all futures fail (what a real
+        contained dispatch crash produces)."""
+        self.healthy = False
+        self.shutdown()
+
+
+def _fake_router(fakes, **kw):
+    it = iter(fakes)
+    kw.setdefault("health_poll_s", 0.005)
+    kw.setdefault("hedge_quantile", 0.0)   # off unless the test asks
+    return ServingRouter(lambda: next(it), num_replicas=len(fakes),
+                         max_replacements=0, **kw)
+
+
+class TestRoutingPolicy:
+    def test_unhealthy_replica_takes_no_new_work(self, hvd):
+        a, b = FakeEngine(healthy=False), FakeEngine()
+        with _fake_router([a, b]) as router:
+            for i in range(4):
+                router.submit(np.array([i + 1]), 3).result(timeout=60)
+        assert a.submitted == 0
+        assert b.submitted == 4
+
+    def test_least_loaded_wins(self, hvd):
+        a, b = FakeEngine(tpot_s=0.2), FakeEngine(tpot_s=0.001)
+        with _fake_router([a, b]) as router:
+            slow = router.submit(np.array([1]), 4)        # lands somewhere
+            _wait(lambda: a.submitted + b.submitted == 1)
+            loaded = a if a.submitted else b
+            other = b if loaded is a else a
+            # Submit-and-wait so each placement sees the idle replica
+            # at load 0 vs the slow holder at load 1 — every one must
+            # avoid the loaded replica.
+            for i in range(3):
+                router.submit(np.array([i + 2]), 3).result(timeout=60)
+            assert other.submitted == 3, (
+                "new work landed on the loaded replica")
+            slow.result(timeout=60)
+
+    def test_slo_breaching_replica_drained_from_rotation(self, hvd):
+        class _BurningSLO:
+            def health(self):
+                return {"healthy": False, "breaching": ["ttft"]}
+
+        a, b = FakeEngine(), FakeEngine()
+        a.slo = _BurningSLO()
+        with _fake_router([a, b]) as router:
+            for i in range(3):
+                router.submit(np.array([i + 1]), 3).result(timeout=60)
+        assert a.submitted == 0 and b.submitted == 3
+
+    def test_retry_budget_spends_then_sheds(self, hvd):
+        # Both replicas shed everything: the free first try plus
+        # budget-many retries, then the caller gets the shed.
+        a = FakeEngine(shed_next=10 ** 6)
+        b = FakeEngine(shed_next=10 ** 6)
+        with _fake_router([a, b], retry_budget=3,
+                          backoff_s=0.001) as router:
+            with pytest.raises(QueueFullError):
+                router.submit(np.array([1]), 3)
+            snap = router.metrics_snapshot()
+        assert snap["retries"] == 3
+        assert snap["shed"] == 1
+        assert snap["budget_exhausted"] == 1
+
+    def test_retry_recovers_on_second_replica(self, hvd):
+        a = FakeEngine(shed_next=10 ** 6)
+        b = FakeEngine()
+        with _fake_router([a, b], retry_budget=4,
+                          backoff_s=0.001) as router:
+            # The router may try the shedding replica first (load tie)
+            # — the retry must land the request on the healthy one.
+            out = [router.submit(np.array([i + 1]), 3).result(
+                timeout=60) for i in range(3)]
+        assert len(out) == 3
+        assert b.submitted == 3
+
+    def test_zero_budget_disables_retries(self, hvd):
+        a = FakeEngine(shed_next=10 ** 6)
+        b = FakeEngine(shed_next=10 ** 6)
+        with _fake_router([a, b], retry_budget=0,
+                          backoff_s=0.001) as router:
+            with pytest.raises(QueueFullError):
+                router.submit(np.array([1]), 3)
+            assert router.metrics_snapshot()["retries"] == 0
+
+    def test_hedge_slow_first_token_and_cancel_loser(self, hvd):
+        """8 fast requests seed the TTFT quantile; the 9th lands on a
+        replica whose first token would take 30 s — the router must
+        hedge it onto the other replica after ~the p-quantile delay,
+        take the duplicate's (identical) stream, and cancel the
+        slow loser."""
+        a = FakeEngine(ttft_s=0.005)
+        b = FakeEngine(ttft_s=0.005)
+        with _fake_router([a, b], hedge_quantile=0.95) as router:
+            for i in range(8):
+                router.submit(np.array([i + 1]), 2).result(timeout=60)
+            # Wedge the NEXT submit: whichever replica takes it will
+            # sit on the first token for 30 s.
+            a.ttft_s = b.ttft_s = 30.0
+            h = router.submit(np.array([50]), 3)
+            # Un-wedge only the replica that does NOT hold the
+            # request, so the hedge (which must land there) is fast.
+            with router._lock:
+                prid = router._requests[h.id].attempts[0].replica_id
+                fakes = {rep.id: rep.engine
+                         for rep in router._replicas.values()}
+            for rid, eng in fakes.items():
+                if rid != prid:
+                    eng.ttft_s = 0.005
+            res = h.result(timeout=60)
+            snap = router.metrics_snapshot()
+        assert list(res.tokens) == _fake_stream(np.array([50]), 0, 3)
+        assert snap["hedges"] == 1
+        assert snap["hedge_wins"] == 1
+        loser = fakes[prid]
+        _wait(lambda: loser.cancels >= 1, timeout=10)
+
+    def test_terminal_stream_migration_synthesizes_completion(
+            self, hvd):
+        """Review regression: a replica dying in the window AFTER
+        generating a request's final token but BEFORE resolving its
+        future — migration must synthesize the completed result (the
+        stream is whole; resubmitting would be rejected with 'no
+        decode budget'), never crash the monitor or dangle the
+        future."""
+        a = FakeEngine(ttft_s=30.0)
+        b = FakeEngine(ttft_s=30.0)
+        with _fake_router([a, b]) as router:
+            h = router.submit(np.array([5]), 6, seed=2)
+            _wait(lambda: a.submitted + b.submitted == 1)
+            holder = a if a.submitted else b
+            stream = _fake_stream(np.array([5]), 2, 6)
+            with holder._lock:
+                holder._reqs[0]["tokens"] = list(stream)
+            holder.die()
+            res = h.result(timeout=60)
+            snap = router.metrics_snapshot()
+        assert list(res.tokens) == stream
+        assert res.finish_reason == "length"
+        assert res.trace_id == h.trace_id
+        assert snap["completed"] == 1
+        assert snap["failed"] == 0
+
+    def test_hedge_loser_does_not_wedge_drain(self, hvd):
+        """Review regression: the hedge loser's live-attempt count
+        must return to 0 when the winner clears it — otherwise the
+        loser's replica can never finish a drain()."""
+        a = FakeEngine(ttft_s=0.005)
+        b = FakeEngine(ttft_s=0.005)
+        with _fake_router([a, b], hedge_quantile=0.95) as router:
+            for i in range(8):
+                router.submit(np.array([i + 1]), 2).result(timeout=60)
+            a.ttft_s = b.ttft_s = 30.0
+            h = router.submit(np.array([50]), 3)
+            with router._lock:
+                prid = router._requests[h.id].attempts[0].replica_id
+                fakes = {rep.id: rep.engine
+                         for rep in router._replicas.values()}
+            for rid, eng in fakes.items():
+                if rid != prid:
+                    eng.ttft_s = 0.005
+            h.result(timeout=60)
+            assert router.metrics_snapshot()["hedges"] == 1
+            # The loser (primary) replica must drain to completion:
+            # its leaked live-count would park it DRAINING forever.
+            router.drain(prid)
+            _wait(lambda: prid not in router.replicas(), timeout=30)
+
+    def test_migration_off_dead_fake_carries_forced_prefix(self, hvd):
+        """Replica death with a half-done stream: the resubmission
+        carries the generated tokens as a forced prefix and the final
+        stream equals the deterministic oracle."""
+        a = FakeEngine(tpot_s=0.02)
+        b = FakeEngine(tpot_s=0.001)
+        with _fake_router([a, b]) as router:
+            h = router.submit(np.array([9]), 12, seed=4)
+            _wait(lambda: a.submitted + b.submitted == 1)
+            holder = a if a.submitted else b
+            _wait(lambda: len(h.tokens_so_far()) >= 3)
+            mid = len(h.tokens_so_far())
+            holder.die()
+            res = h.result(timeout=60)
+            snap = router.metrics_snapshot()
+        assert list(res.tokens) == _fake_stream(np.array([9]), 4, 12)
+        assert snap["migrations"] == 1
+        assert snap["migrated_tokens"] >= mid
+        other = b if holder is a else a
+        with other._lock:
+            mig = [r for r in other._reqs if r["forced"] > 0]
+        assert mig and mig[0]["forced"] >= 3, (
+            "migrated submit did not carry the forced prefix")
+
+
+class TestRetryBudget:
+    def test_spend_and_refill(self, hvd):
+        budget = RetryBudget(2, refill_window_s=0.2)
+        assert budget.try_spend() and budget.try_spend()
+        assert not budget.try_spend()
+        _wait(lambda: budget.try_spend(), timeout=5)
+
+    def test_zero_capacity_never_spends(self, hvd):
+        assert not RetryBudget(0).try_spend()

@@ -148,6 +148,96 @@ class TestEngineOracle:
         assert not np.array_equal(a, c)
 
 
+class TestForcedPrefix:
+    """`submit(forced_prefix=...)` — the token-exact continuation
+    primitive behind router migration (docs/serving.md 'Fleet
+    failover'): tokens an earlier engine already generated are
+    teacher-forced into the cache and the sample stream resumes at
+    the right ordinal, so the completed stream is bitwise what an
+    uninterrupted run produces."""
+
+    @pytest.mark.parametrize("temp,top_p,seed",
+                             [(0.0, None, 0), (0.8, None, 5),
+                              (1.1, 0.9, 3)])
+    def test_continuation_bitwise_exact(self, lm, temp, top_p, seed):
+        model, params = lm
+        prompt = _prompts(1, seed=17)[0]
+        steps = 12
+        with ServingEngine(model, params, num_slots=2) as eng:
+            ref = list(eng.submit(prompt, steps, temperature=temp,
+                                  top_p=top_p, seed=seed)
+                       .result(timeout=300).tokens)
+        for k in (1, 5, steps - 1):
+            with ServingEngine(model, params, num_slots=2) as eng:
+                r = eng.submit(prompt, steps, temperature=temp,
+                               top_p=top_p, seed=seed,
+                               forced_prefix=ref[:k]).result(
+                    timeout=300)
+            assert list(r.tokens) == ref, (temp, k)
+            # The forced span pre-seeds the stream: the handle's view
+            # and the result both contain the WHOLE stream.
+            assert len(r.tokens) == steps
+
+    def test_paged_continuation_bitwise_exact(self, lm):
+        """The paged pool path: the forced prefix rides the prefix
+        matcher (prompt ++ forced) and continues bitwise."""
+        model, params = lm
+        prompt = _prompts(1, seed=23)[0]
+        steps = 10
+        kw = dict(paged=True, kv_block_size=4)
+        with ServingEngine(model, params, num_slots=2, **kw) as eng:
+            ref = list(eng.submit(prompt, steps, temperature=0.7,
+                                  seed=2).result(timeout=300).tokens)
+        with ServingEngine(model, params, num_slots=2, **kw) as eng:
+            r = eng.submit(prompt, steps, temperature=0.7, seed=2,
+                           forced_prefix=ref[:6]).result(timeout=300)
+        assert list(r.tokens) == ref
+
+    def test_eos_in_continuation_still_stops(self, lm):
+        """A continuation whose next sampled token is eos retires as
+        'eos' exactly like the uninterrupted run."""
+        model, params = lm
+        prompt = _prompts(1, seed=3)[0]
+        steps = 10
+        probe = np.asarray(generate(
+            model, params, jnp.asarray(prompt)[None], steps))[0]
+        eos = int(probe[prompt.shape[0] + steps // 2])
+        with ServingEngine(model, params, num_slots=1,
+                           eos_id=eos) as eng:
+            ref = eng.submit(prompt, steps).result(timeout=300)
+        assert ref.finish_reason == "eos"
+        k = len(ref.tokens) - 1    # everything but the eos itself
+        if k:
+            with ServingEngine(model, params, num_slots=1,
+                               eos_id=eos) as eng:
+                r = eng.submit(prompt, steps,
+                               forced_prefix=list(ref.tokens)[:k]
+                               ).result(timeout=300)
+            assert r.finish_reason == "eos"
+            np.testing.assert_array_equal(r.tokens, ref.tokens)
+
+    def test_forced_prefix_validation(self, lm):
+        model, params = lm
+        with ServingEngine(model, params, num_slots=1,
+                           eos_id=7) as eng:
+            with pytest.raises(ValueError, match="decode budget"):
+                eng.submit(np.array([1]), 4, forced_prefix=[1, 2, 3, 4])
+            with pytest.raises(ValueError, match="eos_id"):
+                eng.submit(np.array([1]), 4, forced_prefix=[3, 7])
+            with pytest.raises(ValueError, match="integer"):
+                eng.submit(np.array([1]), 4, forced_prefix=[1.5])
+
+    def test_trace_id_override(self, lm):
+        """submit(trace_id=...) keeps a migrated request's identity —
+        the handle, the result and the retire event all carry it."""
+        model, params = lm
+        with ServingEngine(model, params, num_slots=1) as eng:
+            h = eng.submit(np.array([4]), 3, trace_id="cafe" * 4)
+            out = h.result(timeout=300)
+        assert h.trace_id == "cafe" * 4
+        assert out.trace_id == "cafe" * 4
+
+
 class TestAdmission:
     def test_full_queue_sheds_immediately(self, lm):
         """Queue at capacity => submit raises QueueFullError NOW (no
@@ -294,6 +384,36 @@ class TestAdmission:
             assert out.finish_reason == "length"
         snap = eng.metrics_snapshot()
         assert snap["cancelled"] == 1 and snap["completed"] == 1
+
+    def test_cancel_queued_releases_admission_slot_immediately(
+            self, lm):
+        """Regression (the hedging dependency, docs/serving.md 'Fleet
+        failover'): cancelling a still-QUEUED request must release its
+        admission slot NOW — its future resolves without waiting for a
+        dispatcher pop, and a new submit admits into the freed
+        capacity instead of shedding."""
+        model, params = lm
+        with ServingEngine(model, params, num_slots=1,
+                           max_queue=2) as eng:
+            blocker = eng.submit(np.array([5]), 31)
+            _wait(lambda: len(blocker.tokens_so_far()) >= 1,
+                  timeout=120)
+            q1 = eng.submit(_prompts(1, seed=60)[0], 4)
+            q2 = eng.submit(_prompts(1, seed=61)[0], 4)
+            with pytest.raises(QueueFullError):
+                eng.submit(_prompts(1, seed=62)[0], 4)   # queue full
+            q1.cancel()
+            # The cancel resolved the future inline — no dispatcher
+            # involvement, no sweep latency.
+            with pytest.raises(CancelledError):
+                q1.result(timeout=0.5)
+            # ...and the slot is free for the next submit RIGHT NOW.
+            q3 = eng.submit(_prompts(1, seed=63)[0], 4)
+            blocker.cancel()
+            assert q2.result(timeout=300).finish_reason == "length"
+            assert q3.result(timeout=300).finish_reason == "length"
+        snap = eng.metrics_snapshot()
+        assert snap["cancelled"] == 2 and snap["completed"] == 2
 
     def test_submit_validation(self, lm):
         model, params = lm
