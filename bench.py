@@ -1135,6 +1135,49 @@ def run_resume_check(args):
     return 0 if result["value"] else 1
 
 
+def run_elastic_check(args):
+    """--elastic-check: the elastic-membership acceptance artifact
+    (docs/resilience.md "Elastic membership"). Runs the resize
+    equivalence harness — a 4-member in-process simulated world under
+    a seeded rank_death (one member's heartbeat lease lapses
+    mid-epoch; the survivors commit a new generation, roll back to
+    the committed TrainSnapshot, and rebalance shards) against an
+    uninterrupted control — and records the proof: the union of all
+    members' effective per-record streams bitwise-equal as multisets,
+    plus resize count, detection and time-to-resume p50/max, and
+    records reassigned. Host-side (numpy + threads + checkpoint I/O),
+    daemon-runnable like --resume-check; cpu is forced unless
+    --platform says otherwise."""
+    import shutil
+    import tempfile
+
+    _force_platform(args.platform or "cpu")
+    from horovod_tpu.resilience.equivalence import (
+        run_resize_equivalence)
+
+    workdir = tempfile.mkdtemp(prefix="hvd_elastic_check_")
+    try:
+        report = run_resize_equivalence(workdir, log=log)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    s = report.summary()
+    # Same acceptance shape as the module CLI: union-equivalent AND a
+    # death actually fired AND a resize actually committed — an
+    # externally-armed monkey with unrelated sites would otherwise
+    # make this a vacuous pass.
+    result = {
+        "metric": "elastic_resize_equivalence",
+        "value": 1.0 if report.ok else 0.0,
+        "unit": "bool",
+        "vs_baseline": None,  # reference kills the job on rank death
+        **s,
+    }
+    _set_best(result)
+    emit(_BEST_RESULT)
+    write_out(args)
+    return 0 if result["value"] else 1
+
+
 def run_bert(args, devices, n_chips, log):
     """BERT-MLM pretraining throughput (tokens/sec/chip): the masked-
     LM objective on the shared encoder blocks (`models/bert.py`) —
@@ -1487,10 +1530,20 @@ def main():
                          "bitwise-identical across chaos-injected "
                          "kills+restarts, resume_gap_batches == 0, "
                          "recovery_ms recorded (docs/resilience.md)")
+    ap.add_argument("--elastic-check", action="store_true",
+                    help="run the elastic resize-equivalence harness "
+                         "(membership: rank_death -> shrink -> shard "
+                         "rebalance) and emit its report as the "
+                         "artifact: union record stream bitwise-equal "
+                         "to an uninterrupted run, resize count, "
+                         "time-to-resume p50/max, records reassigned "
+                         "(docs/resilience.md 'Elastic membership')")
     args = ap.parse_args()
 
     if args.resume_check:
         sys.exit(run_resume_check(args))
+    if args.elastic_check:
+        sys.exit(run_elastic_check(args))
 
     if args.model is None:  # driver default: full BASELINE.md coverage
         args.model = "resnet101"

@@ -108,6 +108,23 @@ HVD_CHAOS=train_crash:2,ckpt_kill:1 JAX_PLATFORMS=cpu \
     2>&1 | tee /tmp/hvd_resume_smoke.log
 grep -q "equivalence OK" /tmp/hvd_resume_smoke.log
 
+# Elastic-membership smoke (docs/resilience.md "Elastic membership"):
+# a 4-member in-process simulated world trains under an env-armed
+# rank_death — one member stops heartbeating mid-epoch, the survivors
+# must detect the lapsed lease, commit generation 1, shrink to 3,
+# roll back to the last committed TrainSnapshot, rebalance shards,
+# and finish every epoch with the union of all members' effective
+# per-record streams bitwise-equal (as a multiset) to an
+# uninterrupted control run's — no record trained twice, none
+# silently dropped (the module exits nonzero otherwise, and also if
+# the death or the resize never actually happened).
+rm -rf /tmp/hvd_elastic_smoke
+HVD_CHAOS=rank_death:1 JAX_PLATFORMS=cpu \
+    python -m horovod_tpu.resilience.equivalence --resize \
+    --workdir /tmp/hvd_elastic_smoke \
+    2>&1 | tee /tmp/hvd_elastic_smoke.log
+grep -q "resize equivalence OK" /tmp/hvd_elastic_smoke.log
+
 # Chaos smoke (docs/resilience.md): one injected checkpoint-write
 # failure mid-run — the shared RetryPolicy must retry with backoff and
 # the run must still complete and leave a restorable checkpoint.

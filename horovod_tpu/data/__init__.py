@@ -27,6 +27,22 @@ shuffle seed — everything needed to re-derive the permutation),
 loader skips to the record offset inside the producer
 (`hvd_dl_start_epoch_at`), the fallback slices the shuffled order —
 batches ``k..end`` are bitwise identical to the uninterrupted epoch's.
+
+Elastic resize (docs/resilience.md "Elastic membership"): the cursor
+is additionally *world-portable*. When the fleet shrinks or grows
+mid-epoch, `restore(state, migrate=True)` / `rebalance(new_rank,
+new_world)` remap the splitmix64-keyed stream instead of raising
+`DataStateError`: the untrained remainder of the interrupted epoch —
+every old rank's unconsumed suffix, the dead rank's included — is
+computed from the snapshot's ``(world, next_batch)`` and repartitioned
+round-robin across the new world (`remainder_after` is the pure
+oracle). The union of all new ranks' post-resize batches is exactly
+that remainder: no record trained twice, none silently dropped.
+Resizes chain (a grow right after a shrink, both mid-epoch) through
+the migration ``history`` the cursor carries. The rebalanced remainder
+is read host-side by an explicit-order reader under both loader
+implementations; from the next epoch boundary the stream returns to
+normal file sharding (native prefetch included) under the new world.
 """
 
 from __future__ import annotations
@@ -76,6 +92,57 @@ def shuffle_perm(n: int, seed: int, epoch: int) -> np.ndarray:
     base = (int(seed) * _GOLDEN + int(epoch)) % (1 << 64)
     keys = _mix64(np.uint64(base) + np.arange(n, dtype=np.uint64))
     return np.argsort(keys, kind="stable")
+
+
+def _rank_epoch_order(counts: Sequence[int], world: int, rank: int,
+                      seed: int, epoch: int,
+                      shuffle: bool) -> List[Tuple[int, int]]:
+    """The (global_file_idx, record_idx) walk order rank ``rank`` of
+    ``world`` produces in ``epoch`` — owned files ascending, records
+    ascending, then the splitmix64 stable-sort permutation. This is
+    the SAME order both loader implementations yield (pinned by the
+    parity tests), which is what makes the remainder of a resized
+    epoch computable without replaying it."""
+    order = [(fi, r) for fi in range(len(counts))
+             if fi % world == rank for r in range(counts[fi])]
+    if shuffle:
+        order = [order[i] for i in shuffle_perm(len(order), seed,
+                                                epoch)]
+    return order
+
+
+def remainder_after(counts: Sequence[int], history, *,
+                    batch_size: int, seed: int, epoch: int,
+                    shuffle: bool,
+                    drop_remainder: bool) -> List[Tuple[int, int]]:
+    """The canonical untrained remainder of ``epoch`` after a resize
+    ``history`` — the pure oracle behind elastic rebalancing.
+
+    ``history`` is ``[(world_0, batches_0), (world_1, batches_1),
+    ...]``: segment 0 is the normal file-sharded stream under
+    ``world_0`` with ``batches_0`` lockstep batches consumed per rank;
+    each later segment is the round-robin repartition of the previous
+    remainder under ``world_i`` with ``batches_i`` batches consumed
+    per rank. New rank ``k`` of ``new_world`` owns
+    ``remainder[k::new_world]`` — so the union over ranks is exactly
+    this list, each record once (no record trained twice, none
+    silently dropped). With ``drop_remainder`` the per-rank tail the
+    uninterrupted epoch would never have trained is excluded from
+    segment 0 (it was never owed to anyone)."""
+    w0, b0 = history[0]
+    rem: List[Tuple[int, int]] = []
+    for r in range(int(w0)):
+        order = _rank_epoch_order(counts, int(w0), r, seed, epoch,
+                                  shuffle)
+        n_eff = ((len(order) // batch_size) * batch_size
+                 if drop_remainder else len(order))
+        rem.extend(order[min(int(b0) * batch_size, n_eff):n_eff])
+    for wi, bi in history[1:]:
+        parts = [rem[k::int(wi)] for k in range(int(wi))]
+        rem = []
+        for part in parts:
+            rem.extend(part[min(int(bi) * batch_size, len(part)):])
+    return rem
 
 
 def _open_with_retry(path: str, mode: str):
@@ -302,7 +369,6 @@ class ShardedDataset:
                  rank: Optional[int] = None, world: Optional[int] = None,
                  drop_remainder: bool = False):
         from horovod_tpu.runtime import bootstrap as bs
-        from horovod_tpu.runtime.config import config
 
         if rank is None:
             rank = bs.rank() if bs.is_initialized() else 0
@@ -315,18 +381,32 @@ class ShardedDataset:
         self.shuffle = shuffle
         self.seed = seed
         self.rank, self.world = rank, world
+        self._files = [str(f) for f in files]
         self._num_files = len(files)
+        self._capacity = capacity
         # (epoch, next batch) — advanced as epoch() yields, snapshotted
         # by state(), re-installed by restore().
         self._cursor = (0, 0)
+        # Elastic-resize migration: when set, the cursor's epoch is
+        # streamed from the rebalanced remainder (docs/resilience.md
+        # "Elastic membership") instead of the impl's file sharding;
+        # {"epoch": e, "history": [[world, batches], ...]}.
+        self._migration: Optional[Dict] = None
+        self._counts: Optional[List[int]] = None
+        self.last_rebalance: Optional[Dict] = None
+        self._impl = self._build_impl()
+
+    def _build_impl(self):
+        from horovod_tpu.runtime.config import config
         impl = None
         if config.use_native:
             try:
                 from horovod_tpu.native.build import build_data_loader
                 impl = _NativeLoader(
-                    build_data_loader(), files, self._rb, batch_size,
-                    capacity, shuffle, seed, rank, world,
-                    drop_remainder)
+                    build_data_loader(), self._files, self._rb,
+                    self.batch_size, self._capacity, self.shuffle,
+                    self.seed, self.rank, self.world,
+                    self.drop_remainder)
             # hvd: disable=HVD006(native loader probe: any build/load fault degrades to the Python reader, loudly via the warning below)
             except Exception as e:
                 # Degrading silently would hide real misconfiguration
@@ -338,9 +418,11 @@ class ShardedDataset:
                     f"HOROVOD_NO_NATIVE=1 to silence.")
                 impl = None
         if impl is None:
-            impl = _PythonLoader(files, self._rb, batch_size, shuffle,
-                                 seed, rank, world, drop_remainder)
-        self._impl = impl
+            impl = _PythonLoader(self._files, self._rb,
+                                 self.batch_size, self.shuffle,
+                                 self.seed, self.rank, self.world,
+                                 self.drop_remainder)
+        return impl
 
     @property
     def native(self) -> bool:
@@ -376,10 +458,24 @@ class ShardedDataset:
         ``epoch(epoch_idx)`` stream (the native loader seeks inside
         the producer; the fallback slices the shuffled order). Every
         yield advances the cursor `state()` snapshots, so a checkpoint
-        cut after consuming batch j resumes at batch j+1 exactly."""
+        cut after consuming batch j resumes at batch j+1 exactly.
+
+        Under an installed resize migration (`restore(migrate=True)` /
+        `rebalance`), the migrated epoch streams this rank's share of
+        the rebalanced remainder through the host-side explicit-order
+        reader instead of the impl; any other epoch abandons the
+        migration and runs the normal file-sharded path under the
+        current (rank, world)."""
         epoch_idx, b = int(epoch_idx), int(start_batch)
         if b < 0:
             raise ValueError(f"start_batch must be >= 0, got {b}")
+        mig = self._migration
+        if mig is not None:
+            if epoch_idx == mig["epoch"]:
+                self._cursor = (epoch_idx, b)
+                yield from self._migrated_epoch(mig, epoch_idx, b)
+                return
+            self._migration = None
         self._cursor = (epoch_idx, b)
         for buf, n in self._impl.epoch(epoch_idx,
                                        b * self.batch_size):
@@ -387,6 +483,96 @@ class ShardedDataset:
             self._cursor = (epoch_idx, b)
             yield unpack_records(self.spec, buf, n)
         self._cursor = (epoch_idx + 1, 0)
+
+    # -- elastic resize: the rebalanced remainder ----------------------
+
+    def _file_counts(self) -> List[int]:
+        """Per-file record counts in global file order (identical on
+        every rank — the shard files are the shared input), cached."""
+        if self._counts is None:
+            self._counts = [os.path.getsize(f) // self._rb
+                            for f in self._files]
+        return self._counts
+
+    def _migration_remainder(self, mig: Dict) -> List[Tuple[int, int]]:
+        return remainder_after(
+            self._file_counts(), [tuple(p) for p in mig["history"]],
+            batch_size=self.batch_size, seed=self.seed,
+            epoch=int(mig["epoch"]), shuffle=self.shuffle,
+            drop_remainder=self.drop_remainder)
+
+    def _migrated_epoch(self, mig: Dict, e: int, start_batch: int):
+        """Stream this rank's share of the rebalanced remainder —
+        explicit (file, record) reads, so it works identically under
+        the native and pure-Python impls (prefetch resumes at the next
+        epoch boundary). The final partial batch is yielded even under
+        ``drop_remainder``: the remainder math already excluded the
+        tail the uninterrupted epoch would have dropped, so every
+        record still in the list is owed to the union."""
+        rem = mig.get("_rem")
+        if rem is None:
+            rem = self._migration_remainder(mig)
+        mine = rem[self.rank::self.world]
+        bsz, rb = self.batch_size, self._rb
+        buf = np.empty(bsz * rb, np.uint8)
+        handles: Dict[int, object] = {}
+        b = start_batch
+        try:
+            n_in = 0
+            for fi, ri in mine[start_batch * bsz:]:
+                h = handles.get(fi)
+                if h is None:
+                    h = handles[fi] = _open_with_retry(
+                        self._files[fi], "rb")
+                h.seek(ri * rb)
+                buf[n_in * rb:(n_in + 1) * rb] = np.frombuffer(
+                    h.read(rb), np.uint8)
+                n_in += 1
+                if n_in == bsz:
+                    b += 1
+                    self._cursor = (e, b)
+                    yield unpack_records(self.spec, buf, n_in)
+                    n_in = 0
+            if n_in:
+                b += 1
+                self._cursor = (e, b)
+                yield unpack_records(self.spec, buf, n_in)
+        finally:
+            for h in handles.values():
+                h.close()
+        self._migration = None
+        self._cursor = (e + 1, 0)
+
+    @property
+    def migration(self) -> Optional[Dict]:
+        """The active resize migration ({"epoch", "history"}) or None
+        — read-only evidence for tests and the membership harness
+        (the internal cached remainder is not part of the view)."""
+        if not self._migration:
+            return None
+        return {k: v for k, v in self._migration.items()
+                if not k.startswith("_")}
+
+    def rebalance(self, new_rank: int, new_world: int) -> Dict:
+        """Remap the LIVE stream onto a resized world, in place.
+
+        Rebuilds the loader impl under ``(new_rank, new_world)`` and
+        migrates the current cursor (`restore(state, migrate=True)`
+        semantics): the untrained remainder of the in-progress epoch
+        is repartitioned round-robin so the union over all new ranks
+        is exactly the records no old rank had consumed. Returns the
+        rebalance report (also kept as `last_rebalance`)."""
+        new_rank, new_world = int(new_rank), int(new_world)
+        if not 0 <= new_rank < new_world:
+            raise ValueError(
+                f"rebalance: rank {new_rank} outside world "
+                f"{new_world}")
+        st = self.state()
+        self._impl.close()
+        self.rank, self.world = new_rank, new_world
+        self._impl = self._build_impl()
+        self.restore(st, migrate=True)
+        return dict(self.last_rebalance or {})
 
     # -- the checkpointable cursor ------------------------------------
 
@@ -402,7 +588,7 @@ class ShardedDataset:
         into a differently-seeded or differently-batched stream would
         silently yield the wrong records — `restore` refuses it)."""
         e, b = self._cursor
-        return {
+        out = {
             "schema": DATA_STATE_SCHEMA,
             "epoch": e, "next_batch": b,
             "seed": int(self.seed), "shuffle": bool(self.shuffle),
@@ -412,14 +598,52 @@ class ShardedDataset:
             "num_files": int(self._num_files),
             "record_bytes": int(self._rb),
         }
+        if self._migration is not None:
+            out["migration"] = {
+                "epoch": int(self._migration["epoch"]),
+                "history": [[int(w), int(n)] for w, n
+                            in self._migration["history"]],
+            }
+        return out
 
-    def restore(self, state: Dict) -> "ShardedDataset":
+    @staticmethod
+    def _check_migration(mig, epoch: int) -> Dict:
+        """Validate a snapshot's migration leg (shape + epoch match);
+        returns the normalized dict or raises `DataStateError`."""
+        try:
+            e = int(mig["epoch"])
+            hist = [[int(w), int(n)] for w, n in mig["history"]]
+        except (TypeError, ValueError, KeyError) as exc:
+            raise DataStateError(
+                f"malformed migration leg in data state: {exc!r}"
+            ) from None
+        if e != epoch:
+            raise DataStateError(
+                f"migration epoch {e} != cursor epoch {epoch}")
+        if not hist or any(w <= 0 or n < 0 for w, n in hist):
+            raise DataStateError(
+                f"migration history out of range: {hist!r}")
+        return {"epoch": e, "history": hist}
+
+    def restore(self, state: Dict, *,
+                migrate: bool = False) -> "ShardedDataset":
         """Re-install a `state()` snapshot onto this (fresh) dataset.
 
         Raises `DataStateError` naming every mismatched identity field
-        — resume logic treats that as a corrupt/incompatible cursor
-        and falls back to the epoch boundary rather than serving a
-        stream the snapshot does not describe."""
+        (expected = this dataset, got = the snapshot) — resume logic
+        treats that as a corrupt/incompatible cursor and falls back to
+        the epoch boundary rather than serving a stream the snapshot
+        does not describe.
+
+        ``migrate=True`` makes the cursor world-portable (elastic
+        resize, docs/resilience.md "Elastic membership"): a snapshot
+        from a different ``world`` extends the migration history and
+        rebalances the epoch's untrained remainder across the current
+        world; a bare ``rank`` relabel under the same world adopts the
+        cursor as-is (streams are slot-indexed — whoever occupies rank
+        k continues rank k's suffix). Every other identity mismatch
+        still raises: a resize changes who reads what, never what the
+        records are."""
         if not isinstance(state, dict):
             raise DataStateError(
                 f"data state must be a dict, got {type(state).__name__}")
@@ -428,20 +652,82 @@ class ShardedDataset:
                 f"data state schema {state.get('schema')!r} != "
                 f"supported {DATA_STATE_SCHEMA}")
         mine = self.state()
+        core = ("seed", "shuffle", "batch_size", "drop_remainder",
+                "num_files", "record_bytes")
+        world_keys = ("world", "rank")
         mismatched = [
-            f"{k}: snapshot {state.get(k)!r} != dataset {mine[k]!r}"
-            for k in ("seed", "shuffle", "batch_size", "drop_remainder",
-                      "rank", "world", "num_files", "record_bytes")
-            if state.get(k) != mine[k]]
-        if mismatched:
+            f"{k}: expected {mine[k]!r} (this dataset), got "
+            f"{state.get(k)!r} (snapshot)"
+            for k in core if state.get(k) != mine[k]]
+        world_moved = [k for k in world_keys
+                       if state.get(k) != mine[k]]
+        if mismatched or (world_moved and not migrate):
+            core_ok = not mismatched
+            mismatched += [
+                f"{k}: expected {mine[k]!r} (this dataset), got "
+                f"{state.get(k)!r} (snapshot)"
+                for k in world_moved]
+            hint = ""
+            if world_moved and core_ok:
+                hint = (" — a cursor from a resized world needs "
+                        "migration: restore(state, migrate=True) or "
+                        "ShardedDataset.rebalance() "
+                        "(docs/resilience.md 'Elastic membership')")
             raise DataStateError(
                 "data state incompatible with this dataset — "
-                + "; ".join(mismatched))
+                + "; ".join(mismatched) + hint)
         e, b = int(state["epoch"]), int(state["next_batch"])
         if e < 0 or b < 0:
             raise DataStateError(
                 f"data state cursor out of range: epoch={e} batch={b}")
-        self._cursor = (e, b)
+        self.last_rebalance = None
+        mig = state.get("migration")
+        try:
+            old_world = int(state["world"])
+        except (TypeError, ValueError, KeyError):
+            raise DataStateError(
+                f"data state world not an int: "
+                f"{state.get('world')!r}") from None
+        if old_world == self.world:
+            # Same world: identical stream addressing (rank relabels
+            # included — see docstring); adopt cursor and any active
+            # migration verbatim.
+            self._migration = (self._check_migration(mig, e)
+                               if mig else None)
+            self._cursor = (e, b)
+            return self
+        # World changed: extend the history with the snapshot's live
+        # tail and rebalance the remainder over the current world.
+        if old_world <= 0:
+            raise DataStateError(
+                f"data state world out of range: {old_world}")
+        history = list((self._check_migration(mig, e)["history"]
+                        if mig else []))
+        history.append([old_world, b])
+        new_mig = {"epoch": e, "history": history}
+        # Computed ONCE: the shuffle-permutation replay behind the
+        # remainder is O(total records · log) — the cached list also
+        # feeds the migrated epoch's reader (`_rem` is in-memory
+        # only; state() serializes epoch/history and a restored
+        # cursor recomputes lazily).
+        rem = self._migration_remainder(new_mig)
+        if b == 0 and len(history) == 1:
+            # Nothing of the epoch consumed yet: restart it cleanly
+            # under the new world's normal file sharding (fast path —
+            # native prefetch, no explicit-order reader).
+            self._migration = None
+        else:
+            self._migration = dict(new_mig, _rem=rem)
+        self._cursor = (e, 0)
+        self.last_rebalance = {
+            "epoch": e,
+            "from_batch": b,
+            "old_world": old_world,
+            "new_world": int(self.world),
+            "history": [list(p) for p in history],
+            "records_reassigned": len(rem),
+            "assigned": len(rem[self.rank::self.world]),
+        }
         return self
 
     def close(self):

@@ -216,6 +216,51 @@ def resilience_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
     }
 
 
+def elastic_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """The elastic-membership plane (resilience/membership.py,
+    docs/resilience.md "Elastic membership"): world generation,
+    resize/death/join accounting, and the shard-rebalance cost of
+    every committed resize."""
+    reg = reg or registry()
+    return {
+        "generation": reg.gauge(
+            "hvd_elastic_generation",
+            "Monotonic elastic-world generation (0 = launch world; "
+            "+1 per committed resize — restarts vs resizes "
+            "disambiguate on this)"),
+        "world_size": reg.gauge(
+            "hvd_elastic_world_size",
+            "Committed world size after the newest resize (equals "
+            "the launch size at generation 0)"),
+        "resizes": reg.counter(
+            "hvd_elastic_resizes_total",
+            "Committed world resizes by kind (shrink, grow, steady — "
+            "steady = membership changed, size did not)", ("kind",)),
+        "rank_deaths": reg.counter(
+            "hvd_elastic_rank_deaths_total",
+            "Members removed from the world by heartbeat-lease "
+            "expiry (preemption, crash, partition)"),
+        "rank_joins": reg.counter(
+            "hvd_elastic_rank_joins_total",
+            "Members admitted to the world via a join announcement"),
+        "heartbeats_missed": reg.counter(
+            "hvd_elastic_heartbeats_missed_total",
+            "Heartbeat writes that did not land (chaos "
+            "heartbeat_drop or a transport fault) — lease math "
+            "tolerates isolated misses"),
+        "rebalance": reg.histogram(
+            "hvd_elastic_rebalance_seconds",
+            "Per-resize shard-rebalance latency: rollback to the "
+            "committed TrainSnapshot through the migrated cursor "
+            "installed (ElasticTrainer resize path)"),
+        "records_reassigned": reg.counter(
+            "hvd_elastic_records_reassigned_total",
+            "Records of interrupted epochs repartitioned across the "
+            "new world by shard rebalancing (the untrained-remainder "
+            "union, docs/resilience.md)"),
+    }
+
+
 def training_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
     """The training plane: step cadence, throughput, and the MFU
     gauge (analytic FLOPs over the device's peak,
@@ -320,6 +365,7 @@ def declare_standard_metrics(
         "serving": serving_metrics(reg),
         "router": router_metrics(reg),
         "resilience": resilience_metrics(reg),
+        "elastic": elastic_metrics(reg),
         "training": training_metrics(reg),
         "collectives": collective_metrics(reg),
         "slo": slo_metrics(reg),

@@ -56,7 +56,9 @@ def _local_rank() -> int:
         return 0
 
 
-def merge_windows(windows: List[Dict]) -> Optional[Dict]:
+def merge_windows(windows: List[Dict],
+                  expected_ranks: Optional[int] = None
+                  ) -> Optional[Dict]:
     """Fold per-rank timing windows into one straggler report.
 
     Each window is a `StragglerTracker.window_snapshot()` dict
@@ -67,6 +69,17 @@ def merge_windows(windows: List[Dict]) -> Optional[Dict]:
          "skew_s": max_mean - min_mean, "straggler": bool,
          "per_rank": {rank: {"n", "total_s", "mean_s", "max_s"}}}
 
+    Churn-tolerant by contract: a rank that died mid-window costs its
+    contribution, never the merge — ``None``/empty/partial entries in
+    ``windows`` (an allgather slot a dead peer never filled, a
+    snapshot missing ``total_s``) degrade to the surviving ranks'
+    report rather than raising. Pass ``expected_ranks`` (the world
+    size) to have the report additionally FLAG who is absent:
+    ``missing_ranks`` lists every rank 0..expected-1 that contributed
+    nothing — a stall warning naming the straggler should also name
+    the rank that stopped reporting entirely (it is usually the real
+    suspect).
+
     Pure function — the in-process leg `dryrun`-style tests and the
     fleet aggregator both call it on simulated rank windows.
     """
@@ -74,12 +87,18 @@ def merge_windows(windows: List[Dict]) -> Optional[Dict]:
     for w in windows:
         if not w or not w.get("n"):
             continue
-        r = int(w.get("rank", 0))
+        try:
+            r = int(w.get("rank", 0))
+            n = int(w["n"])
+            total = float(w.get("total_s", 0.0))
+            mx = float(w.get("max_s", 0.0))
+        except (TypeError, ValueError):
+            continue   # malformed (truncated mid-death) window
         cur = per_rank.setdefault(
             r, {"n": 0, "total_s": 0.0, "max_s": 0.0})
-        cur["n"] += int(w["n"])
-        cur["total_s"] += float(w["total_s"])
-        cur["max_s"] = max(cur["max_s"], float(w.get("max_s", 0.0)))
+        cur["n"] += n
+        cur["total_s"] += total
+        cur["max_s"] = max(cur["max_s"], mx)
     if not per_rank:
         return None
     for stats in per_rank.values():
@@ -88,7 +107,7 @@ def merge_windows(windows: List[Dict]) -> Optional[Dict]:
     fastest = min(per_rank, key=lambda r: per_rank[r]["mean_s"])
     lo = per_rank[fastest]["mean_s"]
     hi = per_rank[slowest]["mean_s"]
-    return {
+    out = {
         "ranks": len(per_rank),
         "slowest_rank": slowest,
         "fastest_rank": fastest,
@@ -101,6 +120,11 @@ def merge_windows(windows: List[Dict]) -> Optional[Dict]:
                          for k, v in stats.items()}
                      for r, stats in sorted(per_rank.items())},
     }
+    if expected_ranks is not None:
+        out["expected_ranks"] = int(expected_ranks)
+        out["missing_ranks"] = sorted(
+            set(range(int(expected_ranks))) - set(per_rank))
+    return out
 
 
 class StragglerTracker:

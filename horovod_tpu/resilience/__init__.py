@@ -19,11 +19,20 @@ recovery layer (docs/resilience.md):
   checkpointing, rollback, and the exactly-once `TrainSnapshot`
   (model + optimizer + data cursor + host RNG + guard history) into
   one loop-side helper.
+* `membership` — elastic world membership: `WorldMonitor` heartbeat
+  leases + rank-death/join detection over a pluggable KV transport,
+  the barrier'd propose/ack/commit resize protocol (monotonic world
+  generation), and `SimulatedWorld`, the in-process N-thread elastic
+  training world CPU tests drive end-to-end (docs/resilience.md
+  "Elastic membership").
 * `equivalence` — the crash-restart equivalence harness: trains the
   same workload twice, once uninterrupted and once under
   chaos-injected kills + restarts, and asserts the batch streams are
   bitwise identical and the final params match (``python -m
-  horovod_tpu.resilience.equivalence`` is the CI smoke entry).
+  horovod_tpu.resilience.equivalence`` is the CI smoke entry); with
+  ``--resize``, the elastic twin — a simulated world under
+  ``rank_death`` must shrink, rebalance, and keep the per-record
+  union stream bitwise-equal to an uninterrupted run's.
 
 The chaos-vs-recovery contract is exercised end-to-end in
 `tests/test_resilience.py` / `tests/test_resume.py`: every recovery
@@ -42,6 +51,16 @@ from horovod_tpu.resilience.elastic import (
     PreemptionHandler,
     TrainSnapshot,
 )
+from horovod_tpu.resilience.membership import (
+    BootstrapKV,
+    ElasticBarrier,
+    InProcessKV,
+    MembershipError,
+    ResizeDecision,
+    SimulatedWorld,
+    WorldMonitor,
+    install_kv,
+)
 from horovod_tpu.resilience.retry import (
     RetryError,
     RetryPolicy,
@@ -53,4 +72,7 @@ __all__ = [
     "RetryError", "RetryPolicy", "default_io_policy",
     "ElasticTrainer", "NaNGuard", "PreemptionHandler",
     "TrainSnapshot",
+    "BootstrapKV", "ElasticBarrier", "InProcessKV",
+    "MembershipError", "ResizeDecision", "SimulatedWorld",
+    "WorldMonitor", "install_kv",
 ]

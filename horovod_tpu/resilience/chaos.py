@@ -307,6 +307,14 @@ _SITE_DOCS: Dict[str, str] = {
     "router.replica_kill": "abrupt replica death mid-stream — the "
                            "router must migrate its in-flight "
                            "requests token-exactly",
+    "rank_death": "training rank dies mid-epoch (preemption/crash): "
+                  "heartbeat lease lapses, survivors must resize and "
+                  "rebalance shards",
+    "rank_join": "a new rank announces itself mid-run — the world "
+                 "must grow with a new generation",
+    "heartbeat_drop": "a heartbeat write is lost in transit — lease "
+                      "math must tolerate isolated misses without a "
+                      "false death",
 }
 
 _SITE_CALL_RE = (r'(?:chaos\s*\.\s*)?(?:fires|slow_site)\(\s*'

@@ -54,24 +54,54 @@ from horovod_tpu.resilience.retry import RetryPolicy
 SNAPSHOT_SCHEMA = 1
 
 
+#: Default signal set: SIGTERM/SIGINT are the kill path; SIGUSR1 is
+#: the cloud *preemption notice* (GCE shutdown scripts, k8s preStop
+#: hooks and TPU maintenance notifiers can deliver it ahead of the
+#: real SIGTERM) — catching it starts the emergency save BEFORE the
+#: hard signal lands, with the whole HVD_PREEMPT_GRACE_S window still
+#: in hand.
+DEFAULT_PREEMPT_SIGNALS = (signal.SIGTERM, signal.SIGINT,
+                           signal.SIGUSR1)
+
+
 class PreemptionHandler:
-    """Flag-setting SIGTERM/SIGINT handler (context manager).
+    """Flag-setting SIGTERM/SIGINT/SIGUSR1 handler (context manager).
 
     The handler itself does no I/O: Python signal handlers run between
     bytecodes on the main thread, possibly inside an XLA dispatch or a
     lock — checkpointing there can deadlock. It records the signal and
     the time; the training loop polls `triggered` at step boundaries
-    (milliseconds apart) and saves from clean context. A second
-    delivery of the same signal falls through to the previous handler
-    — a stuck loop can still be killed with a second Ctrl-C.
+    (milliseconds apart) and saves from clean context.
+
+    Escalation model: SIGUSR1 is an advance *notice* — it only ever
+    sets the flag (clouds may deliver several; none should kill a
+    loop that is busy saving). The FIRST hard signal (SIGTERM/SIGINT)
+    after a notice is absorbed too — it is the expected second act of
+    a preemption, arriving while the emergency checkpoint may still
+    be in flight. Any further hard signal — and, without a notice,
+    the SECOND hard signal of any kind — falls through to the
+    previous disposition, so a wedged loop can still be killed with a
+    second Ctrl-C (or SIGTERM then Ctrl-C).
+
+    Grace window: ``HVD_PREEMPT_GRACE_S`` (default 30 s) is how long
+    the platform promises the host survives past the first notice.
+    `grace_remaining()` is the loop's save budget — e.g. skip an
+    optional validation pass when it dips low.
     """
 
-    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT), *,
-                 callback: Optional[Callable[[int], None]] = None):
+    def __init__(self, signals=DEFAULT_PREEMPT_SIGNALS, *,
+                 callback: Optional[Callable[[int], None]] = None,
+                 grace_s: Optional[float] = None):
+        if grace_s is None:
+            from horovod_tpu.runtime.config import env_float
+            grace_s = env_float("HVD_PREEMPT_GRACE_S", 30.0)
         self._signals = tuple(signals)
         self._callback = callback
         self._event = threading.Event()
         self._prev: dict = {}
+        self._hard_seen: set = set()
+        self._notice_seen = False
+        self.grace_s = float(grace_s)
         self.signum: Optional[int] = None
         self.t_signal: Optional[float] = None
 
@@ -91,11 +121,20 @@ class PreemptionHandler:
         # lock could already be held by the interrupted frame — the
         # loop-side consumers (`ElasticTrainer.after_step`) emit the
         # preemption event from clean context instead.
-        if self._event.is_set():
-            # Second signal: restore the previous disposition and
-            # re-deliver so a wedged loop still dies (SIG_DFL SIGTERM
-            # terminates via the re-raise below) or KeyboardInterrupts
-            # (SIGINT).
+        hard = signum != signal.SIGUSR1
+        # A SIGUSR1 notice buys exactly ONE hard-signal absorption:
+        # the SIGTERM that follows a cloud preemption notice is the
+        # preemption's expected second act (the emergency save may
+        # still be writing). Without a notice, a second hard signal
+        # of ANY kind escalates — the pre-existing wedged-loop escape
+        # hatch (SIGTERM then Ctrl-C must still kill).
+        absorb_hard = (self._notice_seen and not self._hard_seen)
+        if self._event.is_set() and hard and not absorb_hard:
+            # Escalating HARD signal: restore the previous disposition
+            # and re-deliver so a wedged loop still dies (SIG_DFL
+            # SIGTERM terminates via the re-raise below) or
+            # KeyboardInterrupts (SIGINT). A SIGUSR1 notice — however
+            # many times the cloud repeats it — never escalates.
             prev = self._prev.get(signum, signal.SIG_DFL)
             if prev is None:
                 # signal.signal returns None for handlers installed by
@@ -111,8 +150,22 @@ class PreemptionHandler:
                 import os
                 os.kill(os.getpid(), signum)  # restored disposition
             return
+        if self._event.is_set():
+            # Notice already active: record the stronger signal (the
+            # grace clock keeps running from the FIRST notice — the
+            # platform's promise is anchored there).
+            self.signum = signum
+            if hard:
+                self._hard_seen.add(signum)
+            else:
+                self._notice_seen = True
+            return
         self.signum = signum
         self.t_signal = time.time()
+        if hard:
+            self._hard_seen.add(signum)
+        else:
+            self._notice_seen = True
         self._event.set()
         if self._callback is not None:
             self._callback(signum)
@@ -120,6 +173,22 @@ class PreemptionHandler:
     @property
     def triggered(self) -> bool:
         return self._event.is_set()
+
+    @property
+    def grace_deadline(self) -> Optional[float]:
+        """time.time() by which the host may be gone (first notice +
+        HVD_PREEMPT_GRACE_S); None before any signal."""
+        if self.t_signal is None:
+            return None
+        return self.t_signal + self.grace_s
+
+    def grace_remaining(self) -> Optional[float]:
+        """Seconds of the preemption grace window left (clamped at 0)
+        — the emergency-save budget; None before any signal."""
+        dl = self.grace_deadline
+        if dl is None:
+            return None
+        return max(0.0, dl - time.time())
 
     def __enter__(self) -> "PreemptionHandler":
         return self.install()
@@ -259,7 +328,8 @@ class ElasticTrainer:
                  handler: Optional[PreemptionHandler] = None,
                  retry: Optional[RetryPolicy] = None,
                  install_signals: bool = True,
-                 dataset: Any = None, rng: Any = None):
+                 dataset: Any = None, rng: Any = None,
+                 migrate_world: bool = False):
         self.directory = directory
         self.save_every = save_every
         self.keep = keep
@@ -283,6 +353,15 @@ class ElasticTrainer:
         self.rng = rng
         if rng is not None:
             _rng_state(rng)  # validate the type NOW, not at save time
+        # Elastic resize (docs/resilience.md "Elastic membership"):
+        # with migrate_world on, a snapshot cursor from a DIFFERENT
+        # (rank, world) is migrated — the dataset rebalances the
+        # interrupted epoch's untrained remainder across the new
+        # world — instead of degrading to the epoch-boundary
+        # fallback. `resize_report` keeps the newest migration's
+        # evidence (old/new world, records reassigned).
+        self.migrate_world = bool(migrate_world)
+        self.resize_report: Optional[Dict] = None
         self.data_start: Tuple[int, int] = (0, 0)
         self.resume_gap_batches = 0
         self.cursor_fallbacks = 0
@@ -367,6 +446,7 @@ class ElasticTrainer:
             exact, aux_err = False, (
                 f"snapshot step {aux.get('step')!r} != restored "
                 f"step {step}")
+        self.resize_report = None
         if exact and aux is not None:
             try:
                 if self.dataset is not None:
@@ -375,7 +455,7 @@ class ElasticTrainer:
                         raise ValueError(
                             "snapshot has no data cursor (saved "
                             "without an attached dataset?)")
-                    self.dataset.restore(data_state)
+                    self._restore_data(step, data_state)
                     self.data_start = tuple(self.dataset.cursor)
                 if self.rng is not None:
                     if aux.get("rng") is None:
@@ -429,6 +509,65 @@ class ElasticTrainer:
             guard_state=(aux or {}).get("guard"),
             exact=bool(exact), gap_batches=int(gap))
         return restored, step
+
+    def _restore_data(self, step: int, data_state: Dict) -> None:
+        """The dataset leg of an exact resume. Plain restore first;
+        with `migrate_world` on, a cursor whose ONLY incompatibility
+        is its (rank, world) identity is migrated instead — the
+        elastic-resize path: the dataset rebalances the interrupted
+        epoch's untrained remainder across the current world
+        (`ShardedDataset.restore(migrate=True)`), which is still an
+        EXACT resume (gap 0 — nothing replayed, nothing skipped; the
+        union over ranks is pinned by the resize equivalence
+        harness). Any other mismatch re-raises into the loud
+        epoch-boundary fallback as before."""
+        from horovod_tpu.data import DataStateError
+        try:
+            self.dataset.restore(data_state)
+            return
+        except DataStateError:
+            if not self.migrate_world:
+                raise
+        t0 = time.time()
+        # Raises DataStateError itself when more than the world
+        # identity mismatches — the caller's fallback handles it.
+        self.dataset.restore(data_state, migrate=True)
+        rebalance = getattr(self.dataset, "last_rebalance", None)
+        if rebalance is None:
+            # Same-world rank relabel (streams are slot-indexed —
+            # rank k's suffix continues unchanged): an exact resume,
+            # not a resize. No rebalance happened, so no resize
+            # event/metrics — they would read as phantom resizes.
+            return
+        report = dict(rebalance)
+        report["step"] = int(step)
+        report["rebalance_s"] = round(time.time() - t0, 6)
+        self.resize_report = report
+        from horovod_tpu.obs import catalog as _obs_catalog
+        from horovod_tpu.obs import events as _events
+        from horovod_tpu.obs import flightrec as _flightrec
+        m = _obs_catalog.elastic_metrics()
+        m["rebalance"].observe(report["rebalance_s"])
+        m["records_reassigned"].inc(
+            int(report.get("records_reassigned", 0)))
+        _events.emit(
+            "training.resize", step=int(step),
+            old_world=report.get("old_world"),
+            new_world=report.get("new_world"),
+            rank=int(getattr(self.dataset, "rank", -1)),
+            epoch=report.get("epoch"),
+            from_batch=report.get("from_batch"),
+            records_reassigned=report.get("records_reassigned"),
+            rebalance_ms=round(report["rebalance_s"] * 1e3, 3))
+        _flightrec.trigger(
+            "training.resize", step=int(step),
+            old_world=report.get("old_world"),
+            new_world=report.get("new_world"))
+        sys.stderr.write(
+            f"horovod_tpu: elastic resize at step {step} — world "
+            f"{report.get('old_world')} -> {report.get('new_world')}, "
+            f"{report.get('records_reassigned')} record(s) of epoch "
+            f"{report.get('epoch')} rebalanced\n")
 
     # -- the per-step hook --------------------------------------------
 

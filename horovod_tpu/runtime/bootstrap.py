@@ -244,3 +244,49 @@ def num_processes() -> int:
 def mesh():
     """The framework-owned `jax.sharding.Mesh` (1-D `data` axis)."""
     return _state.check_initialized().mesh
+
+
+def world_generation() -> int:
+    """Monotonic elastic-world generation: 0 at launch, +1 per
+    committed resize (resilience/membership.py). Readable before
+    init() — an uninitialized runtime is generation 0."""
+    return _state.global_state().world_generation
+
+
+def apply_resize(new_rank: int, new_world: int, generation: int, *,
+                 rekey_runtime: bool = True) -> None:
+    """Re-key the runtime's membership after a committed elastic
+    resize (docs/resilience.md "Elastic membership").
+
+    Updates rank/size and the monotonic world generation in place —
+    the process survives the resize, so the runtime is re-keyed, not
+    re-initialized. Safe on an uninitialized runtime: only the
+    bookkeeping fields and the `hvd_elastic_generation` gauge move.
+    A real multi-controller deployment additionally rebuilds its mesh
+    from the surviving devices before the next compiled step — that
+    device-plane re-key is the caller's hook (the mesh cannot be
+    rebuilt here for ranks whose devices are gone).
+
+    ``rekey_runtime=False`` records the generation WITHOUT touching
+    the membership fields — the in-process simulated worlds
+    (`resilience.membership.SimulatedWorld`), where many fake ranks
+    share one process, must never rewrite the real runtime's
+    rank/size out from under coexisting code."""
+    st = _state.global_state()
+    with st.lock:
+        if generation < st.world_generation:
+            raise ValueError(
+                f"resize generation {generation} is not monotonic "
+                f"(current {st.world_generation})")
+        st.world_generation = int(generation)
+        if rekey_runtime and st.initialized:
+            st.rank = int(new_rank)
+            st.size = int(new_world)
+            # Compiled collectives are keyed on the old mesh; drop the
+            # eager-op cache so nothing re-dispatches against a world
+            # that no longer exists.
+            st.op_cache = {}
+            st.mc_mesh2 = None
+    from horovod_tpu.obs import catalog as _obs_catalog
+    _obs_catalog.elastic_metrics()["generation"].set(
+        float(generation))

@@ -323,6 +323,22 @@ register_knob(
     "quantile is duplicated on a second replica and the loser "
     "cancelled; <= 0 disables hedging")
 register_knob(
+    "HVD_LEASE_S", "float", "2.0", "resilience/membership.py",
+    "Elastic membership: heartbeat lease in seconds — a rank whose "
+    "newest heartbeat is older than this is declared dead and the "
+    "world resizes (docs/resilience.md 'Elastic membership')")
+register_knob(
+    "HVD_HEARTBEAT_S", "float", "(lease/4)",
+    "resilience/membership.py",
+    "Elastic membership: heartbeat write cadence in seconds "
+    "(default lease/4 — the lease tolerates isolated dropped beats)")
+register_knob(
+    "HVD_PREEMPT_GRACE_S", "float", "30", "resilience/elastic.py",
+    "Preemption grace window in seconds: how long after a preemption "
+    "notice (SIGUSR1/SIGTERM) the host is expected to survive — "
+    "PreemptionHandler.grace_remaining() budgets the emergency "
+    "checkpoint against it (docs/resilience.md)")
+register_knob(
     "HVD_RETRY_BUDGET", "int", str(DEFAULT_RETRY_BUDGET),
     "runtime/config.py",
     "Serving fleet: router retry-budget token-bucket capacity for "

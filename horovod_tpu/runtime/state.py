@@ -51,6 +51,12 @@ class GlobalState:
         self.local_size: int = -1
         self.process_rank: int = -1
         self.num_processes: int = -1
+        # Elastic membership (resilience/membership.py): monotonic
+        # world generation — bumps on every committed resize; 0 is the
+        # launch world. Survives init-state checks: a resize re-keys
+        # the membership fields above in place rather than tearing
+        # the runtime down.
+        self.world_generation: int = 0
         # Device topology.
         self.mesh: Optional[Any] = None          # jax.sharding.Mesh
         self.axis_name: str = "data"
@@ -70,6 +76,7 @@ class GlobalState:
         self.shut_down = False
         self.rank = self.size = self.local_rank = self.local_size = -1
         self.process_rank = self.num_processes = -1
+        self.world_generation = 0
         self.mesh = None
         self.devices = []
         self.op_cache = {}

@@ -329,6 +329,204 @@ class TestLoaderParityAndResume:
         _assert_streams_equal(resumed, full[3:])
 
 
+def _record_ids(stream):
+    """Flatten a batch stream to per-record content hashes (record
+    identity — the fixture's float images are unique; batch GROUPING
+    deliberately does not participate)."""
+    from horovod_tpu.resilience.membership import record_keys
+    return [k for b in stream for k in record_keys(b)]
+
+
+class TestElasticRebalance:
+    """World-portable cursors (docs/resilience.md "Elastic
+    membership"): `restore(migrate=True)` / `rebalance()` must
+    repartition exactly the untrained remainder — no record twice,
+    none dropped — including across chained resizes, and a crash
+    mid-migrated-epoch must restore bitwise."""
+
+    def _snapshot_at(self, paths, world, batches, seed=3):
+        """Leader cursor of a lockstep world after `batches` full
+        batches, plus the per-rank consumed record ids and the full
+        epoch's record universe."""
+        consumed, universe = [], []
+        saved = None
+        for rank in range(world):
+            with hd.ShardedDataset(paths, SPEC, 4, shuffle=True,
+                                   seed=seed, rank=rank,
+                                   world=world) as ds:
+                stream = _stream(ds, 0)
+                universe += _record_ids(stream)
+                consumed += _record_ids(stream[:batches])
+            with hd.ShardedDataset(paths, SPEC, 4, shuffle=True,
+                                   seed=seed, rank=rank,
+                                   world=world) as ds:
+                it = ds.epoch(0)
+                for _ in range(batches):
+                    next(it)
+                if rank == 0:
+                    saved = ds.state()
+                del it
+        return saved, consumed, universe
+
+    @pytest.mark.parametrize("new_world", [3, 5])
+    def test_shrink_and_grow_union_is_untrained_remainder(
+            self, shards, new_world):
+        paths, _ = shards
+        saved, consumed, universe = self._snapshot_at(
+            paths, world=4, batches=2)
+        expected = sorted(set(universe) - set(consumed))
+        union = []
+        for k in range(new_world):
+            with hd.ShardedDataset(paths, SPEC, 4, shuffle=True,
+                                   seed=3, rank=k,
+                                   world=new_world) as ds:
+                ds.restore(saved, migrate=True)
+                assert ds.last_rebalance["records_reassigned"] == \
+                    len(expected)
+                e, b = ds.cursor
+                assert (e, b) == (0, 0)
+                union += _record_ids(ds.epoch(e, start_batch=b))
+        assert len(union) == len(set(union))   # no record twice
+        assert sorted(union) == expected       # none dropped
+
+    def test_chained_shrink_then_grow(self, shards):
+        paths, _ = shards
+        saved, consumed, universe = self._snapshot_at(
+            paths, world=4, batches=2)
+        # shrink 4 -> 3, consume one migrated batch per new rank
+        mids = []
+        consumed2 = set(consumed)
+        for k in range(3):
+            ds = hd.ShardedDataset(paths, SPEC, 4, shuffle=True,
+                                   seed=3, rank=k, world=3)
+            ds.restore(saved, migrate=True)
+            it = ds.epoch(0)
+            consumed2 |= set(_record_ids([next(it)]))
+            mids.append(ds.state())
+            del it
+            ds.close()
+        # grow 3 -> 5 mid-migrated-epoch: history chains
+        expected = sorted(set(universe) - consumed2)
+        union = []
+        for k in range(5):
+            with hd.ShardedDataset(paths, SPEC, 4, shuffle=True,
+                                   seed=3, rank=k, world=5) as ds:
+                ds.restore(mids[0], migrate=True)
+                assert len(ds.migration["history"]) == 2
+                union += _record_ids(ds.epoch(*ds.cursor))
+        assert len(union) == len(set(union))
+        assert sorted(union) == expected
+
+    @pytest.mark.parametrize("native", [True, False],
+                             ids=["native", "python"])
+    def test_migrated_epoch_crash_restores_bitwise(
+            self, shards, monkeypatch, native):
+        """Both loader impls: a snapshot cut mid-MIGRATED-epoch
+        restores to exactly the remaining migrated batches, and the
+        epoch after the migrated one runs the normal resharded
+        stream."""
+        paths, _ = shards
+        saved, _, _ = self._snapshot_at(paths, world=4, batches=2)
+        kw = dict(batch_size=4, shuffle=True, seed=3, rank=1, world=3)
+        with _ds(paths, monkeypatch, native, **kw) as ds:
+            ds.restore(saved, migrate=True)
+            full = _stream(ds, 0)
+            next_epoch = _stream(ds, 1)
+        with _ds(paths, monkeypatch, native, **kw) as ds:
+            ds.restore(saved, migrate=True)
+            it = ds.epoch(0)
+            next(it)
+            snap = ds.state()
+            assert "migration" in snap
+            del it
+        with _ds(paths, monkeypatch, native, **kw) as ds2:
+            ds2.restore(snap)
+            _assert_streams_equal(_stream(ds2, *ds2.cursor), full[1:])
+            # migration consumed; epoch 1 is the normal world-3 stream
+            assert ds2.migration is None
+            _assert_streams_equal(_stream(ds2, 1), next_epoch)
+
+    def test_rebalance_in_place(self, shards):
+        """`rebalance()` migrates a LIVE dataset from its own cursor
+        (no snapshot round-trip) and rebuilds the impl under the new
+        (rank, world)."""
+        paths, _ = shards
+        live = hd.ShardedDataset(paths, SPEC, 4, shuffle=True, seed=3,
+                                 rank=0, world=4)
+        it = live.epoch(0)
+        next(it), next(it)
+        del it
+        report = live.rebalance(0, 3)
+        assert report["old_world"] == 4 and report["new_world"] == 3
+        assert live.world == 3 and live.migration is not None
+        mine = _record_ids(live.epoch(*live.cursor))
+        # oracle: remainder_after partition for rank 0 of 3
+        counts = [16, 16, 16, 16]
+        rem = hd.remainder_after(counts, [(4, 2)], batch_size=4,
+                                 seed=3, epoch=0, shuffle=True,
+                                 drop_remainder=False)
+        assert len(mine) == len(rem[0::3])
+        live.close()
+
+    def test_restore_world_mismatch_names_expected_and_got(
+            self, shards):
+        """Satellite fix: resize-migration failures must be
+        debuggable — the error names expected vs got for world/rank
+        AND points at the migration path."""
+        paths, _ = shards
+        with hd.ShardedDataset(paths, SPEC, 8, shuffle=True, seed=1,
+                               rank=0, world=2) as ds:
+            good = ds.state()
+            with pytest.raises(hd.DataStateError) as ei:
+                ds.restore(dict(good, world=4, rank=3))
+            msg = str(ei.value)
+            assert "world: expected 2" in msg
+            assert "got 4" in msg
+            assert "rank: expected 0" in msg
+            assert "got 3" in msg
+            assert "migrate=True" in msg
+            # a non-world mismatch must NOT advertise migration
+            with pytest.raises(hd.DataStateError) as ei2:
+                ds.restore(dict(good, seed=9, world=4))
+            assert "migrate=True" not in str(ei2.value)
+            # ...and migrate=True still refuses non-world mismatches
+            with pytest.raises(hd.DataStateError, match="seed"):
+                ds.restore(dict(good, seed=9, world=4), migrate=True)
+
+    def test_drop_remainder_excludes_never_owed_tail(self, tmp_path):
+        """With drop_remainder the per-rank tail the uninterrupted
+        epoch would have dropped is NOT owed to the resized union."""
+        arrays = _arrays(30)
+        paths = hd.write_shards(str(tmp_path), "dr", SPEC, arrays, 2)
+        kw = dict(batch_size=4, shuffle=True, seed=2,
+                  drop_remainder=True)
+        trained = []
+        for r in range(2):
+            with hd.ShardedDataset(paths, SPEC, rank=r, world=2,
+                                   **kw) as ds:
+                trained += _record_ids(ds.epoch(0))
+        saved = None
+        with hd.ShardedDataset(paths, SPEC, rank=0, world=2,
+                               **kw) as ds:
+            it = ds.epoch(0)
+            next(it)
+            saved = ds.state()
+            del it
+        consumed = []
+        for r in range(2):
+            with hd.ShardedDataset(paths, SPEC, rank=r, world=2,
+                                   **kw) as ds:
+                consumed += _record_ids(ds.epoch(0))[:4]
+        expected = sorted(set(trained) - set(consumed))
+        union = []
+        for k in range(3):
+            with hd.ShardedDataset(paths, SPEC, rank=k, world=3,
+                                   **kw) as ds:
+                ds.restore(saved, migrate=True)
+                union += _record_ids(ds.epoch(*ds.cursor))
+        assert sorted(union) == expected
+
+
 class TestTokenPacking:
     def test_pack_tokens_concat_and_tail_drop(self):
         rows = hd.pack_tokens([[1, 2, 3], [4, 5], [6, 7, 8, 9]], 4)
