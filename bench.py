@@ -1155,6 +1155,30 @@ def run_elastic_check(args):
     from horovod_tpu.resilience.equivalence import (
         run_resize_equivalence)
 
+    if getattr(args, "real_procs", False):
+        # The REAL multi-controller drill (resilience/drill.py):
+        # hvdrun worker processes over the rendezvous KV, an actual
+        # SIGKILL, lease detection through the shared FailureDetector,
+        # commit'd resize, union-bitwise-exact resume. detect_s /
+        # time_to_resume_s here are the multi-PROCESS numbers the
+        # simulated world cannot honestly produce.
+        from horovod_tpu.resilience.drill import run_drill
+        workdir = tempfile.mkdtemp(prefix="hvd_elastic_mc_")
+        try:
+            dreport = run_drill(workdir, log=log)
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+        result = {
+            "metric": "elastic_mc_drill",
+            "value": 1.0 if dreport.ok else 0.0,
+            "unit": "bool",
+            "vs_baseline": None,  # reference: mpirun kills the job
+            **dreport.summary(),
+        }
+        _set_best(result)
+        emit(_BEST_RESULT)
+        write_out(args)
+        return 0 if result["value"] else 1
     workdir = tempfile.mkdtemp(prefix="hvd_elastic_check_")
     try:
         report = run_resize_equivalence(workdir, log=log)
@@ -1538,6 +1562,16 @@ def main():
                          "to an uninterrupted run, resize count, "
                          "time-to-resume p50/max, records reassigned "
                          "(docs/resilience.md 'Elastic membership')")
+    ap.add_argument("--real-procs", action="store_true",
+                    help="with --elastic-check: run the REAL "
+                         "multi-controller drill instead of the "
+                         "in-process simulated world — hvdrun-"
+                         "launched worker processes over the "
+                         "rendezvous KV server, a real SIGKILL of "
+                         "one worker, survivors detect -> resize -> "
+                         "exact resume; records detect_s and "
+                         "time_to_resume_s for the multi-process "
+                         "path (resilience/drill.py)")
     args = ap.parse_args()
 
     if args.resume_check:

@@ -125,6 +125,25 @@ HVD_CHAOS=rank_death:1 JAX_PLATFORMS=cpu \
     2>&1 | tee /tmp/hvd_elastic_smoke.log
 grep -q "resize equivalence OK" /tmp/hvd_elastic_smoke.log
 
+# Multi-controller elastic smoke (docs/resilience.md "The
+# multi-process drill"): the REAL thing — hvdrun launches 3 worker
+# processes over the native rendezvous KV server (--elastic: a signal
+# death is a membership event, not a job failure), each worker
+# installs BootstrapKV as its membership transport and trains in
+# KV-coordinated lockstep (no cross-process jax collectives), worker
+# 2 SIGKILLs itself mid-epoch, the survivors' shared FailureDetector
+# sees the lease lapse, the resize protocol commits generation 1,
+# bootstrap.apply_resize re-keys the runtime, and training resumes
+# from the committed TrainSnapshot with the shard remainder
+# rebalanced — the driver verifies the surviving world's final states
+# agree bitwise and the effective per-record union equals every
+# dataset record exactly once per epoch, then prints the OK line.
+rm -rf /tmp/hvd_elastic_mc
+JAX_PLATFORMS=cpu python -m horovod_tpu.resilience.drill \
+    --workdir /tmp/hvd_elastic_mc --world 3 --kill-rank 2 \
+    2>&1 | tee /tmp/hvd_elastic_mc.log
+grep -q "resize equivalence OK (multi-process)" /tmp/hvd_elastic_mc.log
+
 # Chaos smoke (docs/resilience.md): one injected checkpoint-write
 # failure mid-run — the shared RetryPolicy must retry with backoff and
 # the run must still complete and leave a restorable checkpoint.

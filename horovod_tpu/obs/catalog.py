@@ -261,6 +261,36 @@ def elastic_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
     }
 
 
+def detector_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """The unified failure-detection plane (resilience/detector.py,
+    docs/resilience.md "Failure detection"): graduated suspicion
+    states, transition accounting, and the flap-damping evidence that
+    a slow-but-alive peer is being drained, not flapped dead."""
+    reg = reg or registry()
+    return {
+        "peers": reg.gauge(
+            "hvd_detector_peers",
+            "Registered peers by suspicion state (alive, suspect, "
+            "dead) at the newest sweep", ("state",)),
+        "transitions": reg.counter(
+            "hvd_detector_transitions_total",
+            "Suspicion-state transitions per peer, by destination "
+            "state (to=suspect is a drain, to=dead the failover/"
+            "resize verdict, to=alive a recovery)", ("peer", "to")),
+        "flaps": reg.counter(
+            "hvd_detector_flaps_total",
+            "Recoveries to ALIVE per peer — bounded by hysteresis + "
+            "flap damping (HVD_DETECTOR_FLAP_MAX per "
+            "HVD_DETECTOR_FLAP_WINDOW_S; a damped peer holds at "
+            "SUSPECT instead of flapping)", ("peer",)),
+        "sweeps": reg.counter(
+            "hvd_detector_sweeps_total",
+            "Evidence-evaluation sweeps by the shared detector "
+            "thread (one thread per process, however many "
+            "consumers)"),
+    }
+
+
 def training_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
     """The training plane: step cadence, throughput, and the MFU
     gauge (analytic FLOPs over the device's peak,
@@ -366,6 +396,7 @@ def declare_standard_metrics(
         "router": router_metrics(reg),
         "resilience": resilience_metrics(reg),
         "elastic": elastic_metrics(reg),
+        "detector": detector_metrics(reg),
         "training": training_metrics(reg),
         "collectives": collective_metrics(reg),
         "slo": slo_metrics(reg),

@@ -56,6 +56,20 @@ def _local_rank() -> int:
         return 0
 
 
+def _expected_ranks() -> Optional[int]:
+    """The world size an exchange should hear from (None when the
+    runtime is uninitialized or single-process) — lets the merged
+    report flag ranks that stopped reporting entirely."""
+    try:
+        from horovod_tpu.runtime import state as _state
+        st = _state.global_state()
+        if st.initialized and int(st.size) > 1:
+            return int(st.size)
+    except (ImportError, AttributeError, RuntimeError, ValueError):
+        pass
+    return None
+
+
 def merge_windows(windows: List[Dict],
                   expected_ranks: Optional[int] = None
                   ) -> Optional[Dict]:
@@ -234,7 +248,8 @@ class StragglerTracker:
                     windows = [local]   # degraded: local-only report
             else:
                 windows = [local]
-        report = merge_windows(windows)
+        report = merge_windows(windows,
+                               expected_ranks=_expected_ranks())
         if report is None:
             return None
         from horovod_tpu.obs import catalog as _obs_catalog
@@ -249,6 +264,17 @@ class StragglerTracker:
                 slowest_rank=report["slowest_rank"],
                 skew_s=round(report["skew_s"], 6),
                 ranks=report["ranks"])
+        if report["straggler"] or report.get("missing_ranks"):
+            # Collective-stall attribution is failure EVIDENCE: feed
+            # the unified detector (resilience/detector.py) so a rank
+            # that stopped reporting (or is consistently slow) reads
+            # SUSPECT to every consumer — soft evidence only, never a
+            # death verdict (the heartbeat lease owns that).
+            try:
+                from horovod_tpu.resilience import detector as _det
+                _det.shared_detector().ingest_stall_report(report)
+            except _EXCHANGE_ERRORS:
+                pass   # evidence is best-effort; the report stands
         with self._lock:
             self._last_report = report
         return report

@@ -45,6 +45,11 @@ from horovod_tpu.resilience.chaos import (
     armed,
     fires,
 )
+from horovod_tpu.resilience.detector import (
+    FailureDetector,
+    install_detector,
+    shared_detector,
+)
 from horovod_tpu.resilience.elastic import (
     ElasticTrainer,
     NaNGuard,
@@ -53,8 +58,10 @@ from horovod_tpu.resilience.elastic import (
 )
 from horovod_tpu.resilience.membership import (
     BootstrapKV,
+    ChaosKV,
     ElasticBarrier,
     InProcessKV,
+    KVTransportError,
     MembershipError,
     ResizeDecision,
     SimulatedWorld,
@@ -69,10 +76,11 @@ from horovod_tpu.resilience.retry import (
 
 __all__ = [
     "ChaosError", "ChaosMonkey", "armed", "fires",
+    "FailureDetector", "install_detector", "shared_detector",
     "RetryError", "RetryPolicy", "default_io_policy",
     "ElasticTrainer", "NaNGuard", "PreemptionHandler",
     "TrainSnapshot",
-    "BootstrapKV", "ElasticBarrier", "InProcessKV",
-    "MembershipError", "ResizeDecision", "SimulatedWorld",
-    "WorldMonitor", "install_kv",
+    "BootstrapKV", "ChaosKV", "ElasticBarrier", "InProcessKV",
+    "KVTransportError", "MembershipError", "ResizeDecision",
+    "SimulatedWorld", "WorldMonitor", "install_kv",
 ]

@@ -315,6 +315,16 @@ _SITE_DOCS: Dict[str, str] = {
     "heartbeat_drop": "a heartbeat write is lost in transit — lease "
                       "math must tolerate isolated misses without a "
                       "false death",
+    "kv_drop": "a rendezvous-KV round-trip is lost in transit — the "
+               "shared RetryPolicy must absorb isolated drops "
+               "(typed KVTransportError on exhaustion)",
+    "kv_delay": "a slow rendezvous-KV round-trip (congested "
+                "coordinator) — leases must tolerate it",
+    "kv_partition": "ASYMMETRIC partition: this process's KV writes "
+                    "stop landing while reads still work — the "
+                    "minority member must adopt the commit that "
+                    "excludes it and exit MembershipError, never "
+                    "split-brain at the old generation",
 }
 
 _SITE_CALL_RE = (r'(?:chaos\s*\.\s*)?(?:fires|slow_site)\(\s*'

@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
+import itertools
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -52,7 +53,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from horovod_tpu.resilience import chaos
-from horovod_tpu.runtime.config import env_float
+from horovod_tpu.resilience import detector as _detector
+from horovod_tpu.resilience.retry import RetryError, RetryPolicy
+from horovod_tpu.runtime.config import env_float, env_int
 
 
 class MembershipError(RuntimeError):
@@ -60,6 +63,73 @@ class MembershipError(RuntimeError):
     declared dead by the others (its lease lapsed while it was
     paused/partitioned) and a newer generation excludes it. The only
     safe answer is to stop and re-join as a fresh member."""
+
+
+class KVTransportError(MembershipError):
+    """A rendezvous-KV round-trip failed even after the shared
+    `RetryPolicy` ran dry — the typed answer to what used to surface
+    as a raw socket error out of the heartbeat thread. Consumers
+    degrade: a heartbeat counts a missed beat, the watch loop skips a
+    tick, the resize protocol times out into `MembershipError`."""
+
+
+class _KVFault(OSError):
+    """One failed KV attempt (transport down, chaos ``kv_drop`` /
+    ``kv_partition``) — an `OSError` so the shared `RetryPolicy`
+    retries it as transient; `KVTransportError` is what escapes once
+    the policy gives up."""
+
+
+def _kv_policy() -> RetryPolicy:
+    """The KV transport's retry schedule: `HVD_IO_RETRIES` attempts
+    (the same knob checkpoint/data I/O honor) with a tighter base
+    delay — membership traffic is latency-sensitive (heartbeats race
+    leases)."""
+    return RetryPolicy(max_attempts=max(1, env_int("HVD_IO_RETRIES", 3)),
+                       base_delay_s=0.02, max_delay_s=0.25)
+
+
+def _kv_chaos(op: str) -> None:
+    """The KV transport-fault chaos sites, applied to every hardened
+    round-trip (docs/resilience.md chaos-site table):
+
+    * ``kv_drop`` — this round-trip is lost in transit (both
+      directions); the retry policy must absorb isolated drops.
+    * ``kv_partition`` — ASYMMETRIC partition: writes from this
+      process stop landing while reads still work, the nastiest
+      split-brain shape — the minority member keeps seeing a live
+      world it can no longer prove itself alive to, and must exit
+      `MembershipError` once a commit excludes it.
+    * ``kv_delay`` — a slow round-trip (congested rendezvous);
+      leases must tolerate it.
+    """
+    if chaos.fires("kv_drop"):
+        raise _KVFault(f"chaos kv_drop: {op} round-trip lost")
+    if op == "put" and chaos.fires("kv_partition"):
+        raise _KVFault(f"chaos kv_partition: {op} did not land "
+                       f"(asymmetric write partition)")
+    chaos.slow_site("kv_delay", 0.05)
+
+
+def _hardened_call(policy: RetryPolicy, op: str, attempt: Callable, *,
+                   on_retry: Optional[Callable] = None,
+                   what: str = "KV"):
+    """The BootstrapKV/ChaosKV common core — one hardened round-trip:
+    the ``kv_*`` chaos sites + the shared `RetryPolicy` + typed
+    `KVTransportError` exhaustion. ``on_retry`` is the transport's
+    between-attempts hook (BootstrapKV reconnects + logs there)."""
+    def one():
+        _kv_chaos(op)
+        return attempt()
+
+    try:
+        return policy.call(
+            one, on_retry=on_retry if on_retry is not None
+            else (lambda *_: None))
+    except RetryError as e:
+        raise KVTransportError(
+            f"{what} {op} failed after {e.attempts} attempt(s): "
+            f"{e.__cause__!r}") from e
 
 
 # ---------------------------------------------------------------------------
@@ -104,6 +174,14 @@ class BootstrapKV:
     (`runtime/bootstrap.py` / `native.bindings.kv_set/kv_get`) — the
     deployment transport for multi-controller worlds; JSON values.
 
+    Every round-trip is HARDENED: the `kv_drop`/`kv_delay`/
+    `kv_partition` chaos sites model transport faults, each attempt
+    runs under the shared `RetryPolicy` (``HVD_IO_RETRIES``), a
+    failed round-trip tries a rendezvous RECONNECT between attempts
+    (the server restarting, a flapped link), and exhaustion raises
+    the typed `KVTransportError` — never a raw socket error out of
+    the heartbeat thread.
+
     Capability notes, honest by design: the native plane has no scan
     and no compare-and-swap. Neither breaks the protocol —
     `put_if_absent` degrades to read-then-write, which is benign
@@ -113,9 +191,20 @@ class BootstrapKV:
     them); join discovery, the one genuinely scan-shaped read, rides
     the well-known ``join_queue`` key instead (`scan` raises, and
     `WorldMonitor.joiners()` falls back). Heartbeats, death
-    detection, and the whole shrink path are targeted gets."""
+    detection, and the whole shrink path are targeted gets.
 
-    def __init__(self, native=None):
+    One honest ambiguity: the native ``kv_get`` answers None for
+    both "key absent" and "server unreachable". A miss inside
+    ``_TRUST_WINDOW_S`` of the last successful round-trip is trusted
+    as absent (protocol probes miss constantly — pinging per miss
+    would double the traffic); a miss outside it is verified with a
+    ``ping`` and escalates to reconnect-and-retry when the transport
+    is actually down."""
+
+    _TRUST_WINDOW_S = 1.0
+
+    def __init__(self, native=None, *,
+                 policy: Optional[RetryPolicy] = None):
         if native is None:
             from horovod_tpu.runtime import state as _rt_state
             native = _rt_state.global_state().native
@@ -126,20 +215,89 @@ class BootstrapKV:
                 "HOROVOD_KV set, or install an InProcessKV/"
                 "custom transport via membership.install_kv")
         self._native = native
+        self._policy = policy if policy is not None else _kv_policy()
+        self._lock = threading.Lock()
+        self._last_ok_t = float("-inf")
+        self.reconnects = 0
+
+    # -- transport plumbing -------------------------------------------
+
+    def _mark_ok(self):
+        with self._lock:
+            self._last_ok_t = time.monotonic()
+
+    def _recently_ok(self) -> bool:
+        with self._lock:
+            return (time.monotonic() - self._last_ok_t
+                    < self._TRUST_WINDOW_S)
+
+    def _reconnect(self):
+        """Best-effort rendezvous reconnect between retry attempts
+        (HOROVOD_KV names the server)."""
+        from horovod_tpu.runtime.config import env_str
+        addr = env_str("HOROVOD_KV")
+        if not addr or ":" not in addr:
+            return
+        host, port = addr.rsplit(":", 1)
+        with self._lock:
+            self.reconnects += 1
+        try:
+            self._native.connect(host, int(port), timeout_s=2.0)
+        except (OSError, ValueError, RuntimeError):
+            pass   # next attempt will fault again and re-enter here
+
+    def _call(self, op: str, attempt: Callable):
+        """One hardened round-trip: chaos sites + retry policy +
+        reconnect between attempts; typed exhaustion."""
+        def on_retry(exc, n, delay):
+            import sys
+            self._reconnect()
+            sys.stderr.write(
+                f"horovod_tpu membership: transient KV fault "
+                f"({exc!r}); retry {n} in {delay:.2f}s\n")
+
+        return _hardened_call(self._policy, op, attempt,
+                              on_retry=on_retry, what="rendezvous KV")
+
+    # -- the KV surface -----------------------------------------------
 
     def put(self, key: str, value) -> None:
         import json
-        self._native.kv_set(key, json.dumps(value).encode())
+        payload = json.dumps(value).encode()
+
+        def attempt():
+            if not self._native.kv_set(key, payload):
+                raise _KVFault(f"kv_set({key!r}) did not land")
+            self._mark_ok()
+
+        self._call("put", attempt)
 
     def get(self, key: str):
         import json
-        raw = self._native.kv_get(key, timeout_ms=0)
-        if raw is None:
-            return None
-        try:
-            return json.loads(raw.decode())
-        except (ValueError, UnicodeDecodeError):
-            return None
+
+        def attempt():
+            raw = self._native.kv_get(key, timeout_ms=0)
+            if raw is None:
+                # Absent vs unreachable: trust a recent success,
+                # otherwise verify the server actually answers.
+                if not self._recently_ok():
+                    try:
+                        alive = self._native.ping()
+                    except (OSError, RuntimeError):
+                        alive = False
+                    if not alive:
+                        raise _KVFault(
+                            f"kv_get({key!r}): rendezvous "
+                            f"unreachable")
+                    self._mark_ok()
+                return None
+            self._mark_ok()
+            try:
+                return json.loads(raw.decode())
+            except (ValueError, UnicodeDecodeError):
+                return None
+
+        return self._call("get", attempt)
 
     def put_if_absent(self, key: str, value):
         cur = self.get(key)
@@ -157,6 +315,39 @@ class BootstrapKV:
         # The rendezvous plane has no delete; an empty tombstone is
         # indistinguishable from absent for every protocol read.
         self.put(key, None)
+
+
+class ChaosKV:
+    """The same transport hardening `BootstrapKV` applies to the
+    native plane, composable around ANY membership KV (typically
+    `InProcessKV`): every round-trip passes the `kv_drop`/`kv_delay`/
+    `kv_partition` chaos sites under the shared `RetryPolicy`, with
+    typed `KVTransportError` exhaustion — how in-process worlds drill
+    transport faults (a partitioned member wraps only ITS handle;
+    the survivors' handles stay clean)."""
+
+    def __init__(self, inner, *, policy: Optional[RetryPolicy] = None):
+        self._inner = inner
+        self._policy = policy if policy is not None else _kv_policy()
+
+    def _call(self, op: str, fn: Callable, *args):
+        return _hardened_call(self._policy, op, lambda: fn(*args))
+
+    def put(self, key: str, value) -> None:
+        self._call("put", self._inner.put, key, value)
+
+    def get(self, key: str):
+        return self._call("get", self._inner.get, key)
+
+    def put_if_absent(self, key: str, value):
+        return self._call("put", self._inner.put_if_absent, key,
+                          value)
+
+    def scan(self, prefix: str) -> Dict[str, Any]:
+        return self._call("get", self._inner.scan, prefix)
+
+    def delete(self, key: str) -> None:
+        self._call("put", self._inner.delete, key)
 
 
 # The pluggable transport, `straggler.install_exchange`-style: None
@@ -185,6 +376,17 @@ def default_kv():
         if _KV is None:
             _KV = InProcessKV()
         return _KV
+
+
+# A member whose beat age crosses this fraction of the lease is
+# SUSPECT (drained by consumers that can drain; the resize protocol
+# ignores suspicion — only DEAD, age past the full lease, resizes).
+SUSPECT_LEASE_FRACTION = 0.5
+
+# Process-unique monitor ids for detector-peer namespacing (observer-
+# scoped: each member judges its peers through its own clock and KV
+# handle; id(self) would alias after garbage collection).
+_MONITOR_IDS = itertools.count()
 
 
 # ---------------------------------------------------------------------------
@@ -271,23 +473,44 @@ class WorldMonitor:
         self._thread: Optional[threading.Thread] = None
         self.beats = 0
         self.beats_missed = 0
+        # The shared failure detector owns the liveness question
+        # (resilience/detector.py): this monitor registers its peers'
+        # KV-lease beat ages as evidence, observer-scoped (each
+        # member judges peers through its own clock/KV handle), and
+        # reads graduated verdicts back — the inline lease arithmetic
+        # this class used to do. One sweep thread per process,
+        # however many monitors (and routers) are live.
+        self._det = _detector.shared_detector()
+        self._det_ns = f"wm/{next(_MONITOR_IDS)}"
+        self._det_peers: set = set()
+        # The never-beaten startup-grace reference (_beat_age);
+        # re-anchored by start().
+        self._start_t = self.clock()
 
     # -- heartbeats ----------------------------------------------------
 
     def heartbeat(self) -> bool:
         """One beat; False when the write was dropped (chaos
         ``heartbeat_drop`` or a transport fault) — the lease is sized
-        to survive isolated misses (default cadence = lease/4)."""
+        to survive isolated misses (default cadence = lease/4), and a
+        KV transport failure is a typed, COUNTED miss, not a raw
+        socket error out of the heartbeat thread."""
         if chaos.fires("heartbeat_drop"):
-            from horovod_tpu.obs import catalog as _obs_catalog
-            _obs_catalog.elastic_metrics()["heartbeats_missed"].inc()
-            with self._lock:
-                self.beats_missed += 1
-            return False
-        self.kv.put(f"hb/{self.member_id}", {"t": self.clock()})
+            return self._miss_beat()
+        try:
+            self.kv.put(f"hb/{self.member_id}", {"t": self.clock()})
+        except KVTransportError:
+            return self._miss_beat()
         with self._lock:
             self.beats += 1
         return True
+
+    def _miss_beat(self) -> bool:
+        from horovod_tpu.obs import catalog as _obs_catalog
+        _obs_catalog.elastic_metrics()["heartbeats_missed"].inc()
+        with self._lock:
+            self.beats_missed += 1
+        return False
 
     def announce_join(self) -> None:
         """Publish this (non-member) process's intent to join; the
@@ -304,28 +527,92 @@ class WorldMonitor:
     def _beat_age(self, member: str, now: float) -> float:
         hb = self.kv.get(f"hb/{member}")
         if not hb:
-            return float("inf")
+            # Startup grace: a member that has never beaten is aged
+            # from when THIS observer started watching, not from -inf
+            # — real multi-process worlds stagger their starts
+            # (import time, scheduler jitter), and an observer that
+            # came up first must not resize a still-booting peer out
+            # instantly. A peer that never comes up still expires on
+            # the ordinary lease schedule.
+            return now - self._start_t
         return now - float(hb.get("t", float("-inf")))
 
     def members(self) -> List[str]:
         with self._lock:
             return list(self._members)
 
+    # -- detector plumbing --------------------------------------------
+
+    def _peer_key(self, member: str) -> str:
+        return f"{self._det_ns}/{member}"
+
+    def _sync_detector_peers(self) -> None:
+        """Register every current peer (members minus self) with the
+        shared detector, KV-lease beat age as evidence; drop peers no
+        longer in the world. Idempotent — called at start() and after
+        every adopted commit."""
+        members = self.members()
+        want = {m for m in members if m != self.member_id}
+        with self._lock:
+            have = set(self._det_peers)
+            self._det_peers = set(want)
+        for m in have - want:
+            self._det.unregister(self._peer_key(m))
+        for m in want:
+            # Re-registering refreshes rank attribution after a
+            # resize (ranks are slots; stall reports name ranks).
+            self._det.register(
+                self._peer_key(m),
+                age_fn=(lambda m=m: self._beat_age(m, self.clock())),
+                clock=self.clock,
+                suspect_after=self.lease_s * SUSPECT_LEASE_FRACTION,
+                dead_after=self.lease_s,
+                label=m, poll_s=self.heartbeat_s,
+                rank=members.index(m))
+
+    def _peer_state(self, member: str) -> str:
+        """This peer's graduated verdict, evidence evaluated NOW (the
+        protocol's deterministic read). Falls back to direct lease
+        arithmetic for a peer not (or no longer) registered — e.g. a
+        stopped monitor probing one last time."""
+        key = self._peer_key(member)
+        with self._lock:
+            registered = member in self._det_peers
+        if registered:
+            return self._det.state_of(key, refresh=True)
+        age = self._beat_age(member, self.clock())
+        if age > self.lease_s:
+            return _detector.DEAD
+        if age > self.lease_s * SUSPECT_LEASE_FRACTION:
+            return _detector.SUSPECT
+        return _detector.ALIVE
+
     def alive_members(self, now: Optional[float] = None) -> List[str]:
-        """Current members whose lease has not lapsed (self always —
-        a member never declares itself dead)."""
-        now = self.clock() if now is None else now
-        out = []
-        for m in self.members():
-            if m == self.member_id or self._beat_age(m, now) <= self.lease_s:
-                out.append(m)
-        return out
+        """Current members the detector does not call DEAD (self
+        always — a member never declares itself dead; SUSPECT peers
+        are still alive: drained, not removed). An explicit ``now``
+        keeps the pre-detector point-in-time semantics: raw lease
+        arithmetic evaluated at that timestamp (``self.clock``
+        domain), bypassing the detector's graduated state."""
+        dead = set(self.dead_members(now))
+        return [m for m in self.members() if m not in dead]
 
     def dead_members(self, now: Optional[float] = None) -> List[str]:
-        now = self.clock() if now is None else now
+        if now is not None:
+            return [m for m in self.members()
+                    if m != self.member_id
+                    and self._beat_age(m, now) > self.lease_s]
         return [m for m in self.members()
                 if m != self.member_id
-                and self._beat_age(m, now) > self.lease_s]
+                and self._peer_state(m) == _detector.DEAD]
+
+    def suspect_members(self) -> List[str]:
+        """Peers under graduated suspicion (stale-but-not-dead
+        evidence, stall reports, flap damping) — drain candidates,
+        never resize triggers."""
+        return [m for m in self.members()
+                if m != self.member_id
+                and self._peer_state(m) == _detector.SUSPECT]
 
     def joiners(self) -> List[str]:
         cur = set(self.members())
@@ -347,11 +634,21 @@ class WorldMonitor:
 
     def pending_change(self) -> Optional[Dict]:
         """{'dead': [...], 'joiners': [...]} when the committed world
-        no longer matches reality, else None."""
+        no longer matches reality, else None. Also flags a NEWER
+        COMMIT this member has not adopted (``'commit': gen``) — how
+        a write-partitioned member finds out the world moved on
+        without it: its own beats stopped landing, the survivors
+        resized, and the only honest next step is `resize()`, which
+        adopts the commit and raises `MembershipError` if it excludes
+        this member (never split-brain at the old generation)."""
         dead, joiners = self.dead_members(), self.joiners()
-        if not dead and not joiners:
+        newer = self.kv.get(f"commit/{self.generation + 1}")
+        if not dead and not joiners and newer is None:
             return None
-        return {"dead": dead, "joiners": joiners}
+        out: Dict[str, Any] = {"dead": dead, "joiners": joiners}
+        if newer is not None:
+            out["commit"] = self.generation + 1
+        return out
 
     # -- the watcher thread --------------------------------------------
 
@@ -363,7 +660,9 @@ class WorldMonitor:
             self.kv.put_if_absent("commit/0", {
                 "generation": 0, "members": list(members),
                 "died": [], "joined": []})
+        self._start_t = self.clock()
         self.heartbeat()
+        self._sync_detector_peers()
         self._stop.clear()
         t = threading.Thread(target=self._watch_loop,
                              name=f"hvd-member-{self.member_id}",
@@ -374,10 +673,19 @@ class WorldMonitor:
         return self
 
     def _watch_loop(self):
+        """Heartbeat writer + change watcher. NOT a liveness sweep —
+        detection belongs to the shared `FailureDetector`; this
+        thread only writes this member's own beats and reacts to what
+        the detector (and the commit log) already concluded. A KV
+        transport fault costs the tick, never the thread."""
         while not self._stop.wait(self.heartbeat_s):
-            self.heartbeat()
-            if self.on_change is not None and self.pending_change():
-                self.on_change()
+            try:
+                self.heartbeat()
+                if (self.on_change is not None
+                        and self.pending_change()):
+                    self.on_change()
+            except KVTransportError:
+                continue   # typed + already counted; next tick retries
 
     def stop(self) -> None:
         """Stop beating and watching (clean shutdown: the lease will
@@ -389,6 +697,9 @@ class WorldMonitor:
             t, self._thread = self._thread, None
         if t is not None:
             t.join(timeout=5.0)
+        with self._lock:
+            self._det_peers = set()
+        self._det.unregister_prefix(self._det_ns + "/")
 
     def die(self) -> None:
         """Abrupt death for drills: stop heartbeating NOW, no
@@ -417,6 +728,7 @@ class WorldMonitor:
             rank=members.index(self.member_id), members=members,
             died=[m for m in prev if m not in members],
             joined=[m for m in members if m not in prev])
+        self._sync_detector_peers()
         self.kv.delete(f"join/{self.member_id}")
         queue = self.kv.get("join_queue") or []
         if self.member_id in queue:

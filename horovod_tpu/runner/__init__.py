@@ -106,6 +106,14 @@ def main(argv: List[str] | None = None) -> int:
                          "multi-process means CPU devices)")
     ap.add_argument("--devices-per-proc", type=int, default=1,
                     help="virtual CPU devices per worker (cpu platform)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic job: a worker killed by a SIGNAL "
+                         "(preemption, the elastic drill's SIGKILL) "
+                         "does NOT kill the job — survivors keep "
+                         "running and the exit code reflects them; a "
+                         "worker failing with a nonzero STATUS still "
+                         "fails the job (mpirun's all-or-nothing "
+                         "contract stays the default)")
     ap.add_argument("--no-prefix", action="store_true",
                     help="don't prefix worker output with [rank]")
     ap.add_argument("command", nargs=argparse.REMAINDER,
@@ -219,6 +227,7 @@ def main(argv: List[str] | None = None) -> int:
             threads.append(t)
 
     exit_code = 0
+    clean_exits = 0
     try:
         remaining = set(range(local_n))
         while remaining:
@@ -227,14 +236,31 @@ def main(argv: List[str] | None = None) -> int:
                 if rc is None:
                     continue
                 remaining.discard(i)
-                if rc != 0 and exit_code == 0:
+                if rc == 0:
+                    clean_exits += 1
+                    continue
+                if args.elastic and rc < 0:
+                    # Elastic contract: a signal death (preemption,
+                    # SIGKILL drill) is a MEMBERSHIP event, not a job
+                    # failure — the survivors' resize protocol owns
+                    # it from here.
+                    print(f"hvdrun: worker {rank_offset + i} died "
+                          f"with signal {-rc}; elastic job continues",
+                          file=sys.stderr)
+                    continue
+                if exit_code == 0:
                     exit_code = rc
-                    # mpirun behavior: one failure kills the job.
-                    for j in remaining:
-                        procs[j].terminate()
+                    if not args.elastic:
+                        # mpirun behavior: one failure kills the job.
+                        for j in remaining:
+                            procs[j].terminate()
             if remaining:
                 import time
                 time.sleep(0.2)
+        if args.elastic and exit_code == 0 and clean_exits == 0:
+            # Every worker died by signal: nobody survived to finish
+            # the job — that is a failure, not elasticity.
+            exit_code = 1
     except KeyboardInterrupt:
         for p in procs:
             p.send_signal(signal.SIGINT)

@@ -246,6 +246,33 @@ def mesh():
     return _state.check_initialized().mesh
 
 
+def connect_kv(addr: Optional[str] = None, *, timeout_s: float = 60.0):
+    """Attach this process to the launcher's rendezvous KV plane
+    WITHOUT full `init()` — no jax backend, no device mesh, no init
+    barrier. Returns the connected native control-plane client.
+
+    This is the multi-controller elastic drill's bootstrap
+    (`resilience/drill.py`): worker processes coordinate membership,
+    heartbeats and lockstep training entirely through the KV
+    (``membership.install_kv(BootstrapKV(connect_kv()))``), so the
+    drill runs on any box — including one whose jaxlib lacks
+    cross-process CPU collectives. ``addr`` defaults to the
+    launcher-set ``HOROVOD_KV``."""
+    if addr is None:
+        addr = _config.env_str("HOROVOD_KV")
+    if not addr or ":" not in addr:
+        raise RuntimeError(
+            "connect_kv needs a rendezvous address (host:port); "
+            "launch under hvdrun or pass addr= explicitly")
+    from horovod_tpu.native import load_native
+    native = load_native()
+    host, port = addr.rsplit(":", 1)
+    if not native.connect(host, int(port), timeout_s=timeout_s):
+        raise RuntimeError(
+            f"could not reach rendezvous server at {addr}")
+    return native
+
+
 def world_generation() -> int:
     """Monotonic elastic-world generation: 0 at launch, +1 per
     committed resize (resilience/membership.py). Readable before

@@ -339,6 +339,33 @@ register_knob(
     "PreemptionHandler.grace_remaining() budgets the emergency "
     "checkpoint against it (docs/resilience.md)")
 register_knob(
+    "HVD_DETECTOR_SWEEP_S", "float", "0.05",
+    "resilience/detector.py",
+    "Failure detector: shared sweep-thread cadence in seconds (per-"
+    "peer poll intervals may ask for faster; floor 0.005), "
+    "docs/resilience.md 'Failure detection'")
+register_knob(
+    "HVD_DETECTOR_HYSTERESIS", "int", "2",
+    "resilience/detector.py",
+    "Failure detector: consecutive good observations required to "
+    "leave SUSPECT (recovery hysteresis; death is never gated)")
+register_knob(
+    "HVD_DETECTOR_FLAP_WINDOW_S", "float", "30",
+    "resilience/detector.py",
+    "Failure detector: flap-damping window — recoveries inside it "
+    "count against HVD_DETECTOR_FLAP_MAX")
+register_knob(
+    "HVD_DETECTOR_FLAP_MAX", "int", "4",
+    "resilience/detector.py",
+    "Failure detector: recoveries allowed per flap window before the "
+    "peer is damped (held at SUSPECT — drained, not resurrected — "
+    "until the window decays)")
+register_knob(
+    "HVD_ELASTIC_DRILL_TIMEOUT_S", "float", "300",
+    "resilience/drill.py",
+    "Multi-process elastic drill: wall-clock budget for the whole "
+    "hvdrun-launched worker world (driver kills the job past it)")
+register_knob(
     "HVD_RETRY_BUDGET", "int", str(DEFAULT_RETRY_BUDGET),
     "runtime/config.py",
     "Serving fleet: router retry-budget token-bucket capacity for "

@@ -13,11 +13,18 @@ owns four robustness mechanisms (docs/serving.md "Fleet failover"):
 
 * **Health-gated, load-aware routing** — every placement consults the
   replica's `_health()` (a dead or closing dispatch thread takes no
-  new work), its SLO monitor (a fast-burning replica is drained from
-  rotation exactly as its own ``/healthz`` 503 asks), and its load
+  new work), the shared `FailureDetector`'s graduated verdict (a
+  SUSPECT replica — stale health evidence, flap-damped — is DRAINED
+  from rotation rather than killed; `resilience/detector.py` owns the
+  liveness question for router and training membership alike, one
+  sweep thread per host), its SLO monitor (a fast-burning replica is
+  drained exactly as its own ``/healthz`` 503 asks), and its load
   (queue depth + busy slots; least-loaded wins, round-robin ties).
   Per-request deadlines propagate into each engine's admission queue,
-  so queue-expiry keeps working across retries and migrations.
+  so queue-expiry keeps working across retries and migrations. DEAD
+  verdicts arrive by detector subscription — the router no longer
+  runs a private health-poll sweep; its monitor thread is purely the
+  REACTION layer (migrations, hedges, drains, replacements).
 * **Retry budget** — a shed (`QueueFullError`) or closed first answer
   is retried on another replica under a token bucket
   (``HVD_RETRY_BUDGET`` capacity, refilling at capacity/60 per
@@ -76,6 +83,7 @@ from horovod_tpu.obs import events as _events
 from horovod_tpu.obs import flightrec as _flightrec
 from horovod_tpu.obs import tracing as _tracing
 from horovod_tpu.resilience import chaos
+from horovod_tpu.resilience import detector as _detector
 from horovod_tpu.serving.admission import (
     DeadlineExceededError, EngineClosedError, QueueFullError,
     ServingError,
@@ -93,6 +101,11 @@ REPLICA_DEAD = "dead"
 # this the router never hedges (a cold fleet has no quantile worth
 # deriving a delay from).
 _HEDGE_MIN_SAMPLES = 8
+
+# Process-unique router ids for detector-peer namespacing (id(self)
+# would do, except CPython reuses addresses — a stale peer from a
+# collected router must never alias a new router's namespace).
+_ROUTER_IDS = itertools.count()
 
 
 class RetryBudget:
@@ -292,12 +305,24 @@ class ServingRouter:
         self._closing = False
         self._rng = random.Random(0xC0FFEE)
         self._wake = threading.Event()
+        # Liveness is OWNED by the shared FailureDetector
+        # (resilience/detector.py): each replica's engine health is a
+        # registered poll-evidence peer, and this router subscribes —
+        # SUSPECT drains the replica from rotation, DEAD triggers the
+        # (unchanged) declare-dead -> migrate -> cold-replace
+        # reactions. No private health-poll sweep: a host running a
+        # router fleet plus training membership has exactly one
+        # detector thread.
+        self._det = _detector.shared_detector()
+        self._det_ns = f"router/{next(_ROUTER_IDS)}"
+        self._detector_deaths: List[int] = []
         try:
             for _ in range(num_replicas):
                 eng = factory()
                 rep = _Replica(next(self._rep_ids), eng)
                 with self._lock:
                     self._replicas[rep.id] = rep
+                self._register_replica(rep)
         except BaseException:
             # A factory failing partway through fleet construction
             # must not leak the replicas already built (live dispatch
@@ -306,6 +331,7 @@ class ServingRouter:
             with self._lock:
                 built = [r.engine for r in self._replicas.values()]
                 self._replicas.clear()
+            self._det.unregister_prefix(self._det_ns + "/")
             for eng in built:
                 try:
                     eng.shutdown(drain=False, timeout=60)
@@ -331,6 +357,50 @@ class ServingRouter:
             self._m["requests"].inc(n, outcome=outcome)
         else:
             self._m[name].inc(n)
+
+    # -- detector plumbing --------------------------------------------
+
+    def _peer_key(self, rep: "_Replica") -> str:
+        return f"{self._det_ns}/{rep.id}"
+
+    def _register_replica(self, rep: "_Replica"):
+        """One poll-evidence peer per replica: healthy iff the
+        engine's own health surface says so. A probe that RAISES
+        reads unhealthy (a torn-down engine must be able to die, not
+        hide behind an evidence error)."""
+        def poll(rep=rep):
+            try:
+                return bool(rep.engine._health().get("healthy"))
+            except (ServingError, RuntimeError, AttributeError):
+                return False
+        self._det.register(
+            self._peer_key(rep), poll_fn=poll,
+            label=f"replica{rep.id}",
+            poll_s=self.health_poll_s,
+            suspect_after=0.0,   # any bad probe drains the replica
+            dead_after=max(3 * self.health_poll_s, 0.05),
+            on_transition=self._on_replica_transition)
+
+    def _on_replica_transition(self, key: str, old: str, new: str,
+                               view):
+        """Detector subscription (runs on the detector thread):
+        SUSPECT drains, recovery un-drains, DEAD hands the replica to
+        the monitor sweep — the REACTIONS (declare dead, migrate
+        token-exactly, cold-replace) are unchanged PR-9 machinery."""
+        del old, view
+        try:
+            rid = int(key.rsplit("/", 1)[1])
+        except ValueError:
+            return
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return
+            rep.suspect = new == _detector.SUSPECT
+            if new == _detector.DEAD and rep.state == REPLICA_UP:
+                self._detector_deaths.append(rid)
+        if new != _detector.ALIVE:
+            self._wake.set()
 
     # -- submit side ---------------------------------------------------
 
@@ -385,6 +455,12 @@ class ServingRouter:
         monitor (a fast-burning replica is drained from rotation, the
         consumer PR 8's burn-rate 503 was built for)."""
         if rep.state != REPLICA_UP:
+            return False
+        if rep.suspect:
+            # Graduated suspicion (the shared FailureDetector): a
+            # SUSPECT replica is DRAINED — no new placements — while
+            # its in-flight work keeps running; it re-enters rotation
+            # on recovery instead of being killed and cold-replaced.
             return False
         try:
             if not rep.engine._health().get("healthy"):
@@ -692,10 +768,11 @@ class ServingRouter:
     # -- the monitor ---------------------------------------------------
 
     def _monitor_loop(self):
-        """The router's background sweep: chaos kills, replica health,
-        pending migrations, hedge scans, first-token observation,
-        drains and cold replacements. Engine calls happen with the
-        router lock RELEASED."""
+        """The router's background sweep — the REACTION layer: chaos
+        kills, detector-verdict processing, pending migrations, hedge
+        scans, first-token observation, drains and cold replacements.
+        (Liveness DETECTION lives in the shared FailureDetector.)
+        Engine calls happen with the router lock RELEASED."""
         while not self._stop.is_set():
             self._wake.wait(self.health_poll_s)
             self._wake.clear()
@@ -716,21 +793,21 @@ class ServingRouter:
         # fault behind the failover acceptance tests and bench A/B.
         if chaos.fires("router.replica_kill"):
             self._chaos_kill()
-        # 2. Health: declare dead replicas (their engines already
-        # failed their futures — the engine's no-dangling-futures
-        # contract — so migration rides the attempt callbacks).
+        # 2. Liveness: drain the shared FailureDetector's DEAD
+        # verdicts (it polled the engines' health with graduated
+        # suspicion; this sweep owns only the REACTION). The dead
+        # engines already failed their futures — the engine's
+        # no-dangling-futures contract — so migration rides the
+        # attempt callbacks.
         with self._lock:
-            reps = list(self._replicas.values())
-        for rep in reps:
-            if rep.state == REPLICA_DEAD:
-                continue
-            try:
-                healthy = rep.engine._health().get("healthy", False)
-            except (ServingError, RuntimeError, AttributeError):
-                healthy = False
-            if not healthy and rep.state == REPLICA_UP:
-                self._declare_dead(rep, "health probe: dispatch dead "
-                                        "or engine closing")
+            verdicts, self._detector_deaths = (
+                self._detector_deaths, [])
+        for rid in verdicts:
+            with self._lock:
+                rep = self._replicas.get(rid)
+            if rep is not None and rep.state == REPLICA_UP:
+                self._declare_dead(rep, "failure detector: health "
+                                        "evidence expired (DEAD)")
         # 3. Token-exact migrations queued by attempt callbacks —
         # BEFORE cold replacement: with healthy siblings up, orphaned
         # streams must not wait out a synchronous factory build (an
@@ -783,6 +860,7 @@ class ServingRouter:
                 f"the replica stays dead\n")
 
     def _declare_dead(self, rep: "_Replica", why: str):
+        self._det.unregister(self._peer_key(rep))
         with self._lock:
             if rep.state == REPLICA_DEAD:
                 return
@@ -1027,6 +1105,7 @@ class ServingRouter:
             with self._lock:
                 rep.state = REPLICA_DEAD
                 rep.reaped = True
+            self._det.unregister(self._peer_key(rep))
             _events.emit("router.drained", replica=rep.id)
             dead.append(rep)
         for rep in dead:
@@ -1089,6 +1168,8 @@ class ServingRouter:
             else:
                 self._replicas.pop(rep.id, None)
                 self._replicas[fresh.id] = fresh
+        if not stillborn:
+            self._register_replica(fresh)
         if stillborn:
             try:
                 eng.shutdown(drain=False, timeout=60)
@@ -1185,6 +1266,11 @@ class ServingRouter:
             builders = list(self._builders)
         for b in builders:
             b.join()
+        # After the monitor and every builder joined: nobody can
+        # re-register a peer, so the namespace teardown cannot leak a
+        # poll closure over a shut-down engine into the shared
+        # detector.
+        self._det.unregister_prefix(self._det_ns + "/")
         with self._lock:
             reps = list(self._replicas.values())
             orphans = [p[0] for p in self._pending_migrations]
@@ -1233,3 +1319,4 @@ class _Replica:
         self.state = REPLICA_UP
         self.live = 0        # router attempts currently on this engine
         self.reaped = False  # dead replica already queued for replace
+        self.suspect = False  # detector SUSPECT: drained from rotation

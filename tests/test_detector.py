@@ -1,0 +1,326 @@
+"""Unified failure-detector tests (docs/resilience.md "Failure
+detection"): graduated ALIVE -> SUSPECT -> DEAD suspicion, recovery
+hysteresis, flap damping with a bounded flaps counter, evidence-error
+asymmetry (unavailable evidence can never read DEAD), stall-report
+ingestion, the DEAD-verdict flight-recorder bundle, and the
+one-sweep-thread-per-process contract shared by the serving router
+and training membership."""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.obs import events
+from horovod_tpu.obs.events import EventLog
+from horovod_tpu.resilience import chaos
+from horovod_tpu.resilience.detector import (ALIVE, DEAD, SUSPECT,
+                                             FailureDetector,
+                                             install_detector,
+                                             shared_detector)
+
+
+@pytest.fixture()
+def det():
+    """A quiet detector: huge poll_s keeps the background thread
+    parked, so tests drive evaluation deterministically through
+    state_of(refresh=True)."""
+    d = FailureDetector(sweep_s=999.0)
+    yield d
+    d.stop()
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestSuspicionStates:
+    def test_age_evidence_graduates(self, det):
+        clock = _Clock()
+        age = [0.0]
+        det.register("p", age_fn=lambda: age[0], clock=clock,
+                     suspect_after=1.0, dead_after=2.0, poll_s=999)
+        assert det.state_of("p", refresh=True) == ALIVE
+        age[0] = 1.5
+        assert det.state_of("p", refresh=True) == SUSPECT
+        age[0] = 2.5
+        assert det.state_of("p", refresh=True) == DEAD
+
+    def test_recovery_needs_hysteresis(self, det):
+        clock = _Clock()
+        age = [5.0]
+        det.register("p", age_fn=lambda: age[0], clock=clock,
+                     suspect_after=1.0, dead_after=2.0, poll_s=999,
+                     hysteresis=3)
+        assert det.state_of("p", refresh=True) == DEAD
+        age[0] = 0.0
+        # Two good observations: still held (hysteresis=3).
+        assert det.state_of("p", refresh=True) == DEAD
+        assert det.state_of("p", refresh=True) == DEAD
+        assert det.state_of("p", refresh=True) == ALIVE
+
+    def test_poll_evidence_suspects_then_dies(self, det):
+        ok = [True]
+        det.register("p", poll_fn=lambda: ok[0],
+                     suspect_after=0.0, dead_after=0.15, poll_s=999,
+                     hysteresis=1)
+        assert det.state_of("p", refresh=True) == ALIVE
+        ok[0] = False
+        assert det.state_of("p", refresh=True) == SUSPECT
+        time.sleep(0.2)
+        assert det.state_of("p", refresh=True) == DEAD
+        ok[0] = True
+        assert det.state_of("p", refresh=True) == ALIVE
+
+    def test_evidence_error_caps_at_suspect(self, det):
+        """The split-brain guard: 'I cannot see the peer' must never
+        read as 'the peer is dead' — a fully-partitioned observer
+        may only SUSPECT, never propose deaths."""
+        def broken():
+            raise OSError("kv unreachable")
+        det.register("p", age_fn=broken, clock=time.monotonic,
+                     suspect_after=0.1, dead_after=0.2, poll_s=999)
+        for _ in range(10):
+            assert det.state_of("p", refresh=True) == SUSPECT
+        tl = det.timeline_of("p")
+        assert any(e["kind"] == "evidence_error" for e in tl)
+
+    def test_evidence_error_never_demotes_dead(self, det):
+        """The other direction of the error asymmetry: an observer
+        whose evidence source flakes AFTER a DEAD verdict must not
+        demote the corpse to SUSPECT — the dead member would vanish
+        from dead_members() mid-resize and flap back with a fresh
+        detector.dead event (and flight bundle) on every KV blip.
+        Only a real proof of life resurrects a DEAD peer."""
+        clock = _Clock()
+        age = [5.0]
+        fail = [False]
+
+        def evidence():
+            if fail[0]:
+                raise OSError("kv flaking")
+            return age[0]
+
+        det.register("p", age_fn=evidence, clock=clock,
+                     suspect_after=1.0, dead_after=2.0, poll_s=999,
+                     hysteresis=1)
+        assert det.state_of("p", refresh=True) == DEAD
+        fail[0] = True
+        for _ in range(5):
+            assert det.state_of("p", refresh=True) == DEAD
+        # A real good observation still recovers it.
+        fail[0] = False
+        age[0] = 0.0
+        assert det.state_of("p", refresh=True) == ALIVE
+
+    def test_cached_evidence_cannot_satisfy_hysteresis(self, det):
+        """Recovery hysteresis counts OBSERVATIONS, not sweeps: a
+        poll peer whose interval hasn't elapsed re-reads its last
+        good poll (ev=None) — those cached evaluations must not
+        increment the good streak, or one lucky probe re-admits a
+        flapping replica at any HVD_DETECTOR_HYSTERESIS."""
+        ok = [False]
+        det.register("p", poll_fn=lambda: ok[0],
+                     suspect_after=0.0, dead_after=999, poll_s=0.2,
+                     hysteresis=2)
+        assert det.state_of("p", refresh=True) == SUSPECT
+        ok[0] = True
+        assert det.state_of("p", refresh=True) == SUSPECT  # good #1
+        # Cached sweeps (poll not due) between real observations:
+        # sweep_once() evaluates every registered peer with ev=None.
+        for _ in range(5):
+            det.sweep_once()
+            assert det.state_of("p") == SUSPECT
+        time.sleep(0.25)   # poll due again
+        assert det.state_of("p", refresh=True) == ALIVE    # good #2
+
+
+class TestFlapDamping:
+    def test_flap_storm_is_damped_and_counter_bounded(self, det):
+        """A peer alternating good/stale evidence must not bounce
+        ALIVE<->SUSPECT forever: after flap_max recoveries inside the
+        window it is HELD at SUSPECT, and hvd_detector_flaps_total
+        stops growing — bounded by construction."""
+        clock = _Clock()
+        age = [0.0]
+        det.register("p", age_fn=lambda: age[0], clock=clock,
+                     suspect_after=1.0, dead_after=50.0, poll_s=999,
+                     hysteresis=1, flap_window_s=60.0, flap_max=3)
+        for _ in range(20):   # a flap storm
+            age[0] = 1.5
+            det.state_of("p", refresh=True)
+            age[0] = 0.0
+            det.state_of("p", refresh=True)
+        view = det.view("p")
+        assert view.flaps <= 3          # bounded, not 20
+        assert view.damped
+        assert view.state == SUSPECT    # held: drained, not flapping
+        # DEATH is never blocked by damping — evidence drives it.
+        age[0] = 99.0
+        assert det.state_of("p", refresh=True) == DEAD
+
+    def test_stall_report_marks_suspect(self, det):
+        clock = _Clock()
+        det.register("p0", age_fn=lambda: 0.0, clock=clock,
+                     suspect_after=1.0, dead_after=2.0, poll_s=999,
+                     rank=0)
+        det.register("p1", age_fn=lambda: 0.0, clock=clock,
+                     suspect_after=1.0, dead_after=2.0, poll_s=999,
+                     rank=1)
+        n = det.ingest_stall_report(
+            {"missing_ranks": [1], "straggler": False}, hold_s=5.0)
+        assert n == 1
+        assert det.state_of("p1", refresh=True) == SUSPECT
+        assert det.state_of("p0", refresh=True) == ALIVE
+        clock.t += 10.0   # the stall hold decays
+        assert det.state_of("p1", refresh=True) != DEAD
+
+
+class TestVerdictObservability:
+    def test_dead_verdict_cuts_bundle_with_timeline(self, det,
+                                                    tmp_path,
+                                                    monkeypatch):
+        """Satellite: every DEAD verdict dumps a flight-recorder
+        bundle carrying the peer's evidence timeline (beats, polls,
+        transitions) so postmortems can distinguish true death from
+        partition."""
+        from horovod_tpu.obs import flightrec
+        monkeypatch.setenv("HVD_FLIGHT_DIR", str(tmp_path))
+        log = EventLog()
+        prev = events.install(log)
+        try:
+            clock = _Clock()
+            age = [0.0]
+            det.register("victim", age_fn=lambda: age[0], clock=clock,
+                         suspect_after=0.5, dead_after=1.0,
+                         poll_s=999)
+            det.state_of("victim", refresh=True)
+            age[0] = 0.7
+            det.state_of("victim", refresh=True)
+            age[0] = 3.0
+            assert det.state_of("victim", refresh=True) == DEAD
+            kinds = [e["kind"] for e in log.tail(20)]
+            assert "detector.suspect" in kinds
+            assert "detector.dead" in kinds
+            bundles = flightrec.list_bundles(str(tmp_path))
+            assert bundles
+            b = flightrec.load(bundles[-1])
+            assert b["reason"] == "detector.dead"
+            tl = b["context"]["timeline"]
+            assert any(e["kind"] == "transition" and e["to"] == "dead"
+                       for e in tl)
+            assert any(e["kind"] == "stale" for e in tl)
+        finally:
+            events.install(prev)
+
+    def test_transition_callback_and_unregister(self, det):
+        seen = []
+        age = [0.0]
+        det.register("p", age_fn=lambda: age[0],
+                     clock=time.monotonic, suspect_after=1.0,
+                     dead_after=2.0, poll_s=999, hysteresis=1,
+                     on_transition=lambda k, o, n, v: seen.append(
+                         (k, o, n)))
+        age[0] = 5.0
+        det.state_of("p", refresh=True)
+        assert ("p", ALIVE, DEAD) in seen or (
+            "p", SUSPECT, DEAD) in seen
+        det.unregister("p")
+        assert det.peers() == {}
+        # unregistered peers read ALIVE (nothing to suspect)
+        assert det.state_of("p", refresh=True) == ALIVE
+
+
+class TestSharedDetectorSingleThread:
+    def test_router_plus_membership_one_sweep_thread(self, tmp_path):
+        """THE satellite: a host running a serving-router fleet AND
+        training membership runs exactly ONE detector sweep thread —
+        liveness polling is no longer duplicated per consumer."""
+        from horovod_tpu.resilience.membership import (InProcessKV,
+                                                       WorldMonitor)
+        from horovod_tpu.serving.router import ServingRouter
+
+        class _MiniEngine:
+            """The minimal health/submit surface the router probes."""
+            queue_depth = 0
+            slo = None
+
+            class pool:
+                busy_slots = 0
+
+            def _health(self):
+                return {"healthy": True}
+
+            def shutdown(self, *, drain=True, timeout=None):
+                pass
+
+        prev = install_detector(None)   # fresh shared instance
+        if prev is not None:
+            prev.stop()   # restartable: next register revives it
+        try:
+            router = ServingRouter(_MiniEngine, num_replicas=2,
+                                   health_poll_s=0.02,
+                                   max_replacements=0)
+            kv = InProcessKV()
+            mons = [WorldMonitor(f"rank{i}", rank=i, world=2, kv=kv,
+                                 lease_s=0.5, apply_runtime=False
+                                 ).start() for i in range(2)]
+            try:
+                time.sleep(0.15)   # let sweeps run
+                sweepers = [t for t in threading.enumerate()
+                            if t.name == "hvd-failure-detector"
+                            and t.is_alive()]
+                assert len(sweepers) == 1, sweepers
+                det = shared_detector()
+                # Both consumers' peers live in the ONE detector.
+                keys = set(det.peers())
+                assert any(k.startswith("router/") for k in keys)
+                assert any(k.startswith("wm/") for k in keys)
+            finally:
+                for m in mons:
+                    m.stop()
+                router.shutdown(drain=False)
+            # Teardown unregisters every consumer's namespace.
+            assert shared_detector().peers() == {}
+        finally:
+            old = install_detector(prev)
+            if old is not None:
+                old.stop()
+
+    def test_shared_chaos_heartbeat_drop_suspect_never_dead(self):
+        """Satellite: under heartbeat_drop chaos, isolated missed
+        beats may SUSPECT a member (drain) but must never produce a
+        false DEAD — no spurious resize."""
+        from horovod_tpu.resilience.membership import (InProcessKV,
+                                                       WorldMonitor)
+        prev = install_detector(None)
+        try:
+            kv = InProcessKV()
+            mons = [WorldMonitor(f"rank{i}", rank=i, world=2, kv=kv,
+                                 lease_s=0.4, heartbeat_s=0.05,
+                                 apply_runtime=False)
+                    for i in range(2)]
+            for m in mons:
+                m.start()
+            try:
+                time.sleep(0.15)   # both members beating steadily
+                with chaos.armed("heartbeat_drop:2") as monkey:
+                    deadline = time.monotonic() + 1.0
+                    while time.monotonic() < deadline:
+                        assert mons[0].dead_members() == []
+                        assert mons[1].dead_members() == []
+                        time.sleep(0.02)
+                    assert monkey.fired("heartbeat_drop") == 2
+                    assert mons[0].pending_change() is None
+                    assert mons[1].pending_change() is None
+            finally:
+                for m in mons:
+                    m.stop()
+        finally:
+            old = install_detector(prev)
+            if old is not None:
+                old.stop()

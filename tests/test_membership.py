@@ -21,8 +21,10 @@ from horovod_tpu.resilience.elastic import (ElasticTrainer,
                                             PreemptionHandler)
 from horovod_tpu.resilience.equivalence import (
     main as equivalence_main, run_resize_equivalence)
-from horovod_tpu.resilience.membership import (ElasticBarrier,
+from horovod_tpu.resilience.membership import (BootstrapKV, ChaosKV,
+                                               ElasticBarrier,
                                                InProcessKV,
+                                               KVTransportError,
                                                MembershipError,
                                                SimulatedWorld,
                                                WorldMonitor,
@@ -381,6 +383,213 @@ class TestResizeEquivalenceHarness:
         rc = equivalence_main(["--resize",
                                "--workdir", str(tmp_path / "a")])
         assert rc == 0
+
+
+class TestGraduatedSuspicion:
+    def test_stale_member_suspect_then_dead_then_recovers(self):
+        """Membership consumes the shared FailureDetector's graduated
+        verdicts: a beat age past lease/2 is SUSPECT (drainable,
+        never a resize trigger), past the full lease DEAD, and
+        resumed beats recover through hysteresis — all driven by a
+        manual clock, no threads."""
+        kv = InProcessKV()
+        t = [100.0]
+        mons = [WorldMonitor(f"rank{i}", rank=i, world=2, kv=kv,
+                             lease_s=1.0, heartbeat_s=0.25,
+                             clock=lambda: t[0],
+                             apply_runtime=False)
+                for i in range(2)]
+        for m in mons:
+            m.heartbeat()
+            m._sync_detector_peers()
+        try:
+            assert mons[0].dead_members() == []
+            assert mons[0].suspect_members() == []
+            # rank1 goes quiet: stale past lease/2 -> SUSPECT only.
+            t[0] += 0.7
+            mons[0].heartbeat()
+            assert mons[0].suspect_members() == ["rank1"]
+            assert mons[0].dead_members() == []
+            assert mons[0].pending_change() is None   # drain != resize
+            # ...past the full lease -> DEAD (the resize trigger).
+            t[0] += 0.5
+            mons[0].heartbeat()
+            assert mons[0].dead_members() == ["rank1"]
+            # rank1 comes back: recovery through hysteresis.
+            mons[1].heartbeat()
+            for _ in range(4):
+                mons[0].dead_members()   # consecutive good evals
+            assert mons[0].dead_members() == []
+            assert mons[0].suspect_members() == []
+        finally:
+            for m in mons:
+                m.stop()
+
+
+class _FlakyNative:
+    """The native rendezvous client surface BootstrapKV consumes,
+    scripted: the first ``fail_sets`` kv_set calls report failure
+    (server momentarily unreachable), ``server_up`` drives ping."""
+
+    def __init__(self, fail_sets=0, server_up=True):
+        self.fail_sets = fail_sets
+        self.server_up = server_up
+        self.store = {}
+        self.connects = 0
+
+    def kv_set(self, key, value):
+        if self.fail_sets > 0:
+            self.fail_sets -= 1
+            return False
+        self.store[key] = value
+        return True
+
+    def kv_get(self, key, timeout_ms=0):
+        return self.store.get(key)
+
+    def ping(self):
+        return self.server_up
+
+    def connect(self, host, port, timeout_s=None):
+        self.connects += 1
+        return True
+
+
+class TestKVTransportHardening:
+    """Satellite: every BootstrapKV round-trip rides the shared
+    RetryPolicy with typed errors + reconnect, and the kv_drop/
+    kv_delay/kv_partition chaos sites drill the transport."""
+
+    def test_bootstrap_put_retries_and_reconnects(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_KV", "127.0.0.1:1")
+        native = _FlakyNative(fail_sets=2)
+        kv = BootstrapKV(native=native)
+        kv.put("a", {"x": 1})          # two faults absorbed
+        assert kv.get("a") == {"x": 1}
+        assert kv.reconnects == 2      # reconnect tried per fault
+        assert native.connects == 2
+
+    def test_bootstrap_exhaustion_is_typed(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_KV", "127.0.0.1:1")
+        kv = BootstrapKV(native=_FlakyNative(fail_sets=10 ** 6))
+        with pytest.raises(KVTransportError):
+            kv.put("a", 1)
+
+    def test_bootstrap_get_distinguishes_missing_from_down(self):
+        up = BootstrapKV(native=_FlakyNative(server_up=True))
+        assert up.get("nope") is None            # absent, verified
+        down = BootstrapKV(native=_FlakyNative(server_up=False))
+        with pytest.raises(KVTransportError):    # unreachable, typed
+            down.get("nope")
+
+    def test_kv_drop_absorbed_then_typed(self):
+        kv = ChaosKV(InProcessKV())
+        with chaos.armed("kv_drop:2") as monkey:
+            kv.put("k", 7)             # retried through both drops
+        assert monkey.fired("kv_drop") == 2
+        assert kv.get("k") == 7
+        with chaos.armed("kv_drop:-1"):
+            with pytest.raises(KVTransportError):
+                kv.put("k", 8)
+        assert kv.get("k") == 7        # the drop really dropped it
+
+    def test_kv_delay_tolerated_by_lease(self):
+        kv = ChaosKV(InProcessKV())
+        mons = [WorldMonitor(f"rank{i}", rank=i, world=2, kv=kv,
+                             lease_s=0.5, heartbeat_s=0.05,
+                             apply_runtime=False)
+                for i in range(2)]
+        with chaos.armed("kv_delay:3:delay=0.1") as monkey:
+            for m in mons:
+                m.start()
+            try:
+                time.sleep(0.6)
+                assert monkey.fired("kv_delay") == 3
+                assert mons[0].pending_change() is None
+                assert mons[1].pending_change() is None
+            finally:
+                for m in mons:
+                    m.stop()
+
+    def test_heartbeat_transport_fault_counts_missed_beat(self):
+        kv = ChaosKV(InProcessKV())
+        mon = WorldMonitor("rank0", rank=0, world=1, kv=kv,
+                           lease_s=0.5, apply_runtime=False)
+        with chaos.armed("kv_drop:-1"):
+            assert mon.heartbeat() is False     # typed + counted,
+        assert mon.beats_missed == 1            # never a raw error
+        assert mon.heartbeat() is True
+
+
+class TestKVPartitionSplitBrain:
+    def test_minority_member_exits_never_two_generations(self):
+        """THE acceptance pin: under an asymmetric kv_partition (the
+        victim's writes stop landing, reads still work) the world
+        must never run two live generations — the survivors commit
+        generation 1 without the victim, and the victim adopts that
+        commit and exits MembershipError instead of acting at
+        generation 0 or proposing a competing world."""
+        import threading
+        shared = InProcessKV()
+        victim_kv = ChaosKV(shared)
+        lease = 0.3
+        survivors = [WorldMonitor(f"rank{i}", rank=i, world=3,
+                                  kv=shared, lease_s=lease,
+                                  heartbeat_s=0.05,
+                                  apply_runtime=False)
+                     for i in range(2)]
+        victim = WorldMonitor("rank2", rank=2, world=3, kv=victim_kv,
+                              lease_s=lease, heartbeat_s=0.05,
+                              apply_runtime=False)
+        for m in survivors + [victim]:
+            m.start()
+        try:
+            time.sleep(0.15)   # everyone beating
+            with chaos.armed("kv_partition:-1") as monkey:
+                # The victim's beats stop landing; survivors detect.
+                deadline = time.monotonic() + lease * 10
+                while (survivors[0].pending_change() is None
+                       and time.monotonic() < deadline):
+                    time.sleep(0.02)
+                pend = survivors[0].pending_change()
+                assert pend and pend["dead"] == ["rank2"]
+                decs = {}
+
+                def agree(i):
+                    decs[i] = survivors[i].resize(timeout_s=15.0)
+
+                ts = [threading.Thread(target=agree, args=(i,))
+                      for i in range(2)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=20.0)
+                assert decs[0].generation == decs[1].generation == 1
+                assert decs[0].members == ["rank0", "rank1"]
+                assert monkey.fired("kv_partition") > 0
+                # The victim OBSERVES the commit through its intact
+                # read path (pending_change flags it)...
+                deadline = time.monotonic() + 5.0
+                flagged = None
+                while time.monotonic() < deadline:
+                    flagged = victim.pending_change()
+                    if flagged and flagged.get("commit"):
+                        break
+                    time.sleep(0.02)
+                assert flagged and flagged["commit"] == 1
+                # ...and its only move is MembershipError: stop.
+                with pytest.raises(MembershipError):
+                    victim.resize(timeout_s=5.0)
+            # Exactly ONE generation-1 commit, nothing beyond it, and
+            # the victim never adopted a world of its own.
+            assert shared.get("commit/1")["members"] == ["rank0",
+                                                         "rank1"]
+            assert shared.get("commit/2") is None
+            assert victim.generation == 0
+            assert victim.beats_missed > 0
+        finally:
+            for m in survivors + [victim]:
+                m.stop()
 
 
 class TestMergeWindowsMissingRank:
