@@ -529,7 +529,8 @@ def _tpot_histogram(results):
 
 def _serve_rate(model, params, args, prompts, rate, *,
                 pipeline_depth, prefill_chunk_budget, chaos_mode,
-                log, paged_cfg=None, slo_spec=None):
+                log, paged_cfg=None, slo_spec=None, engine_kw=None,
+                label=""):
     """One open-loop Poisson rate point through a fresh (pre-warmed)
     engine; returns the per-rate record. ``pipeline_depth`` /
     ``prefill_chunk_budget`` parameterize the hot path so the same
@@ -548,7 +549,14 @@ def _serve_rate(model, params, args, prompts, rate, *,
     kw = {}
     if paged_cfg:
         kw = dict(paged=True, kv_blocks=paged_cfg["kv_blocks"],
-                  kv_block_size=paged_cfg["kv_block_size"])
+                  kv_block_size=paged_cfg["kv_block_size"],
+                  paged_kernel=paged_cfg.get(
+                      "kernel", getattr(args, "serving_paged_kernel",
+                                        None)))
+    if engine_kw:
+        # Decode-fast-path matrix knobs (weight_quant / spec_draft /
+        # spec_k / paged_kernel) ride straight into the engine.
+        kw.update(engine_kw)
     slo_mon = None
     if slo_spec:
         from horovod_tpu.obs.slo import SLOMonitor
@@ -599,6 +607,11 @@ def _serve_rate(model, params, args, prompts, rate, *,
         "compiles": snap["compiles"],
         "pipeline_depth": pipeline_depth,
         "prefill_chunk_budget": prefill_chunk_budget,
+        # Decode-fast-path evidence: tokens retired per decode tick
+        # across all lanes (~busy lanes without spec decode; x
+        # (1 + acceptance x k) per lane with it — compare legs at
+        # the same occupancy).
+        "tokens_per_tick": snap["tokens_per_tick"],
         # Effective concurrency high-water mark (decoding +
         # mid-prefill): bounded by num_slots on the fixed pool, by
         # BLOCK availability on the paged one — the capacity half of
@@ -606,6 +619,16 @@ def _serve_rate(model, params, args, prompts, rate, *,
         "peak_active": snap["peak_active"],
         "num_slots": S,
     }
+    if snap["spec_rounds"]:
+        rec.update({
+            "spec_rounds": snap["spec_rounds"],
+            "spec_proposed": snap["spec_proposed"],
+            "spec_accepted": snap["spec_accepted"],
+            "spec_acceptance_rate": snap["spec_acceptance_rate"],
+            "spec_multi_token_ticks": snap["spec_multi_token_ticks"],
+        })
+    if label:
+        rec["config"] = label
     if slo_mon is not None:
         # Burn-rate view of the same window (obs/slo.py): objectives,
         # fast/slow burn per objective, and whether anything breached.
@@ -919,6 +942,18 @@ def run_serving(args, devices, n_chips, log):
     from horovod_tpu.serving import ServingEngine
 
     model, params = _build_decode_lm(args)
+    # The spec matrix's fp legs use the model AS BUILT — captured
+    # before the main-leg quantization below, so
+    # --serving-weight-quant can't contaminate the fp column of the
+    # fp-vs-int8 A/B.
+    fp_model, fp_params = model, params
+    if (args.serving_weight_quant
+            and model.weight_quant != args.serving_weight_quant):
+        # Weight-only int8 for the MAIN serving legs (the spec matrix
+        # below always measures fp AND int8 regardless).
+        from horovod_tpu.ops.quantization import quantize_lm_params
+        model = model.clone(weight_quant=args.serving_weight_quant)
+        params = quantize_lm_params(params)
     n_params = sum(int(np.prod(p.shape))
                    for p in jax.tree.leaves(params))
     S = args.serving_slots
@@ -1086,6 +1121,77 @@ def run_serving(args, devices, n_chips, log):
             f"skipped {p['prefill_tokens_skipped']}, peak concurrency "
             f"{f['peak_active']} (cap {f['num_slots']}) -> "
             f"{p['peak_active']}{ttft}")
+    if args.serving_spec_k > 0 and not chaos_mode:
+        # Decode-fast-path A/B matrix (docs/serving.md "Decode fast
+        # path"): paged x {fp, int8 weights} x {spec off, spec on} at
+        # the highest rate — every leg the same paged geometry and
+        # kernel mode, so the columns isolate the weight-quant and
+        # the spec-decode levers. Self-draft (default) measures the
+        # acceptance CEILING (rate 1.0 — the round mechanics with
+        # every proposal accepted); --serving-spec-draft-layers swaps
+        # in a random small draft for realistic plumbing.
+        k = args.serving_spec_k
+        rate = max(rates)
+        bs = args.serving_kv_block_size
+        if args.seq % bs:
+            raise ValueError(
+                f"--serving-kv-block-size {bs} must divide --seq "
+                f"{args.seq} for the spec matrix's paged legs")
+        paged_cfg = {"num_slots": S,
+                     "kv_blocks": S * args.seq // bs + 1,
+                     "kv_block_size": bs,
+                     "kernel": args.serving_paged_kernel}
+        # Spec-mode verify needs k tokens of cache headroom; trim the
+        # workload's prompts so every submit passes the bound.
+        limit = max(1, args.seq - steps - k + 1)
+        mprompts = [p if len(p) <= limit else p[:limit]
+                    for p in prompts]
+        import jax.numpy as jnp
+
+        from horovod_tpu.models.transformer import TransformerLM
+        from horovod_tpu.ops.quantization import quantize_lm_params
+        from horovod_tpu.parallel.tensor import unbox
+        if args.serving_spec_draft_layers > 0:
+            dm = TransformerLM(
+                vocab_size=32768,
+                num_layers=args.serving_spec_draft_layers,
+                num_heads=args.heads, num_kv_heads=args.kv_heads,
+                pos_emb=args.pos_emb, head_dim=args.head_dim,
+                max_len=args.seq, dtype=jnp.bfloat16,
+                attn_impl=args.attn_impl, **_lm_arch_kwargs(args))
+            dp = unbox(dm.init(jax.random.PRNGKey(2),
+                               jnp.zeros((1, 64), jnp.int32))["params"])
+            draft_fp = draft_q = (dm, dp)
+        else:
+            qm = (fp_model if fp_model.weight_quant == "int8"
+                  else fp_model.clone(weight_quant="int8"))
+            qp = (fp_params if fp_model.weight_quant == "int8"
+                  else quantize_lm_params(fp_params))
+            draft_fp = (fp_model, fp_params)
+            draft_q = (qm, qp)   # int8 legs self-draft at int8 too
+        legs = {
+            "paged_fp": {},
+            "paged_int8": {"weight_quant": "int8"},
+            "paged_fp_spec": {"spec_draft": draft_fp, "spec_k": k},
+            "paged_int8_spec": {"weight_quant": "int8",
+                                "spec_draft": draft_q, "spec_k": k},
+        }
+        matrix = {"rate": rate, "spec_k": k,
+                  "paged_kernel": args.serving_paged_kernel,
+                  "self_draft": args.serving_spec_draft_layers == 0}
+        for name, ekw in legs.items():
+            matrix[name] = _serve_rate(
+                fp_model, fp_params, args, mprompts, rate,
+                pipeline_depth=depth, prefill_chunk_budget=budget,
+                chaos_mode=False, log=log, paged_cfg=paged_cfg,
+                engine_kw=dict(ekw), label=name)
+        out["spec_matrix"] = matrix
+        log(f"spec matrix at rate={rate}/s: tokens/tick "
+            + ", ".join(f"{n}={matrix[n]['tokens_per_tick']}"
+                        for n in legs)
+            + "; tpot p50 "
+            + ", ".join(f"{n}={matrix[n]['tpot_ms_p50']}ms"
+                        for n in legs))
     if getattr(args, "router", False):
         # Fleet-failover A/B (1 vs N replicas, with and without the
         # seeded router.replica_kill chaos) at the highest rate.
@@ -1468,6 +1574,35 @@ def main():
                     help="serving: paged-KV block size in tokens for "
                          "the paged A/B leg (HVD_KV_BLOCK_SIZE "
                          "parity)")
+    ap.add_argument("--serving-spec-k", type=int, default=0,
+                    metavar="K",
+                    help="serving: > 0 adds the decode-fast-path A/B "
+                         "matrix at the highest rate — paged x "
+                         "{fp,int8 weights} x {spec off, spec on at "
+                         "K proposals/round} — recording "
+                         "accepted-tokens-per-tick, acceptance rate "
+                         "and TPOT per config (HVD_SPEC_K parity; "
+                         "docs/serving.md 'Decode fast path')")
+    ap.add_argument("--serving-spec-draft-layers", type=int, default=0,
+                    metavar="N",
+                    help="serving: draft depth for the spec legs — 0 "
+                         "(default) self-drafts with the target "
+                         "itself (the acceptance CEILING: measures "
+                         "round mechanics at acceptance 1.0), N >= 1 "
+                         "builds a random N-layer draft (realistic "
+                         "plumbing, chance-level acceptance on "
+                         "random weights)")
+    ap.add_argument("--serving-weight-quant", default="",
+                    choices=["", "int8"],
+                    help="serving: weight-only quantization for the "
+                         "MAIN serving legs (the spec matrix always "
+                         "runs both fp and int8; HVD_WEIGHT_QUANT "
+                         "parity)")
+    ap.add_argument("--serving-paged-kernel", default="auto",
+                    choices=["auto", "off", "lax", "pallas"],
+                    help="serving: paged-attention dispatch for every "
+                         "paged leg (HVD_PAGED_KERNEL parity; 'off' "
+                         "= the legacy full-span gather)")
     ap.add_argument("--router", action="store_true",
                     help="serving: add the fleet-failover A/B — "
                          "ServingRouter over 1 vs --router-replicas "
@@ -2012,6 +2147,12 @@ def _bench_body(args, devices, n_chips, metric, unit,
         if "paged_ab" in r:
             result["paged_ab"] = r["paged_ab"]
             result["serving_shared_prefix"] = args.serving_shared_prefix
+        if "spec_matrix" in r:
+            # The decode-fast-path A/B matrix (docs/serving.md
+            # "Decode fast path"): paged x {fp, int8 weights} x
+            # {spec off, spec on} — accepted tokens/tick, acceptance
+            # rate and TPOT per leg.
+            result["spec_matrix"] = r["spec_matrix"]
         if "router_ab" in r:
             # The fleet-failover A/B (docs/serving.md "Fleet
             # failover"): 1 vs N replicas, each +/- the seeded

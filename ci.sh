@@ -58,9 +58,12 @@ JAX_PLATFORMS=cpu python -m pytest -q --collect-only \
 # a 48-token system prompt through a PAGED engine — the second must
 # report prefill-tokens-skipped > 0 (prefix served from resident
 # blocks) and TTFT strictly below the cold request's, both token-exact
-# vs sequential generate.
+# vs sequential generate. --spec-check is the decode-fast-path smoke
+# (PR 13, docs/serving.md "Decode fast path"): a speculative
+# (self-draft) engine's greedy streams must be BITWISE the plain
+# engine's with >= 1 multi-token round observed.
 JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4 \
-    --warmup --interleave-check --obs-check --prefix-check
+    --warmup --interleave-check --obs-check --prefix-check --spec-check
 
 # Fleet-observability smoke (docs/observability.md "Fleet view" /
 # "Flight recorder"): on a 2-engine host, one /fleet scrape must show

@@ -44,6 +44,11 @@ snapshot. Two extra CI legs exercise the PR-3 hot-path guarantees:
   flight-recorder bundle in ``HVD_FLIGHT_DIR`` whose pretty-printer
   output names both the ring's newest event and an in-flight
   request's trace_id.
+* ``--spec-check`` is the decode-fast-path smoke (docs/serving.md
+  "Decode fast path"): the same greedy workload through a plain and
+  a speculative (self-draft) engine must produce BITWISE-equal
+  streams with >= 1 multi-token round observed — the serving-side
+  twin of `tests/test_spec_serving.py`'s oracle.
 * ``--failover-check`` is the serving-fleet failover smoke
   (docs/serving.md "Fleet failover"): THREE engine replicas behind a
   `ServingRouter`, one killed abruptly (the ``router.replica_kill``
@@ -406,6 +411,36 @@ def failover_check(model, params, n_requests=6, replicas=3):
         router.shutdown()
 
 
+def spec_check(model, params, prompts, max_new):
+    """The decode-fast-path smoke (docs/serving.md "Decode fast
+    path"): the SAME greedy workload through a plain engine and a
+    speculative one (self-draft — the acceptance ceiling, so
+    multi-token rounds are deterministic) must produce bitwise-equal
+    streams, with at least one round retiring > 1 token."""
+    steps = max_new
+    with ServingEngine(model, params, num_slots=2) as eng:
+        plain = [list(eng.submit(p, steps).result(timeout=600).tokens)
+                 for p in prompts]
+        plain_snap = eng.metrics_snapshot()
+    with ServingEngine(model, params, num_slots=2,
+                       spec_draft=(model, params), spec_k=3) as eng:
+        spec = [list(eng.submit(p, steps).result(timeout=600).tokens)
+                for p in prompts]
+        snap = eng.metrics_snapshot()
+    assert spec == plain, (
+        "speculative greedy streams diverged from the plain engine's")
+    assert snap["spec_multi_token_ticks"] >= 1, snap
+    # tokens_per_tick counts all lanes, so the A/B (same workload,
+    # same lane count) is the honest multi-token evidence.
+    assert snap["tokens_per_tick"] > plain_snap["tokens_per_tick"], (
+        snap["tokens_per_tick"], plain_snap["tokens_per_tick"])
+    print(f"spec check OK: {len(prompts)} greedy streams bitwise-"
+          f"equal to the plain engine, {snap['spec_rounds']} rounds, "
+          f"tokens/tick {plain_snap['tokens_per_tick']} -> "
+          f"{snap['tokens_per_tick']}, acceptance "
+          f"{snap['spec_acceptance_rate']}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=4)
@@ -441,6 +476,12 @@ def main():
                          "(router.replica_kill), all requests must "
                          "complete bitwise-equal to a no-chaos run "
                          "(docs/serving.md 'Fleet failover')")
+    ap.add_argument("--spec-check", action="store_true",
+                    help="decode-fast-path smoke: a speculative "
+                         "(self-draft) engine's greedy streams must "
+                         "be bitwise the plain engine's, with >= 1 "
+                         "multi-token round observed "
+                         "(docs/serving.md 'Decode fast path')")
     ap.add_argument("--prefill-chunk-budget", type=int, default=8,
                     help="prompt tokens streamed per scheduler step")
     args = ap.parse_args()
@@ -500,6 +541,8 @@ def main():
         obs_check(model, params)
     if args.prefix_check:
         prefix_check(model, params)
+    if args.spec_check:
+        spec_check(model, params, prompts, args.max_new_tokens)
     if args.fleet_check:
         fleet_check(model, params, deferred_monkey)
     if args.failover_check:

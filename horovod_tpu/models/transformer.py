@@ -1191,16 +1191,24 @@ class PagedCacheSpec:
     """Static (hashable — rides jit static args) description of one
     paged slot cache: the B=1 decode-cache tree structure, each leaf's
     kind ("kv" = pooled into blocks, "index" = the per-lane fill
-    scalar), and the block geometry. Built once per pool via
-    `paged_cache_spec`."""
+    scalar), the block geometry, and — for the paged-kernel mode —
+    each leaf's tree path plus the KV leaves' tail shapes/dtypes (so
+    the kernel path can build the per-call staging cache and the
+    "paged" collection without a shapes re-eval inside jit). Built
+    once per pool via `paged_cache_spec`."""
 
-    __slots__ = ("treedef", "kinds", "block_size", "blocks_per_seq")
+    __slots__ = ("treedef", "kinds", "block_size", "blocks_per_seq",
+                 "paths", "kv_shapes", "kv_dtypes")
 
-    def __init__(self, treedef, kinds, block_size, blocks_per_seq):
+    def __init__(self, treedef, kinds, block_size, blocks_per_seq,
+                 paths=(), kv_shapes=(), kv_dtypes=()):
         self.treedef = treedef
         self.kinds = tuple(kinds)
         self.block_size = int(block_size)
         self.blocks_per_seq = int(blocks_per_seq)
+        self.paths = tuple(tuple(p) for p in paths)
+        self.kv_shapes = tuple(tuple(s) for s in kv_shapes)
+        self.kv_dtypes = tuple(str(d) for d in kv_dtypes)
 
     @property
     def view_len(self) -> int:
@@ -1208,7 +1216,8 @@ class PagedCacheSpec:
 
     def _key(self):
         return (self.treedef, self.kinds, self.block_size,
-                self.blocks_per_seq)
+                self.blocks_per_seq, self.paths, self.kv_shapes,
+                self.kv_dtypes)
 
     def __hash__(self):
         return hash(self._key())
@@ -1243,8 +1252,9 @@ def paged_cache_spec(model: TransformerLM,
         dec_model.init, jax.random.PRNGKey(0),
         jax.ShapeDtypeStruct((1, model.max_len), jnp.int32))["cache"]
     flat, treedef = tree_flatten_with_path(shapes)
-    kinds = []
+    kinds, paths, kv_shapes, kv_dtypes = [], [], [], []
     for path, leaf in flat:
+        paths.append(tuple(getattr(p, "key", str(p)) for p in path))
         if "index" in str(path):
             assert leaf.shape == (), (path, leaf.shape)
             kinds.append("index")
@@ -1252,8 +1262,12 @@ def paged_cache_spec(model: TransformerLM,
             assert leaf.shape[:2] == (1, model.max_len), (path,
                                                           leaf.shape)
             kinds.append("kv")
+            kv_shapes.append(leaf.shape[2:])
+            kv_dtypes.append(leaf.dtype)
     return PagedCacheSpec(treedef, kinds, block_size,
-                          model.max_len // block_size)
+                          model.max_len // block_size,
+                          paths=paths, kv_shapes=kv_shapes,
+                          kv_dtypes=kv_dtypes)
 
 
 def init_paged_pools(model: TransformerLM, spec: PagedCacheSpec,
@@ -1302,6 +1316,71 @@ def _paged_view(spec: PagedCacheSpec, pools, table, fill):
     return tree_unflatten(spec.treedef, leaves)
 
 
+# Cache-leaf name -> the "paged" collection name its pool rides under
+# (read by `ParallelSelfAttention._paged_decode_attention`).
+_POOL_NAMES = {"cached_key": "key_pool", "cached_value": "value_pool",
+               "cached_key_scale": "key_scale_pool",
+               "cached_value_scale": "value_scale_pool"}
+
+
+def _paged_staging(spec: PagedCacheSpec, fill, length: int):
+    """The paged-KERNEL mode's per-call "cache" collection: a tiny
+    [1, length] staging buffer per KV leaf (the apply writes this
+    call's new rows at position 0; the tick scatters them into their
+    blocks afterwards) plus the index leaves — ``cache_index`` 0 (the
+    staging write position) and ``pos_index`` the TRUE fill (learned
+    positions slice their table at the absolute position). The real
+    KV never materializes here: attention walks the pools through the
+    "paged" collection (`_paged_collection`)."""
+    from jax.tree_util import tree_unflatten
+    leaves, ki = [], 0
+    fill = jnp.asarray(fill, jnp.int32)
+    for kind, path in zip(spec.kinds, spec.paths):
+        if kind == "kv":
+            leaves.append(jnp.zeros((1, length) + spec.kv_shapes[ki],
+                                    spec.kv_dtypes[ki]))
+            ki += 1
+        else:
+            leaves.append(fill if path[-1] == "pos_index"
+                          else jnp.zeros((), jnp.int32))
+    return tree_unflatten(spec.treedef, leaves)
+
+
+def _paged_collection(spec: PagedCacheSpec, pools, table, fill):
+    """The read-only "paged" variable collection for one lane's
+    apply: each attention module's KV pools land at that module's
+    path (key_pool/value_pool, plus the int8-KV scale pools when
+    present), alongside the lane's block ``table`` and true ``fill``.
+    Under the tick's vmap the pools are closed-over (UNBATCHED — one
+    physical pool serves every lane) while table/fill are per-lane."""
+    col, pi = {}, 0
+    for kind, path in zip(spec.kinds, spec.paths):
+        if kind != "kv":
+            continue
+        parent = col
+        for seg in path[:-1]:
+            parent = parent.setdefault(seg, {})
+        parent[_POOL_NAMES[path[-1]]] = pools[pi]
+        parent["table"] = table
+        parent["fill"] = fill
+        pi += 1
+    return col
+
+
+def _paged_cache_vars(spec: PagedCacheSpec, pools, params, table,
+                      fill, length: int, fused: bool):
+    """The apply's variable dict for one paged lane: the gathered
+    [max_len] view (legacy/oracle path) or the staging + "paged"
+    collection pair (kernel path) — THE single dispatch site the
+    tick, the prefill chunk, and the speculative verify all share."""
+    if fused:
+        return {"params": params,
+                "cache": _paged_staging(spec, fill, length),
+                "paged": _paged_collection(spec, pools, table, fill)}
+    return {"params": params,
+            "cache": _paged_view(spec, pools, table, fill)}
+
+
 def _paged_new_rows(spec: PagedCacheSpec, cache, fill, length: int):
     """The rows a decode/prefill apply just wrote into a view cache —
     positions [fill, fill+length) of every KV leaf, [length, ...] each
@@ -1324,25 +1403,31 @@ def _paged_scatter(spec: PagedCacheSpec, pools, rows, bids, offs):
 
 
 @hot_path
-@functools.partial(jax.jit, static_argnames=("dec_model", "spec"),
+@functools.partial(jax.jit,
+                   static_argnames=("dec_model", "spec", "fused"),
                    donate_argnums=(2,))
 def paged_prefill_chunk(dec_model, spec: PagedCacheSpec, pools, params,
-                        tables, fills, slot, chunk):
+                        tables, fills, slot, chunk, fused=False):
     """Append one [C]-token prompt chunk into lane ``slot``'s paged
     cache; returns ``(pools, fills, last-position logits [V])``. The
-    lane's view is gathered through its block table, the apply is the
-    SAME `chunked_prefill` cache-wide-mask program the linear slot
+    lane's view is gathered through its block table (``fused=False``,
+    the legacy/oracle path) or — the paged-kernel mode — the apply
+    writes into a [1, C] staging buffer while attention walks only
+    the filled blocks (`_paged_cache_vars`); either way the apply is
+    the SAME `chunked_prefill` cache-wide-mask program the linear slot
     pool runs (correct at any fill — including a fill that starts past
     a shared-prefix span the admission matched and skipped), and only
     the chunk's C new rows scatter back into their blocks."""
     table = tables[slot]
     fill = fills[slot]
-    cache = _paged_view(spec, pools, table, fill)
-    (hidden, embed), mut = dec_model.apply(
-        {"params": params, "cache": cache}, chunk[None, :],
-        return_hidden=True, mutable=["cache"])
     C = chunk.shape[0]
-    rows = _paged_new_rows(spec, mut["cache"], fill, C)
+    variables = _paged_cache_vars(spec, pools, params, table, fill,
+                                  C, fused)
+    (hidden, embed), mut = dec_model.apply(
+        variables, chunk[None, :],
+        return_hidden=True, mutable=["cache"])
+    rows = _paged_new_rows(spec, mut["cache"],
+                           jnp.int32(0) if fused else fill, C)
     pos = fill + jnp.arange(C, dtype=jnp.int32)
     bids = table[pos // spec.block_size]
     offs = pos % spec.block_size
@@ -1354,26 +1439,32 @@ def paged_prefill_chunk(dec_model, spec: PagedCacheSpec, pools, params,
 
 
 @hot_path
-@functools.partial(jax.jit, static_argnames=("dec_model", "spec"),
+@functools.partial(jax.jit,
+                   static_argnames=("dec_model", "spec", "fused"),
                    donate_argnums=(2,))
 def paged_decode_tick(dec_model, spec: PagedCacheSpec, pools, params,
                       tables, fills, toks, temps, top_ps, rngs, live,
-                      done, eos):
+                      done, eos, fused=False):
     """One continuous-batching decode tick over every lane of a PAGED
-    pool: vmap of (gather view -> B=1 decode apply -> sample) over the
+    pool: vmap of (cache view -> B=1 decode apply -> sample) over the
     lane axis, then ONE batched scatter of the new KV rows into their
-    blocks. Same occupancy semantics as `slot_decode_tick` — ``live``
-    gates fill advance, ``done`` is the on-device stop — expressed in
-    paged form: a non-advancing lane keeps its fill (the freeze) and
-    routes its dead row to the null block (the masked write)."""
+    blocks. ``fused=False`` gathers the lane's whole table into a
+    linear view (the legacy/oracle path); ``fused=True`` is the
+    paged-kernel mode — attention walks only the FILLED blocks
+    (`ops.paged_attention`) and the new row stages at position 0.
+    Same occupancy semantics as `slot_decode_tick` — ``live`` gates
+    fill advance, ``done`` is the on-device stop — expressed in paged
+    form: a non-advancing lane keeps its fill (the freeze) and routes
+    its dead row to the null block (the masked write)."""
 
     def one(table, fill, tok, temp, top_p, rng, lv, dn):
-        cache = _paged_view(spec, pools, table, fill)
+        variables = _paged_cache_vars(spec, pools, params, table,
+                                      fill, 1, fused)
         (hidden, embed), mut = dec_model.apply(
-            {"params": params, "cache": cache}, tok[None, None],
+            variables, tok[None, None],
             return_hidden=True, mutable=["cache"])
-        rows = [r[0] for r in _paged_new_rows(spec, mut["cache"],
-                                              fill, 1)]
+        rows = [r[0] for r in _paged_new_rows(
+            spec, mut["cache"], jnp.int32(0) if fused else fill, 1)]
         logits = jnp.einsum("d,vd->v", hidden[0, -1],
                             embed.astype(hidden.dtype))
         rng, r = jax.random.split(rng)
@@ -1400,6 +1491,235 @@ def paged_decode_tick(dec_model, spec: PagedCacheSpec, pools, params,
     pools = _paged_scatter(spec, pools, rows, bids, offs)
     fills = jnp.where(adv, fills + 1, fills)
     return pools, emit, rngs, done, fills
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding in the slot tick (the device surface of
+# `models.speculative` generalized to the serving pools).
+#
+# `generate_speculative` is a batch-1 host loop; serving needs the
+# draft-verify round BATCHED over every decode lane with per-lane
+# variable acceptance. One jitted ROUND per scheduler step replaces
+# the S=1 tick for greedy requests: the draft proposes k tokens per
+# lane (a device-chained scan — k+1 ticks, the extra one warming the
+# draft cache for full acceptance), the target verifies each lane's
+# whole [pending, p_1..p_k] block in ONE chunked append (the same
+# S>1-onto-non-empty-cache path prefill chunks ride), acceptance and
+# eos truncation are computed ON DEVICE, and both caches rewind by
+# setting the per-lane index leaves — rejected rows become invisible
+# to the masks and are overwritten by later appends (the linear
+# rewind trick; in paged form the stale scattered rows land in
+# reserved blocks and are equally invisible). Between 1 and k+1
+# tokens retire per round per lane; greedy acceptance makes the
+# emitted stream EXACTLY the target's greedy decode, so every pinned
+# token-exact contract (vs `generate`, vs the non-spec engine, under
+# forced-prefix migration) holds bitwise.
+# ---------------------------------------------------------------------------
+
+def _index_leaves(cache):
+    """The per-lane index vectors of a slot cache, flatten order —
+    captured before a speculative round so the rewind can restore
+    pre-round + n_emit exactly."""
+    from jax.tree_util import tree_flatten_with_path
+    flat, _ = tree_flatten_with_path(cache)
+    return [leaf for path, leaf in flat if "index" in str(path)]
+
+
+def _rewind_indices(cache, pre, delta):
+    """Set every per-lane index leaf to ``pre + delta`` (the
+    speculative rewind: pre-round fill plus the tokens the round
+    actually consumed; 0 delta freezes a masked lane). KV bytes past
+    the rewound index are stale but invisible — every decode mask
+    attends positions < index only, and the next append overwrites
+    them (the same contract `models.speculative._rewind` relies on)."""
+    from jax.tree_util import tree_flatten_with_path, tree_unflatten
+    flat, treedef = tree_flatten_with_path(cache)
+    out, pi = [], 0
+    for path, leaf in flat:
+        if "index" in str(path):
+            out.append((pre[pi] + delta).astype(leaf.dtype))
+            pi += 1
+        else:
+            out.append(leaf)
+    return tree_unflatten(treedef, out)
+
+
+def _spec_draft_chain(drf_model, drf_params, drf_cache, toks, adv, k):
+    """k+1 vmapped draft ticks, device-chained (no host sync): tick j
+    feeds the previous greedy pick, so the chain proposes p_1..p_k
+    (the k+1-th pick is discarded — that tick exists to write p_k's
+    K/V, which a FULL acceptance needs in the draft cache; partial
+    acceptances rewind it away). Masked lanes ride with frozen
+    indices. Returns (drf_cache, proposals [L, k+1])."""
+
+    def tick(carry, _):
+        dcache, cur = carry
+
+        def one(sub, tok, lv):
+            (hidden, embed), mut = drf_model.apply(
+                {"params": drf_params, "cache": sub}, tok[None, None],
+                return_hidden=True, mutable=["cache"])
+            new = _freeze_cache_indices(mut["cache"], sub, lv)
+            logits = jnp.einsum("d,vd->v", hidden[0, -1],
+                                embed.astype(hidden.dtype))
+            return new, jnp.argmax(logits, -1).astype(tok.dtype)
+
+        dcache, nxt = jax.vmap(one)(dcache, cur, adv)
+        return (dcache, nxt), nxt
+
+    (drf_cache, _), props = lax.scan(tick, (drf_cache, toks), None,
+                                     length=k + 1)
+    return drf_cache, jnp.swapaxes(props, 0, 1)        # [L, k+1]
+
+
+def _spec_accept(props, greedy, pending, adv, done, eos, k: int):
+    """The acceptance rule, batched: per lane, the longest prefix of
+    ``props`` matching the target's greedy picks, plus the target's
+    own next token — truncated at the first emitted eos (on-device
+    stop, mirroring the tick's done semantics: a done lane re-emits
+    eos once and never advances). Returns (emitted [L, k+1] — first
+    n_emit columns are the round's tokens, later columns padding —
+    n_emit [L], done, next pending token [L], proposed [L])."""
+    match = props == greedy[:, :k]
+    a = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+    jj = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    g_at_a = jnp.take_along_axis(greedy, a[:, None], axis=1)  # [L, 1]
+    props_pad = jnp.concatenate([props, props[:, :1]], axis=1)
+    emitted = jnp.where(jj < a[:, None], props_pad,
+                        jnp.where(jj == a[:, None], g_at_a,
+                                  jnp.zeros_like(g_at_a)))
+    n = a + 1
+    hit = (emitted == eos) & (jj <= a[:, None])
+    eos_idx = jnp.min(jnp.where(hit, jj, k + 1), axis=1)
+    n = jnp.minimum(n, eos_idx + 1)
+    new_done = done | (adv & (eos_idx <= a))
+    # Done-but-unretired lanes mirror the tick: one eos re-emit, no
+    # advance. Non-live lanes emit nothing.
+    n = jnp.where(adv, n, jnp.where(done, 1, 0))
+    emitted = jnp.where((~adv & done)[:, None] & (jj == 0),
+                        eos.astype(emitted.dtype), emitted)
+    last = jnp.take_along_axis(
+        emitted, jnp.clip(n - 1, 0, k)[:, None], axis=1)[:, 0]
+    toks_out = jnp.where(adv, last,
+                         jnp.where(done, eos.astype(pending.dtype),
+                                   pending)).astype(pending.dtype)
+    proposed = jnp.where(adv, k, 0)
+    return emitted, n, new_done, toks_out, proposed
+
+
+@hot_path
+@functools.partial(jax.jit,
+                   static_argnames=("dec_model", "drf_model", "k"),
+                   donate_argnums=(4, 5))
+def slot_spec_round(dec_model, drf_model, params, drf_params, cache,
+                    drf_cache, toks, live, done, eos, k):
+    """One speculative draft-verify round over every LINEAR slot lane
+    (greedy only — the spec-serving contract). Returns ``(cache,
+    drf_cache, emitted [L, k+1], n_emit [L], done, toks, proposed)``;
+    each live lane retires 1..k+1 tokens, bitwise the target's greedy
+    stream."""
+    adv = live & ~done
+    pre_t = _index_leaves(cache)
+    pre_d = _index_leaves(drf_cache)
+    drf_cache, props = _spec_draft_chain(drf_model, drf_params,
+                                         drf_cache, toks, adv, k)
+    block = jnp.concatenate([toks[:, None], props[:, :k]], axis=1)
+
+    def verify(sub, row, lv):
+        (hidden, embed), mut = dec_model.apply(
+            {"params": params, "cache": sub}, row[None, :],
+            return_hidden=True, mutable=["cache"])
+        new = _freeze_cache_indices(mut["cache"], sub, lv)
+        logits = jnp.einsum("sd,vd->sv", hidden[0],
+                            embed.astype(hidden.dtype))
+        return new, jnp.argmax(logits, -1).astype(row.dtype)
+
+    cache, greedy = jax.vmap(verify)(cache, block, adv)
+    emitted, n_emit, done, toks, proposed = _spec_accept(
+        props[:, :k], greedy, toks, adv, done, eos, k)
+    delta = jnp.where(adv, n_emit, 0)
+    cache = _rewind_indices(cache, pre_t, delta)
+    drf_cache = _rewind_indices(drf_cache, pre_d, delta)
+    return cache, drf_cache, emitted, n_emit, done, toks, proposed
+
+
+@hot_path
+@functools.partial(jax.jit,
+                   static_argnames=("dec_model", "drf_model", "spec",
+                                    "k", "fused"),
+                   donate_argnums=(5, 6))
+def paged_spec_round(dec_model, drf_model, spec: PagedCacheSpec,
+                     params, drf_params, pools, drf_cache, tables,
+                     fills, toks, live, done, eos, k, fused=False):
+    """The paged twin of `slot_spec_round`: the draft rides its own
+    linear slot cache (small model — the paging win is the target's),
+    the verify is a vmapped S=k+1 paged append (gathered view or the
+    block-walking kernel path, per ``fused``), the k+1 new rows per
+    lane scatter into their blocks, and the rewind is just the fills
+    vector — stale rows beyond it sit in the lane's RESERVED blocks,
+    invisible to every mask and overwritten by later appends (block
+    reservations already cover prompt + max_new; the engine's
+    spec-mode submit bound keeps even the k-token overshoot inside
+    max_len, and out-of-table writes drop, per `paged_decode_tick`'s
+    boundary contract)."""
+    adv = live & ~done
+    pre_d = _index_leaves(drf_cache)
+    drf_cache, props = _spec_draft_chain(drf_model, drf_params,
+                                         drf_cache, toks, adv, k)
+    block = jnp.concatenate([toks[:, None], props[:, :k]], axis=1)
+
+    def verify(table, fill, row):
+        variables = _paged_cache_vars(spec, pools, params, table,
+                                      fill, k + 1, fused)
+        (hidden, embed), mut = dec_model.apply(
+            variables, row[None, :],
+            return_hidden=True, mutable=["cache"])
+        rows = _paged_new_rows(spec, mut["cache"],
+                               jnp.int32(0) if fused else fill, k + 1)
+        logits = jnp.einsum("sd,vd->sv", hidden[0],
+                            embed.astype(hidden.dtype))
+        return rows, jnp.argmax(logits, -1).astype(row.dtype)
+
+    rows, greedy = jax.vmap(verify)(tables, fills, block)
+    emitted, n_emit, done, toks, proposed = _spec_accept(
+        props[:, :k], greedy, toks, adv, done, eos, k)
+    # The draft cache rewinds like the linear round's: without it the
+    # draft index would creep k+1 per round regardless of acceptance
+    # (wrong RoPE offsets, attention over rejected-token KV —
+    # acceptance decays toward chance and the index eventually
+    # overruns draft max_len). Output would STAY bitwise (the verify
+    # decides every token) — only the speedup would silently rot.
+    drf_cache = _rewind_indices(drf_cache, pre_d,
+                                jnp.where(adv, n_emit, 0))
+    bs = spec.block_size
+    pos = fills[:, None] + jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    # Same boundary semantics as the tick: take_along_axis's fill
+    # mode turns past-the-table lookups into out-of-range ids whose
+    # scatter writes DROP (only ever overshoot rows), and masked
+    # lanes route every row to the null block.
+    owner = jnp.take_along_axis(tables, pos // bs, axis=1)
+    bids = jnp.where(adv[:, None], owner, 0)
+    offs = pos % bs
+    pools = _paged_scatter(spec, pools, rows, bids, offs)
+    fills = fills + jnp.where(adv, n_emit, 0)
+    return (pools, fills, drf_cache, emitted, n_emit, done, toks,
+            proposed)
+
+
+@hot_path
+@functools.partial(jax.jit, static_argnames=("dec_model",),
+                   donate_argnums=(2,))
+def slot_prefill_advance(dec_model, params, cache, slot, chunk):
+    """Draft-cache prompt advance: `slot_prefill_chunk` minus the
+    LM-head matmul — spec decode only needs the draft's KV warm, its
+    logits are never read during prefill (the FIRST token is always
+    the target's)."""
+    sub = jax.tree.map(lambda l: l[slot], cache)
+    _, mut = dec_model.apply({"params": params, "cache": sub},
+                             chunk[None, :], return_hidden=True,
+                             mutable=["cache"])
+    return jax.tree.map(lambda l, s: l.at[slot].set(s), cache,
+                        mut["cache"])
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

@@ -88,6 +88,20 @@ def serving_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
             "hvd_serving_prefill_tokens_skipped_total",
             "Prompt tokens never prefilled because the shared-prefix "
             "cache already held them (the TTFT the cache deleted)"),
+        # Speculative decoding (docs/serving.md "Decode fast path"):
+        # the draft-verify acceptance accounting — acceptance rate =
+        # spec_accepted / spec_proposed, and tokens retired per tick
+        # follows 1 + rate x k.
+        "spec_proposed": reg.counter(
+            "hvd_serving_spec_proposed_total",
+            "Draft tokens proposed to the target model across "
+            "speculative-decode rounds (k per live lane per round)"),
+        "spec_accepted": reg.counter(
+            "hvd_serving_spec_accepted_total",
+            "Draft proposals the target model's greedy verify "
+            "accepted (acceptance rate = accepted / proposed; each "
+            "accepted proposal is one decode tick the target never "
+            "ran)"),
         "ttft": reg.histogram(
             "hvd_serving_ttft_seconds",
             "Time to first token: submit -> first token out "

@@ -65,6 +65,63 @@ NEG_INF = float("-inf")
 LSE_LANES = 128
 
 
+def _snap_tile(block: int, S: int) -> int:
+    """Largest hardware-legal tile <= ``block`` for a length-``S``
+    grid axis. Real Mosaic (v5e/v5-lite captured it first —
+    BENCH_builder_r04's block-shape-divisibility failure) requires
+    the second-minor block dim to be a multiple of 8 OR equal to the
+    array dim: a single block equal to the (padded) axis always
+    qualifies, a multi-block tile must be 8-aligned — so a
+    user-swept tile like 100 snaps to 96 instead of tracing a kernel
+    only interpret mode can run (the r4 lesson: interpret accepts
+    shapes real Mosaic rejects). Shared by the forward and both
+    backward grids so their tiles can never disagree."""
+    b = min(block, max(S, 1))
+    if b >= S:
+        return b           # one block == the padded axis: always legal
+    return max(8, b - b % 8)
+
+
+def mosaic_block_ok(block_shape, array_shape) -> bool:
+    """The v5-lite lowering rule for one (block, array) pair: the
+    last two block dims must be multiples of (8, 128) respectively,
+    or equal to the corresponding array dims. Introspection for
+    `flash_tile_check` and the CPU regression tests — verifiable
+    without a TPU window."""
+    (b2, b1), (a2, a1) = block_shape[-2:], array_shape[-2:]
+    return ((b1 % 128 == 0 or b1 == a1)
+            and (b2 % 8 == 0 or b2 == a2))
+
+
+def flash_tile_check(Sq: int, Sk: int, H: int, Hkv: int, D: int, *,
+                     block_q: int = 128, block_k: int = 128):
+    """Every (name, block shape, array shape, legal) the fwd + bwd
+    pallas_calls will use at these shapes after tile snapping — the
+    static half of the v5e regression test: a config is
+    hardware-lowerable iff every entry's ``legal`` bit is True, and
+    that is checkable on CPU (interpret mode would happily run
+    illegal tiles, which is exactly how the r04 failure shipped)."""
+    bq = _snap_tile(block_q, Sq)
+    bk = _snap_tile(block_k, Sk)
+    nq = -(-Sq // bq)
+    nk = -(-Sk // bk)
+    B = 1   # batch rides a leading grid dim, never a constrained one
+    entries = [
+        ("fwd.q", (1, 1, bq, D), (B, H, nq * bq, D)),
+        ("fwd.kv", (1, 1, bk, D), (B, Hkv, nk * bk, D)),
+        ("fwd.out", (1, 1, bq, D), (B, H, nq * bq, D)),
+        ("fwd.lse", (1, 1, bq, LSE_LANES), (B, H, nq * bq, LSE_LANES)),
+        ("bwd.dq.q", (1, 1, bq, D), (B, H, nq * bq, D)),
+        ("bwd.dq.lse", (1, 1, bq, LSE_LANES),
+         (B, H, nq * bq, LSE_LANES)),
+        ("bwd.dq.kv", (1, 1, bk, D), (B, Hkv, nk * bk, D)),
+        ("bwd.dkv.q", (1, 1, bq, D), (B, H, nq * bq, D)),
+        ("bwd.dkv.out", (1, 1, bk, D), (B, Hkv, nk * bk, D)),
+    ]
+    return [(name, blk, arr, mosaic_block_ok(blk, arr))
+            for name, blk, arr in entries]
+
+
 def _band_j0(qi, *, window, q_offset, k_offset, block_q, block_k):
     """First k-block index that can intersect q-block ``qi``'s band —
     the banded grid's offset (shared by index_map and kernel so the
@@ -217,8 +274,11 @@ def _flash_forward(q, k, v, *, causal, window, q_offset, k_offset,
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     group = _gqa_group(q, k, v)
-    bq = min(block_q, max(Sq, 1))
-    bk = min(block_k, max(Sk, 1))
+    # Snapped tiles: multi-block tiles must be 8-aligned for real
+    # Mosaic (v5e/v5-lite divisibility; BENCH_builder_r04) — see
+    # `_snap_tile` / `flash_tile_check`.
+    bq = _snap_tile(block_q, Sq)
+    bk = _snap_tile(block_k, Sk)
     nq = -(-Sq // bq)
     nk = -(-Sk // bk)
 
@@ -503,8 +563,9 @@ def _flash_backward(q, k, v, o, lse, g, *, causal, window, q_offset,
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
-    bq = min(block_q, max(Sq, 1))
-    bk = min(block_k, max(Sk, 1))
+    # Same snapped tiles as the forward (v5-lite divisibility).
+    bq = _snap_tile(block_q, Sq)
+    bk = _snap_tile(block_k, Sk)
     nq = -(-Sq // bq)
     nk = -(-Sk // bk)
 

@@ -703,6 +703,69 @@ class ParallelSelfAttention(nn.Module):
         out = acc / l[..., None]                     # [..., H, S, D]
         return jnp.swapaxes(out, -3, -2).astype(dtype)
 
+    def _paged_decode_attention(self, q, k, v, cached_k, cached_v,
+                                scale_k, scale_v, index, i, S, W):
+        """Decode/prefill attention against a PAGED cache: the block
+        pools + this lane's table/fill arrive via the read-only
+        "paged" collection (`models.transformer._paged_collection`),
+        the call's new K/V rows land in the tiny [1, S] staging cache
+        (position 0 — the tick scatters them into their blocks
+        afterwards), and the attention walks only the FILLED blocks
+        (`ops.paged_attention`). RoPE rotates at the TRUE fill (the
+        staging index is always 0). The walk at
+        ``decode_prefix_block`` granularity is bitwise the legacy
+        gathered-view path; ``decode_prefix_impl="pallas"`` swaps in
+        the fused S=1 kernel under the same gating the linear cache
+        uses (trivial mesh, un-quantized), falling back to the walk
+        otherwise."""
+        k_pool = self.get_variable("paged", "key_pool")
+        v_pool = self.get_variable("paged", "value_pool")
+        ks_pool = (self.get_variable("paged", "key_scale_pool")
+                   if self.has_variable("paged", "key_scale_pool")
+                   else None)
+        vs_pool = (self.get_variable("paged", "value_scale_pool")
+                   if self.has_variable("paged", "value_scale_pool")
+                   else None)
+        table = self.get_variable("paged", "table")
+        fill = self.get_variable("paged", "fill")
+        q, k = self._maybe_rope(q, k, offset=fill)
+        # Staging write at position 0 (i is the staging cache_index):
+        # the rows pass through the same codec the pool stores, and
+        # the read-back below is therefore byte-identical to what a
+        # gathered view would hold at positions [fill, fill+S).
+        self._cache_write(cached_k, cached_v, scale_k, scale_v,
+                          index, k, v, i, S, W)
+        k_ins = self._cache_read(cached_k, scale_k)
+        v_ins = self._cache_read(cached_v, scale_v)
+        bs = int(k_pool.shape[2])
+        span = int(table.shape[-1]) * bs
+        blk = self.decode_prefix_block
+        if not blk:
+            raise ValueError(
+                "paged-kernel decode requires decode_prefix_block "
+                "(the walk granularity); got 0/None")
+        wb = min(int(blk), span)
+        if wb % bs or span % wb:
+            raise ValueError(
+                f"paged-kernel decode needs decode_prefix_block "
+                f"({blk}) to be a multiple of the KV block size "
+                f"({bs}) and to divide max_len ({span})")
+        from horovod_tpu.ops.paged_attention import (
+            paged_decode_attention, paged_prefix_attention)
+        if (self.decode_prefix_impl == "pallas" and scale_k is None
+                and q.ndim == 4 and S == 1 and _mesh_is_trivial()):
+            # Same gating as the linear flash-decode kernel: a bare
+            # pallas_call is opaque to GSPMD, and int8 KV keeps the
+            # walk's per-block dequant.
+            return paged_decode_attention(q, k_ins, v_ins, k_pool,
+                                          v_pool, table, fill)
+        reps = self.num_heads // (self.num_kv_heads or self.num_heads)
+        return paged_prefix_attention(
+            q, k_ins, v_ins, k_pool, v_pool, table, fill,
+            walk_block=wb, groups=reps,
+            k_scale_pool=ks_pool, v_scale_pool=vs_pool,
+            compute_dtype=self.dtype or jnp.float32)
+
     def _decode_attention(self, q, k, v):
         """One decode tick: append k/v at `cache_index`, attend q
         against the filled prefix. At cache-init time (`model.init` on
@@ -731,6 +794,17 @@ class ParallelSelfAttention(nn.Module):
         S = q.shape[-3]
         W = cached_k.value.shape[-3]
         i = index.value
+        if self.has_variable("paged", "key_pool"):
+            # Paged-kernel serving mode (ops/paged_attention.py): the
+            # "cache" collection holds only a [1, S] STAGING buffer
+            # for this call's new rows (cache_index = 0), and the
+            # real KV lives in the shared block pools the "paged"
+            # collection carries — attention walks the pools through
+            # the lane's block table, touching only filled blocks,
+            # instead of reading a gathered [max_len] view.
+            return self._paged_decode_attention(
+                q, k, v, cached_k, cached_v, scale_k, scale_v,
+                index, i, S, W)
         # Rotate at the ABSOLUTE position; keys enter the cache
         # already rotated, so the prefix needs no re-rotation.
         q, k = self._maybe_rope(q, k, offset=i)

@@ -50,6 +50,9 @@ DEFAULT_ROUTER_POLL_S = 0.02
 DEFAULT_ROUTER_REPLACEMENTS = 4
 DEFAULT_HEDGE_QUANTILE = 0.95
 DEFAULT_RETRY_BUDGET = 16
+# Serving decode fast path (docs/serving.md "Decode fast path"):
+# speculative-decode proposals per round (the draft-verify depth).
+DEFAULT_SPEC_K = 4
 
 
 # ---------------------------------------------------------------------------
@@ -175,6 +178,24 @@ register_knob(
     "Serving: shared-prefix caching over the paged KV pool (0 "
     "disables matching/publishing; blocks then free eagerly), "
     "docs/serving.md")
+register_knob(
+    "HVD_PAGED_KERNEL", "str", "auto", "runtime/config.py",
+    "Serving: paged-attention dispatch — 'auto'/'lax' walk only the "
+    "FILLED blocks of each lane's table (bitwise-equal to the "
+    "legacy gather), 'pallas' adds the fused Pallas decode kernel, "
+    "'off' keeps the full-span gather (the fallback oracle), "
+    "docs/serving.md 'Decode fast path'")
+register_knob(
+    "HVD_SPEC_K", "int", str(DEFAULT_SPEC_K), "runtime/config.py",
+    "Serving: speculative-decode proposals per round when "
+    "ServingEngine(spec_draft=...) doesn't pass spec_k (1..k tokens "
+    "retired per tick), docs/serving.md 'Decode fast path'")
+register_knob(
+    "HVD_WEIGHT_QUANT", "str", "(unset)", "runtime/config.py",
+    "Serving: weight-only quantization applied at ServingEngine "
+    "construction when weight_quant= isn't passed ('int8' stores "
+    "block matmul kernels int8 + per-channel scales), "
+    "docs/serving.md 'Decode fast path'")
 register_knob(
     "HOROVOD_TIMELINE", "str", "(unset)", "runtime/config.py",
     "Write a Chrome-trace timeline to this path, docs/timeline.md")
@@ -396,6 +417,12 @@ class Config:
     kv_block_size: int = DEFAULT_KV_BLOCK_SIZE
     kv_blocks: int = 0
     prefix_cache: bool = True
+    # Decode fast path (docs/serving.md): paged-attention dispatch
+    # mode, draft-verify depth, and the construction-time weight
+    # quantization default ("" = off).
+    paged_kernel: str = "auto"
+    spec_k: int = DEFAULT_SPEC_K
+    weight_quant: str = ""
     # Serving fleet (ServingRouter, docs/serving.md "Fleet failover").
     router_replicas: int = DEFAULT_ROUTER_REPLICAS
     router_poll_s: float = DEFAULT_ROUTER_POLL_S
@@ -432,6 +459,9 @@ class Config:
                                       DEFAULT_KV_BLOCK_SIZE)
         self.kv_blocks = _env_int("HVD_KV_BLOCKS", 0)
         self.prefix_cache = _env_int("HVD_PREFIX_CACHE", 1) != 0
+        self.paged_kernel = env_str("HVD_PAGED_KERNEL", "auto")
+        self.spec_k = _env_int("HVD_SPEC_K", DEFAULT_SPEC_K)
+        self.weight_quant = env_str("HVD_WEIGHT_QUANT")
         self.router_replicas = _env_int("HVD_ROUTER_REPLICAS",
                                         DEFAULT_ROUTER_REPLICAS)
         self.router_poll_s = _env_float("HVD_ROUTER_POLL",

@@ -191,6 +191,28 @@ class ServingEngine:
         pool at the same num_slots.
     prefix_cache : shared-prefix caching over the paged pool; None
         reads HVD_PREFIX_CACHE (default on). Ignored unless paged.
+    paged_kernel : paged-attention dispatch (docs/serving.md "Decode
+        fast path"): "auto"/"lax" walk only the FILLED blocks of each
+        lane's block table (bitwise the legacy gather), "pallas" adds
+        the fused Pallas decode kernel, "off" keeps the full-span
+        gather (the oracle/fallback). None reads HVD_PAGED_KERNEL.
+        Ignored unless paged.
+    spec_draft : (draft_model, draft_params) arming SPECULATIVE
+        decoding (docs/serving.md "Decode fast path"): the slot tick
+        becomes a batched draft-verify round retiring 1..spec_k+1
+        tokens per lane — greedy-only (submit rejects temperature >
+        0), streams bitwise the plain engine's for any draft, and
+        forced-prefix migration stays bitwise (the accepted-token
+        count is the resume state). Disables the tick ring
+        (pipeline_depth 0 — multi-token retirement is the
+        amortization) and, on paged pools, the prefix cache (one
+        chunk schedule drives both caches).
+    spec_k : draft proposals per round; None reads HVD_SPEC_K
+        (default 4). Only meaningful with spec_draft.
+    weight_quant : "int8" quantizes the target's block matmul kernels
+        at construction (`quantize_lm_params`; a pre-quantized
+        model/params pair passes through). None reads
+        HVD_WEIGHT_QUANT (unset = off).
     slo : an `obs.slo.SLOMonitor` evaluating this engine's TTFT /
         TPOT / shed-rate objectives as multi-window burn rates; None
         reads the ``HVD_SLO`` spec knob (unset = SLO monitoring off).
@@ -214,11 +236,48 @@ class ServingEngine:
                  kv_block_size: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
+                 paged_kernel: Optional[str] = None,
+                 spec_draft=None, spec_k: Optional[int] = None,
+                 weight_quant: Optional[str] = None,
                  slo=None):
         if eos_id is not None and not 0 <= eos_id < model.vocab_size:
             raise ValueError(
                 f"eos_id must be in [0, vocab_size={model.vocab_size}"
                 f"), got {eos_id}")
+        # Weight-only quantization at the engine door (docs/serving.md
+        # "Decode fast path"): the block-matmul kernels land int8 +
+        # per-channel f32 scales, halving decode's weight HBM reads.
+        # None reads HVD_WEIGHT_QUANT; a model already carrying
+        # weight_quant (caller pre-quantized) passes through as-is.
+        if weight_quant is None:
+            from horovod_tpu.runtime.config import config as _cfg
+            weight_quant = _cfg.weight_quant or None
+        if weight_quant:
+            if weight_quant != "int8":
+                raise ValueError(
+                    f"weight_quant must be 'int8' (or None), got "
+                    f"{weight_quant!r}")
+            if model.weight_quant != weight_quant:
+                from horovod_tpu.ops.quantization import (
+                    quantize_lm_params)
+                model = model.clone(weight_quant=weight_quant)
+                params = quantize_lm_params(params)
+        self.weight_quant = model.weight_quant
+        # Speculative decoding (docs/serving.md "Decode fast path"):
+        # ``spec_draft`` = (draft_model, draft_params) turns the slot
+        # tick into a draft-verify ROUND retiring 1..spec_k+1 tokens.
+        # Greedy-only (submit rejects temperature > 0 — the greedy
+        # acceptance rule is what makes the stream bitwise the
+        # target's); rounds are synchronous, so the tick ring is
+        # disabled (the multi-token retire is the amortization).
+        self.spec_draft = spec_draft
+        self.spec_k = 0
+        if spec_draft is not None:
+            if spec_k is None:
+                from horovod_tpu.runtime.config import config as _cfg
+                spec_k = _cfg.spec_k
+            self.spec_k = int(spec_k)
+            pipeline_depth = 0
         self.model = model
         self.eos_id = eos_id
         self.default_timeout_s = default_timeout_s
@@ -246,6 +305,9 @@ class ServingEngine:
                                   check_every_s=max(
                                       1.0, stall_warning_s / 4))
         self.paged = bool(paged)
+        spec_kw = {}
+        if spec_draft is not None:
+            spec_kw = dict(spec_draft=spec_draft, spec_k=self.spec_k)
         if self.paged:
             from horovod_tpu.serving.paging import PagedSlotPool
             if kv_blocks is None:
@@ -256,16 +318,17 @@ class ServingEngine:
                 num_blocks=(int(kv_blocks) if kv_blocks
                             and int(kv_blocks) > 0 else None),
                 block_size=kv_block_size, mesh=mesh, eos_id=eos_id,
-                prefix_cache=prefix_cache,
+                prefix_cache=prefix_cache, kernel=paged_kernel,
                 # Evictions are operator-visible cache pressure: the
                 # allocator reports each one straight into this
                 # engine's metrics (and the shared
                 # hvd_prefix_cache_evictions_total counter).
                 on_evict=lambda: self.metrics.count(
-                    "prefix_evictions"))
+                    "prefix_evictions"),
+                **spec_kw)
         else:
             self.pool = SlotPool(model, params, num_slots, mesh=mesh,
-                                 eos_id=eos_id)
+                                 eos_id=eos_id, **spec_kw)
         # Warmup runs on the constructor thread BEFORE the dispatch
         # thread exists, so the single-jax-thread contract holds.
         self.warmup_info = None
@@ -440,6 +503,25 @@ class ServingEngine:
             raise ValueError(
                 f"prompt ({P}) + max_new_tokens ({max_new_tokens}) - 1 "
                 f"exceeds max_len={self.model.max_len}")
+        if self.spec_k:
+            if temperature > 0:
+                raise ValueError(
+                    "speculative serving is greedy-only (the greedy "
+                    "acceptance rule is the token-exactness proof); "
+                    "submit with temperature=0 or build the engine "
+                    "without spec_draft")
+            if (not unbounded and P + max_new_tokens + self.spec_k - 1
+                    > self.model.max_len):
+                # The verify block writes up to spec_k rows past the
+                # last budgeted token before the rewind; they must
+                # stay inside the cache (a clamped linear-cache write
+                # would corrupt the tail rows).
+                raise ValueError(
+                    f"prompt ({P}) + max_new_tokens "
+                    f"({max_new_tokens}) + spec_k ({self.spec_k}) - 1 "
+                    f"exceeds max_len={self.model.max_len} "
+                    f"(speculative verify needs k tokens of cache "
+                    f"headroom)")
         if self.paged and not self.pool.fits(
                 P + len(forced), max_new_tokens - len(forced)):
             # A request whose WORST-CASE block need exceeds the whole

@@ -130,6 +130,10 @@ class EngineMetrics:
         self.timed_out = 0         # deadline exceeded (queue or decode)
         self.aborted = 0           # non-drain shutdown took the slot
         self.tokens_out = 0        # generated tokens, completed or not
+        # First tokens sampled at prefill completion — produced by
+        # the prefill forward, not a decode tick, so tokens_per_tick
+        # excludes them (else a plain engine reads > 1.0).
+        self.prefill_first_tokens = 0
         self.prefill_tokens = 0
         self.prefill_chunks = 0    # interleaved prefill chunks streamed
         self.ticks = 0             # decode ticks executed
@@ -154,6 +158,14 @@ class EngineMetrics:
         self.prefix_misses = 0
         self.prefix_evictions = 0
         self.prefill_tokens_skipped = 0
+        # Speculative decoding (docs/serving.md "Decode fast path"):
+        # draft-verify rounds, proposal/acceptance accounting, and
+        # how many rounds actually retired > 1 token (the multi-
+        # token-tick evidence ci.sh --spec-check asserts on).
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_multi_token_ticks = 0
         # Gauges (set by the engine each loop).
         self.queue_depth = 0
         self.slots_busy = 0
@@ -205,7 +217,8 @@ class EngineMetrics:
         elif name == "requeued":
             self._obs_res["requeued"].inc(n)
         elif name in ("prefix_hits", "prefix_misses",
-                      "prefix_evictions", "prefill_tokens_skipped"):
+                      "prefix_evictions", "prefill_tokens_skipped",
+                      "spec_proposed", "spec_accepted"):
             self._obs[name].inc(n)
 
     def observe_admission(self, admitted: bool):
@@ -329,6 +342,7 @@ class EngineMetrics:
                 "aborted": self.aborted,
                 "tokens_out": self.tokens_out,
                 "prefill_tokens": self.prefill_tokens,
+                "prefill_first_tokens": self.prefill_first_tokens,
                 "prefill_chunks": self.prefill_chunks,
                 "ticks": self.ticks,
                 "ticks_overlapped": self.ticks_overlapped,
@@ -351,6 +365,25 @@ class EngineMetrics:
                     round(self.prefix_hits
                           / (self.prefix_hits + self.prefix_misses), 4)
                     if self.prefix_hits + self.prefix_misses else None),
+                "spec_rounds": self.spec_rounds,
+                "spec_proposed": self.spec_proposed,
+                "spec_accepted": self.spec_accepted,
+                "spec_acceptance_rate": (
+                    round(self.spec_accepted / self.spec_proposed, 4)
+                    if self.spec_proposed else None),
+                "spec_multi_token_ticks": self.spec_multi_token_ticks,
+                # Tokens retired per decode tick ACROSS ALL LANES,
+                # excluding the prefill-sampled first tokens (which
+                # cost no tick): ~busy-lane count without spec
+                # decode, x (1 + acceptance_rate x k) per lane with
+                # it — the accepted-tokens-per-tick number the bench
+                # matrix records per config (compare legs at the
+                # same occupancy).
+                "tokens_per_tick": (
+                    round((self.tokens_out
+                           - self.prefill_first_tokens)
+                          / self.ticks, 4)
+                    if self.ticks else None),
                 "kv_blocks_free": self.kv_blocks_free,
                 "kv_blocks_used": self.kv_blocks_used,
                 "kv_blocks_cached": self.kv_blocks_cached,

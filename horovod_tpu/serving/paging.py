@@ -60,14 +60,62 @@ import jax.numpy as jnp
 
 from horovod_tpu.annotations import hot_path
 from horovod_tpu.models.transformer import (
-    TransformerLM, init_paged_pools, paged_cache_spec,
-    paged_copy_block, paged_decode_tick, paged_prefill_chunk,
-    prefill_chunks, slot_decode_model,
+    TransformerLM, init_paged_pools, init_slot_cache,
+    paged_cache_spec, paged_copy_block, paged_decode_tick,
+    paged_prefill_chunk, paged_spec_round, prefill_chunks,
+    slot_decode_model, slot_prefill_advance, slot_reset,
 )
 from horovod_tpu.parallel.mesh import use
 from horovod_tpu.serving.slots import (
-    Admission, TickHandle, _first_token,
+    Admission, TickHandle, _first_token, validate_spec_draft,
 )
+
+
+def _resolve_paged_kernel(mode: Optional[str],
+                          model: TransformerLM,
+                          block_size: int) -> str:
+    """Normalize the paged-attention dispatch mode ("off" | "lax" |
+    "pallas"; docs/serving.md "Decode fast path"). None reads
+    HVD_PAGED_KERNEL. "auto" picks the lax block-table walk — bitwise
+    the legacy gathered-view program, so flipping it on perturbs no
+    pinned stream — falling back to "off" (the full-span gather, the
+    runtime-fallback oracle) when the geometry can't walk: the walk
+    accumulates at ``decode_prefix_block`` granularity, which must be
+    a multiple of the KV block size and divide max_len (the same
+    divisibility `_prefix_attention` requires of the view). Explicit
+    modes raise instead of silently degrading."""
+    if mode is None:
+        from horovod_tpu.runtime.config import config as _cfg
+        mode = _cfg.paged_kernel or "auto"
+    mode = {"0": "off", "1": "lax"}.get(str(mode), str(mode))
+    if mode not in ("auto", "off", "lax", "pallas"):
+        raise ValueError(
+            f"paged kernel mode must be auto|off|lax|pallas "
+            f"(HVD_PAGED_KERNEL), got {mode!r}")
+    if mode == "off":
+        return "off"
+    if mode == "pallas":
+        # The pool aligns its decode model's walk granularity to the
+        # block size (always legal — the spec guarantees block_size
+        # divides max_len), so only the backend can gate.
+        from horovod_tpu.ops.flash_attention import pltpu
+        if pltpu is None:
+            raise ValueError(
+                "paged kernel mode 'pallas' needs a pallas TPU "
+                "backend (interpret mode counts); set "
+                "HVD_PAGED_KERNEL=lax or off")
+        return "pallas"
+    blk = model.decode_prefix_block
+    wb = min(int(blk), model.max_len) if blk else 0
+    ok = bool(wb) and wb % block_size == 0 and model.max_len % wb == 0
+    if not ok:
+        if mode == "auto":
+            return "off"
+        raise ValueError(
+            f"paged kernel mode {mode!r} needs decode_prefix_block "
+            f"({blk}) to be a multiple of kv_block_size "
+            f"({block_size}) and divide max_len ({model.max_len})")
+    return "lax" if mode == "auto" else mode
 
 
 class BlockPool:
@@ -432,7 +480,9 @@ class PagedSlotPool:
                  block_size: Optional[int] = None, mesh=None,
                  eos_id: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
-                 on_evict: Optional[Callable[[], None]] = None):
+                 on_evict: Optional[Callable[[], None]] = None,
+                 kernel: Optional[str] = None,
+                 spec_draft=None, spec_k: int = 0):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         from horovod_tpu.runtime.config import config as _cfg
@@ -449,6 +499,34 @@ class PagedSlotPool:
         self._eos = jnp.int32(-1 if eos_id is None else eos_id)
         self.spec = paged_cache_spec(model, block_size)
         self.block_size = self.spec.block_size
+        # Paged-attention dispatch (docs/serving.md "Decode fast
+        # path"): "lax"/"pallas" walk only the FILLED blocks of each
+        # lane's table (the gathered-view program stays the oracle
+        # and the "off" fallback). "pallas" additionally aligns the
+        # walk granularity to the block size so the fused kernel and
+        # its in-module lax fallback agree bitwise with each other.
+        self.kernel_mode = _resolve_paged_kernel(kernel, model,
+                                                 self.block_size)
+        self._fused = self.kernel_mode != "off"
+        if self.kernel_mode == "pallas":
+            self.dec_model = self.dec_model.clone(
+                decode_prefix_impl="pallas",
+                decode_prefix_block=self.block_size)
+        # Speculative decoding: the draft rides a LINEAR slot cache
+        # (it is small — the paging win is the target's); prefix
+        # caching is disabled in spec mode so ONE chunk schedule
+        # drives both caches (a matched prefix would skip the
+        # target's prefill but the draft still needs those tokens).
+        self.spec_draft = spec_draft
+        self.spec_k = int(spec_k) if spec_draft is not None else 0
+        self.drf_model = self.drf_params = self._drf_cache = None
+        if self.spec_on:
+            validate_spec_draft(model, spec_draft, self.spec_k)
+            draft_model, draft_params = spec_draft
+            self.drf_model = slot_decode_model(draft_model)
+            self.drf_params = draft_params
+            self._drf_cache = init_slot_cache(draft_model, num_slots)
+            prefix_cache = False
         if num_blocks is None:
             num_blocks = num_slots * self.spec.blocks_per_seq + 1
         self.num_blocks = int(num_blocks)
@@ -486,6 +564,10 @@ class PagedSlotPool:
 
     # -- shared plumbing (mirrors SlotPool) ---------------------------
 
+    @property
+    def spec_on(self) -> bool:
+        return self.spec_draft is not None and self.spec_k > 0
+
     def _ctx(self):
         return use(self.mesh) if self.mesh is not None \
             else contextlib.nullcontext()
@@ -513,7 +595,8 @@ class PagedSlotPool:
             num_blocks=self.num_blocks, block_size=self.block_size,
             mesh=self.mesh, eos_id=self.eos_id,
             prefix_cache=self.blocks.prefix_cache,
-            on_evict=self._on_evict)
+            on_evict=self._on_evict, kernel=self.kernel_mode,
+            spec_draft=self.spec_draft, spec_k=self.spec_k)
         fresh._seen_shapes = set(self._seen_shapes)
         fresh.compiles = self.compiles
         return fresh
@@ -602,6 +685,10 @@ class PagedSlotPool:
                     jnp.asarray(row))
                 self._fills = self._fills.at[slot].set(
                     jnp.int32(skipped))
+                if self.spec_on:
+                    self._drf_cache = slot_reset(
+                        self.drf_model, self._drf_cache,
+                        jnp.int32(slot))
                 self._live = self._live.at[slot].set(False)
                 self._done = self._done.at[slot].set(False)
             self._note_shape(("paged_begin",))
@@ -649,7 +736,15 @@ class PagedSlotPool:
                 self._pools, self._fills, logits = paged_prefill_chunk(
                     self.dec_model, self.spec, self._pools,
                     self.params, self._tables, self._fills,
-                    jnp.int32(slot), jnp.asarray(chunk, jnp.int32))
+                    jnp.int32(slot), jnp.asarray(chunk, jnp.int32),
+                    fused=self._fused)
+                if self.spec_on:
+                    # Mirror the target's chunk schedule into the
+                    # draft cache (advance-only; see SlotPool).
+                    self._drf_cache = slot_prefill_advance(
+                        self.drf_model, self.drf_params,
+                        self._drf_cache, jnp.int32(slot),
+                        jnp.asarray(chunk, jnp.int32))
             self._note_shape(("paged_prefill", c))
             self._est_fill[slot] = fill + c
             return logits
@@ -756,7 +851,7 @@ class PagedSlotPool:
                     self.dec_model, self.spec, self._pools,
                     self.params, self._tables, self._fills, self._toks,
                     self._temps, self._top_ps, self._rngs, self._live,
-                    self._done, self._eos)
+                    self._done, self._eos, fused=self._fused)
             self._note_shape(("paged_tick",))
         finally:
             self.maybe_compiling = False
@@ -782,6 +877,46 @@ class PagedSlotPool:
     def tick(self) -> np.ndarray:
         return self.tick_sync(self.tick_dispatch())
 
+    # -- speculative rounds (docs/serving.md "Decode fast path") ------
+
+    @hot_path
+    def spec_round(self):
+        """One batched draft-verify round over every paged lane (see
+        `SlotPool.spec_round` — same contract, paged target): returns
+        ``(emitted [L, k+1], n_emit [L], proposed [L])`` numpy."""
+        assert self.spec_on, "spec_round on a pool without spec_draft"
+        k = self.spec_k
+        for slot in list(self._ticking):
+            est = int(self._est_fill[slot])
+            top = min(est + k + 1,
+                      self.spec.blocks_per_seq * self.block_size)
+            if est < top:
+                self._cow_span(slot, est, top)
+        self.maybe_compiling = (
+            ("paged_spec_round",) not in self._seen_shapes)
+        try:
+            with self._ctx():
+                (self._pools, self._fills, self._drf_cache, emitted,
+                 n_emit, self._done, self._toks,
+                 proposed) = paged_spec_round(
+                    self.dec_model, self.drf_model, self.spec,
+                    self.params, self.drf_params, self._pools,
+                    self._drf_cache, self._tables, self._fills,
+                    self._toks, self._live, self._done, self._eos,
+                    k, fused=self._fused)
+            self._note_shape(("paged_spec_round",))
+        finally:
+            self.maybe_compiling = False
+        emitted = np.asarray(emitted)  # hvd: disable=HVD001(the spec round's ONE designed sync — acceptance counts are data-dependent and every retired token rides this read; docs/serving.md)
+        n_emit = np.asarray(n_emit)  # hvd: disable=HVD001(rides the same designed spec-round sync — the device work is already complete)
+        proposed = np.asarray(proposed)  # hvd: disable=HVD001(rides the same designed spec-round sync)
+        for slot in self._ticking:
+            # Conservative host fill advance for the COW gate, same
+            # contract as the tick's +1 (over-estimating only copies
+            # early, clamped to the chain).
+            self._est_fill[slot] += int(n_emit[slot])  # hvd: disable=HVD001(n_emit is already a host numpy array — no device read)
+        return emitted, n_emit, proposed
+
     # -- warmup -------------------------------------------------------
 
     def warmup(self, max_chunk: Optional[int] = None) -> dict:
@@ -802,7 +937,12 @@ class PagedSlotPool:
             self.begin_prefill(0)
             logits = self.prefill_chunk(0, np.zeros((c,), np.int32))
         self.finish_prefill(0, logits, 0.0, None, 0)
-        self.tick_sync(self.tick_dispatch())
+        if self.spec_on:
+            # Warm the round INSTEAD of the plain tick spec-mode
+            # scheduling never dispatches (see SlotPool.warmup).
+            self.spec_round()
+        else:
+            self.tick_sync(self.tick_dispatch())
         # Lane 0 back to pristine FREE state.
         self.begin_prefill(0)
         self._ticking.discard(0)
@@ -828,6 +968,10 @@ class PagedSlotPool:
         self._admit_info.pop(slot, None)
         self._ticking.discard(slot)
         self._est_fill[slot] = 0
+        if self.spec_on:
+            with self._ctx():
+                self._drf_cache = slot_reset(
+                    self.drf_model, self._drf_cache, jnp.int32(slot))
         with self._ctx():
             self._tables = self._tables.at[slot].set(
                 jnp.zeros((self.spec.blocks_per_seq,), jnp.int32))

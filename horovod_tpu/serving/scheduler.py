@@ -256,6 +256,16 @@ class ContinuousBatchingScheduler:
         # minutes for a slot to free.
         self.queue.sweep(now, on_drop=self._queue_drop)
         progressed = self._advance_prefills(now)
+        if getattr(self.pool, "spec_on", False):
+            # Speculative mode replaces the pipelined S=1 tick ring
+            # with synchronous draft-verify ROUNDS: each round's one
+            # host sync retires 1..k+1 tokens per lane (the
+            # amortization that used to need the ring), so there is
+            # no pending tick to overlap.
+            if self.active:
+                self._spec_round()
+                progressed = True
+            return progressed
         handle = snapshot = None
         if self.active:
             # The StallMonitor brackets the dispatch (where a
@@ -297,6 +307,61 @@ class ContinuousBatchingScheduler:
             if self.pipeline_depth < 1:
                 self._sync_pending(overlapped=False)
         return progressed
+
+    @hot_path
+    def _spec_round(self):
+        """One speculative draft-verify round over the active lanes:
+        the pool retires a VARIABLE 1..k+1 tokens per lane; tokens are
+        appended in order with per-token retirement checks (an eos or
+        a budget boundary mid-round discards the lane's remaining
+        emissions — the device already truncated at eos, the budget
+        truncation is host-side). Scheduler accounting: one tick, one
+        round, one exposed host sync — amortized over every token the
+        round retired."""
+        tick_name = (f"serving_spec_{self._gen}."
+                     f"{self.metrics.ticks}")
+        if self.stall is not None:
+            self.stall.begin(tick_name)
+        try:
+            if chaos.fires("serving_tick_stall"):
+                # Same cooperative hung-tick injection as the tick
+                # path (watchdog food; ends early once abandoned).
+                self.metrics.count("faults_injected")
+                t_end = time.time() + chaos.delay_of(
+                    "serving_tick_stall", 1.0)
+                while time.time() < t_end and not self.abandoned:
+                    time.sleep(0.005)
+            emitted, counts, proposed = self.pool.spec_round()
+        finally:
+            if self.stall is not None:
+                self.stall.end(tick_name)
+        self.metrics.count("ticks")
+        self.metrics.count("spec_rounds")
+        self.metrics.count("host_syncs")
+        if self.abandoned:
+            return   # successor replays from prompts; drop the round
+        accepted = prop = 0
+        multi = False
+        for slot, req in list(self.active.items()):
+            n = int(counts[slot])
+            if int(proposed[slot]) > 0:
+                prop += int(proposed[slot])
+                accepted += max(0, n - 1)
+            multi = multi or n >= 2
+            t_tick = time.time()
+            for j in range(n):
+                if self.active.get(slot) is not req:
+                    break   # retired mid-round; discard the tail
+                tok = int(emitted[slot, j])
+                req.tokens.append(tok)
+                self.metrics.count("tokens_out")
+                self._maybe_retire(slot, req, tok, t_tick)
+        if prop:
+            self.metrics.count("spec_proposed", prop)
+        if accepted:
+            self.metrics.count("spec_accepted", accepted)
+        if multi:
+            self.metrics.count("spec_multi_token_ticks")
 
     def _sync_pending(self, overlapped: bool):
         """Read one dispatched tick's tokens; append to the requests
@@ -478,6 +543,9 @@ class ContinuousBatchingScheduler:
         req.t_first = time.time()
         req.tokens.append(first)
         self.metrics.count("tokens_out")
+        # Sampled by the prefill forward, not a decode tick — the
+        # tokens_per_tick metric excludes it.
+        self.metrics.count("prefill_first_tokens")
         _span("end_span", req.id, "PREFILL")
         _span("begin_span", req.id, "DECODE",
               trace_id=req.trace_id)

@@ -51,10 +51,35 @@ import jax.numpy as jnp
 from horovod_tpu.annotations import hot_path
 from horovod_tpu.models.transformer import (
     TransformerLM, init_slot_cache, prefill_chunks, sample_token,
-    slot_decode_model, slot_decode_tick, slot_prefill_chunk,
-    slot_reset,
+    slot_decode_model, slot_decode_tick, slot_prefill_advance,
+    slot_prefill_chunk, slot_reset, slot_spec_round,
 )
 from horovod_tpu.parallel.mesh import use
+
+
+def validate_spec_draft(model: TransformerLM, spec_draft,
+                        spec_k: int):
+    """Shared spec-decode construction checks (both pools and the
+    engine): the draft must share the target's vocab, neither model
+    may roll a sliding-window cache (rewind would overwrite live
+    slots — `models.speculative`'s constraint), the draft cache must
+    cover every position the target can reach, and k must leave room
+    for at least one proposal."""
+    draft_model, _ = spec_draft
+    if spec_k < 1:
+        raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+    if draft_model.vocab_size != model.vocab_size:
+        raise ValueError(
+            f"spec draft vocab ({draft_model.vocab_size}) != target "
+            f"vocab ({model.vocab_size})")
+    if model.window is not None or draft_model.window is not None:
+        raise ValueError(
+            "speculative decoding cannot rewind a sliding-window "
+            "(rolling) cache; use window=None models")
+    if draft_model.max_len < model.max_len:
+        raise ValueError(
+            f"spec draft max_len ({draft_model.max_len}) must cover "
+            f"the target's ({model.max_len})")
 
 
 @jax.jit
@@ -137,7 +162,8 @@ class SlotPool:
     """
 
     def __init__(self, model: TransformerLM, params, num_slots: int,
-                 *, mesh=None, eos_id: Optional[int] = None):
+                 *, mesh=None, eos_id: Optional[int] = None,
+                 spec_draft=None, spec_k: int = 0):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
         self.model = model
@@ -148,6 +174,21 @@ class SlotPool:
         self.eos_id = eos_id
         self._eos = jnp.int32(-1 if eos_id is None else eos_id)
         self._cache = init_slot_cache(model, num_slots)
+        # Speculative decoding (docs/serving.md "Decode fast path"):
+        # ``spec_draft`` = (draft_model, draft_params) arms the
+        # draft-verify round — the tick is then `spec_round`, retiring
+        # 1..k+1 tokens per lane per round, greedy-only. The draft
+        # rides its own linear slot cache, prefilled chunk-for-chunk
+        # alongside the target's.
+        self.spec_draft = spec_draft
+        self.spec_k = int(spec_k) if spec_draft is not None else 0
+        self.drf_model = self.drf_params = self._drf_cache = None
+        if self.spec_on:
+            validate_spec_draft(model, spec_draft, self.spec_k)
+            draft_model, draft_params = spec_draft
+            self.drf_model = slot_decode_model(draft_model)
+            self.drf_params = draft_params
+            self._drf_cache = init_slot_cache(draft_model, num_slots)
         self._toks = jnp.zeros((num_slots,), jnp.int32)
         self._temps = jnp.zeros((num_slots,), jnp.float32)
         self._top_ps = jnp.ones((num_slots,), jnp.float32)
@@ -171,6 +212,10 @@ class SlotPool:
         # hot-path compiles (the "no compile in the timed window"
         # guarantee ci.sh asserts).
         self.compiles = 0
+
+    @property
+    def spec_on(self) -> bool:
+        return self.spec_draft is not None and self.spec_k > 0
 
     def _ctx(self):
         return use(self.mesh) if self.mesh is not None \
@@ -198,7 +243,9 @@ class SlotPool:
         config and shapes, both unchanged, so the clone recompiles
         nothing."""
         fresh = SlotPool(self.model, self.params, self.num_slots,
-                         mesh=self.mesh, eos_id=self.eos_id)
+                         mesh=self.mesh, eos_id=self.eos_id,
+                         spec_draft=self.spec_draft,
+                         spec_k=self.spec_k)
         # The jit cache is process-global: shapes this pool compiled
         # are warm for the clone too (and the compile count carries,
         # so hot-path-compile accounting survives a restart).
@@ -268,6 +315,10 @@ class SlotPool:
             with self._ctx():
                 self._cache = slot_reset(self.dec_model, self._cache,
                                          jnp.int32(slot))
+                if self.spec_on:
+                    self._drf_cache = slot_reset(
+                        self.drf_model, self._drf_cache,
+                        jnp.int32(slot))
                 self._live = self._live.at[slot].set(False)
                 self._done = self._done.at[slot].set(False)
             self._note_shape(("reset",))
@@ -290,6 +341,15 @@ class SlotPool:
                 self._cache, logits = slot_prefill_chunk(
                     self.dec_model, self.params, self._cache,
                     jnp.int32(slot), jnp.asarray(chunk, jnp.int32))
+                if self.spec_on:
+                    # The draft's cache must hold the SAME prompt as
+                    # the target's before any round — same chunk
+                    # schedule, advance-only (no logits: the first
+                    # token is always the target's).
+                    self._drf_cache = slot_prefill_advance(
+                        self.drf_model, self.drf_params,
+                        self._drf_cache, jnp.int32(slot),
+                        jnp.asarray(chunk, jnp.int32))
             self._note_shape(("prefill", c))
             return logits
         finally:
@@ -391,6 +451,36 @@ class SlotPool:
         scheduler's hot path uses the split pair."""
         return self.tick_sync(self.tick_dispatch())
 
+    # -- speculative rounds (docs/serving.md "Decode fast path") ------
+
+    @hot_path
+    def spec_round(self):
+        """One batched draft-verify round over every lane: the draft
+        proposes ``spec_k`` tokens per live lane, the target verifies
+        each lane's block in one chunked append, and 1..k+1 tokens
+        retire per lane — bitwise the target's greedy stream. Returns
+        ``(emitted [L, k+1], n_emit [L], proposed [L])`` numpy; the
+        read is the round's ONE host sync (acceptance is
+        data-dependent — the scheduler must see the tokens to retire
+        and truncate), amortized over every retired token."""
+        assert self.spec_on, "spec_round on a pool without spec_draft"
+        self.maybe_compiling = ("spec_round",) not in self._seen_shapes
+        try:
+            with self._ctx():
+                (self._cache, self._drf_cache, emitted, n_emit,
+                 self._done, self._toks, proposed) = slot_spec_round(
+                    self.dec_model, self.drf_model, self.params,
+                    self.drf_params, self._cache, self._drf_cache,
+                    self._toks, self._live, self._done, self._eos,
+                    self.spec_k)
+            self._note_shape(("spec_round",))
+        finally:
+            self.maybe_compiling = False
+        emitted = np.asarray(emitted)  # hvd: disable=HVD001(the spec round's ONE designed sync — acceptance counts are data-dependent and every retired token rides this read; docs/serving.md)
+        n_emit = np.asarray(n_emit)  # hvd: disable=HVD001(rides the same designed spec-round sync — the device work is already complete)
+        proposed = np.asarray(proposed)  # hvd: disable=HVD001(rides the same designed spec-round sync)
+        return emitted, n_emit, proposed
+
     # -- warmup -------------------------------------------------------
 
     def warmup(self, max_chunk: Optional[int] = None) -> dict:
@@ -416,7 +506,15 @@ class SlotPool:
             self.begin_prefill(0)
             logits = self.prefill_chunk(0, np.zeros((c,), np.int32))
         self.finish_prefill(0, logits, 0.0, None, 0)
-        self.tick_sync(self.tick_dispatch())
+        if self.spec_on:
+            # Spec mode replaces the S=1 tick with the round (the
+            # scheduler never dispatches a plain tick), so warm the
+            # round INSTEAD of paying a dead full-model tick compile;
+            # its program shape is occupancy-independent (live/done
+            # are traced).
+            self.spec_round()
+        else:
+            self.tick_sync(self.tick_dispatch())
         # Lane 0 back to pristine FREE state (reset clears live/done).
         self.begin_prefill(0)
         with self._ctx():
@@ -436,6 +534,9 @@ class SlotPool:
         with self._ctx():
             self._cache = slot_reset(self.dec_model, self._cache,
                                      jnp.int32(slot))
+            if self.spec_on:
+                self._drf_cache = slot_reset(
+                    self.drf_model, self._drf_cache, jnp.int32(slot))
             self._live = self._live.at[slot].set(False)
             self._done = self._done.at[slot].set(False)
             # Neutral sampling state so the freed lane's masked decode
