@@ -618,6 +618,10 @@ def _serve_rate(model, params, args, prompts, rate, *,
         # the paged A/B.
         "peak_active": snap["peak_active"],
         "num_slots": S,
+        # 1 = unsharded; > 1 = the serving mesh width the engine
+        # partitioned the hot path over (docs/serving.md "Sharded
+        # serving").
+        "mesh_devices": snap.get("mesh_devices", 1),
     }
     if snap["spec_rounds"]:
         rec.update({
@@ -1192,6 +1196,57 @@ def run_serving(args, devices, n_chips, log):
             + "; tpot p50 "
             + ", ".join(f"{n}={matrix[n]['tpot_ms_p50']}ms"
                         for n in legs))
+    if args.serving_mesh > 1 and not chaos_mode:
+        # Sharded-serving A/B (docs/serving.md "Sharded serving"): the
+        # paged engine on 1 vs N mesh devices at EQUAL per-device KV
+        # bytes — heads-sharded KV puts 1/N of every block on each
+        # device, so the N-device pool carries N x the blocks (and N x
+        # the lanes) at the unsharded leg's per-device footprint. The
+        # capacity claim is the per-device-memory -> concurrency
+        # trade; the token streams stay bitwise by construction
+        # (pinned by tests/test_sharded_serving.py, not re-proven
+        # here).
+        N = args.serving_mesh
+        if jax.device_count() < N:
+            log(f"serving mesh A/B skipped: need {N} devices, "
+                f"{jax.device_count()} visible (use --platform cpu "
+                f"to force a virtual mesh)")
+        else:
+            rate = max(rates)
+            bs = args.serving_kv_block_size
+            if args.seq % bs:
+                raise ValueError(
+                    f"--serving-kv-block-size {bs} must divide --seq "
+                    f"{args.seq} for the mesh A/B's paged legs")
+            base_cfg = {"num_slots": S,
+                        "kv_blocks": S * args.seq // bs + 1,
+                        "kv_block_size": bs}
+            sharded_cfg = {"num_slots": N * S,
+                           "kv_blocks": N * S * args.seq // bs + 1,
+                           "kv_block_size": bs}
+            out["mesh_ab"] = {
+                "rate": rate, "mesh_devices": N,
+                "equal_per_device_kv_token_rows": S * args.seq,
+                "unsharded": _serve_rate(
+                    model, params, args, prompts, rate,
+                    pipeline_depth=depth, prefill_chunk_budget=budget,
+                    chaos_mode=False, log=log, paged_cfg=base_cfg,
+                    label="mesh1"),
+                "sharded": _serve_rate(
+                    model, params, args, prompts, rate,
+                    pipeline_depth=depth, prefill_chunk_budget=budget,
+                    chaos_mode=False, log=log, paged_cfg=sharded_cfg,
+                    engine_kw={"mesh": N}, label=f"mesh{N}"),
+            }
+            u = out["mesh_ab"]["unsharded"]
+            s = out["mesh_ab"]["sharded"]
+            log(f"mesh A/B at rate={rate}/s (equal per-device KV "
+                f"bytes): 1 -> {N} devices, {u['tok_s']} -> "
+                f"{s['tok_s']} tok/s, ttft p50 {u['ttft_ms_p50']} -> "
+                f"{s['ttft_ms_p50']} ms, tpot p50 {u['tpot_ms_p50']} "
+                f"-> {s['tpot_ms_p50']} ms, peak concurrency "
+                f"{u['peak_active']} (cap {u['num_slots']}) -> "
+                f"{s['peak_active']} (cap {s['num_slots']})")
     if getattr(args, "router", False):
         # Fleet-failover A/B (1 vs N replicas, with and without the
         # seeded router.replica_kill chaos) at the highest rate.
@@ -1603,6 +1658,19 @@ def main():
                     help="serving: paged-attention dispatch for every "
                          "paged leg (HVD_PAGED_KERNEL parity; 'off' "
                          "= the legacy full-span gather)")
+    ap.add_argument("--serving-mesh", type=int, default=0,
+                    metavar="N",
+                    help="serving: > 1 adds the sharded-serving A/B "
+                         "at the highest rate — the paged engine on "
+                         "1 vs N mesh devices at EQUAL per-device KV "
+                         "bytes (the N-device pool carries N x the "
+                         "blocks and lanes, each shard holding the "
+                         "same bytes as the unsharded pool) — "
+                         "recording TTFT/TPOT, tokens/s and peak "
+                         "concurrency per leg. With --platform cpu "
+                         "the virtual device count is forced to N "
+                         "(HVD_SERVE_MESH parity; docs/serving.md "
+                         "'Sharded serving')")
     ap.add_argument("--router", action="store_true",
                     help="serving: add the fleet-failover A/B — "
                          "ServingRouter over 1 vs --router-replicas "
@@ -1708,6 +1776,18 @@ def main():
                          "time_to_resume_s for the multi-process "
                          "path (resilience/drill.py)")
     args = ap.parse_args()
+
+    if args.serving and args.serving_mesh > 1 and args.platform == "cpu":
+        # The sharded-serving A/B needs N visible CPU devices, and
+        # --xla_force_host_platform_device_count only takes effect
+        # before the backend initializes — this runs ahead of the
+        # lazy jax import below (the same window tests/conftest.py
+        # uses for its virtual 8-device mesh).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{args.serving_mesh}").strip()
 
     if args.resume_check:
         sys.exit(run_resume_check(args))
@@ -2153,6 +2233,12 @@ def _bench_body(args, devices, n_chips, metric, unit,
             # {spec off, spec on} — accepted tokens/tick, acceptance
             # rate and TPOT per leg.
             result["spec_matrix"] = r["spec_matrix"]
+        if "mesh_ab" in r:
+            # The sharded-serving A/B (docs/serving.md "Sharded
+            # serving"): 1 vs N mesh devices at equal per-device KV
+            # bytes — TTFT/TPOT, tokens/s, peak concurrency per leg.
+            result["mesh_ab"] = r["mesh_ab"]
+            result["serving_mesh"] = args.serving_mesh
         if "router_ab" in r:
             # The fleet-failover A/B (docs/serving.md "Fleet
             # failover"): 1 vs N replicas, each +/- the seeded

@@ -96,6 +96,18 @@ JAX_PLATFORMS=cpu python -m horovod_tpu.obs.flightrec \
 JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4 \
     --failover-check
 
+# Sharded-serving smoke (docs/serving.md "Sharded serving"): the
+# example bootstraps a 4-device virtual CPU mesh
+# (--xla_force_host_platform_device_count) and asserts (1) fixed AND
+# paged engines sharded over a model=4 mesh produce BITWISE the
+# unsharded engine's token streams, greedy and seeded — the mesh
+# changes where the hot path runs, never what it produces — and (2) a
+# MIXED sharded/unsharded fleet under ServingRouter survives a
+# router.replica_kill mid-decode with every stream token-exact vs the
+# no-chaos run (forced-prefix migration is layout-agnostic).
+JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 3 \
+    --sharded-check
+
 # Resume smoke (docs/resilience.md "Exact resume"): a short training
 # run over a sharded shuffled dataset is killed mid-epoch AND
 # mid-checkpoint-save via HVD_CHAOS, restarted with full TrainSnapshot

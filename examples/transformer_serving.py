@@ -70,6 +70,16 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+if "--sharded-check" in sys.argv:
+    # The sharded smoke needs 4 visible CPU devices, and the flag only
+    # takes effect before the jax backend initializes — so it must be
+    # set here, ahead of the import below (the same window
+    # tests/conftest.py uses).
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=4").strip()
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -441,6 +451,114 @@ def spec_check(model, params, prompts, max_new):
           f"{snap['spec_acceptance_rate']}")
 
 
+def sharded_check(model, params, prompts, max_new, replicas=3):
+    """The sharded-serving smoke (docs/serving.md "Sharded serving"),
+    on the 4-device CPU mesh the module bootstrap forced:
+
+    1. Fixed AND paged engines sharded over a model=4 mesh must
+       produce BITWISE the unsharded engine's token streams, greedy
+       and seeded — the mesh changes where the hot path runs, never
+       what it produces.
+    2. A MIXED fleet under `ServingRouter` — sharded and unsharded
+       replicas side by side, the router none the wiser — has its
+       busiest replica hard-killed mid-decode; every stream must
+       complete bitwise the no-chaos unsharded reference (token-exact
+       migration ACROSS layouts: the forced prefix carries between a
+       sharded and an unsharded cache, or vice versa).
+    """
+    import time
+
+    from horovod_tpu.parallel.mesh import make_mesh
+    from horovod_tpu.resilience import chaos
+    from horovod_tpu.serving import ServingRouter
+
+    assert jax.device_count() >= 4, (
+        "sharded check needs the 4-device CPU mesh", jax.devices())
+    mesh = make_mesh(devices=jax.devices()[:4], model=4)
+    steps = max_new
+
+    def streams(**kw):
+        with ServingEngine(model, params, num_slots=2,
+                           max_queue=2 * len(prompts), **kw) as eng:
+            out = []
+            for i, p in enumerate(prompts):
+                greedy = eng.submit(p, steps)
+                seeded = eng.submit(p, steps, temperature=0.8,
+                                    seed=10 + i)
+                out.append((list(greedy.result(timeout=600).tokens),
+                            list(seeded.result(timeout=600).tokens)))
+            return out, eng.metrics_snapshot()
+
+    for paged in (False, True):
+        kw = dict(paged=True, kv_block_size=16) if paged else {}
+        ref, _ = streams(**kw)
+        got, snap = streams(mesh=mesh, **kw)
+        assert got == ref, (
+            f"sharded {'paged' if paged else 'fixed'} streams "
+            f"diverged from single-device")
+        assert snap["mesh_devices"] == 4, snap
+        print(f"sharded check: {'paged' if paged else 'fixed'} pool "
+              f"bitwise across {len(prompts)} greedy+seeded streams "
+              f"on the model=4 mesh")
+
+    # Leg 2: mixed-layout fleet failover. Replicas alternate
+    # sharded/unsharded, so the kill's migrations land on (or leave
+    # from) a differently-sharded survivor — the forced prefix is
+    # layout-agnostic.
+    rs = np.random.RandomState(6)
+    fprompts = [rs.randint(0, 128, (int(rs.randint(2, 10)),))
+                for _ in range(max(4, len(prompts)))]
+    seeds = list(range(len(fprompts)))
+    fsteps = 24
+    with ServingEngine(model, params, num_slots=2,
+                       max_queue=2 * len(fprompts)) as eng:
+        refs = [list(h.result(timeout=600).tokens) for h in
+                [eng.submit(p, fsteps, temperature=0.7, seed=s)
+                 for p, s in zip(fprompts, seeds)]]
+
+    built = [0]
+
+    def factory():
+        built[0] += 1
+        return ServingEngine(
+            model, params, num_slots=2,
+            max_queue=2 * len(fprompts), warmup=True,
+            mesh=mesh if built[0] % 2 else None)
+
+    router = ServingRouter(factory, num_replicas=replicas,
+                           health_poll_s=0.01)
+    try:
+        handles = [router.submit(p, fsteps, temperature=0.7, seed=s)
+                   for p, s in zip(fprompts, seeds)]
+        deadline = time.time() + 60
+        while (not any(len(h.tokens_so_far()) >= 2 for h in handles)
+               and time.time() < deadline):
+            time.sleep(0.01)
+        with chaos.armed("router.replica_kill:1") as monkey:
+            while (monkey.fired("router.replica_kill") == 0
+                   and time.time() < deadline):
+                time.sleep(0.01)
+            results = [h.result(timeout=600) for h in handles]
+        assert monkey.fired("router.replica_kill") == 1, (
+            "the chaos kill never fired")
+        for r, ref in zip(results, refs):
+            assert list(r.tokens) == ref, (
+                "stream diverged across the mixed-layout replica "
+                "kill", list(r.tokens), ref)
+        snap = router.metrics_snapshot()
+        assert snap["completed"] == len(fprompts), snap
+        assert snap["replica_deaths"] == 1, snap
+        assert snap["migrations"] >= 1, (
+            "the kill caught no stream mid-decode", snap)
+        print(f"sharded check OK: mixed sharded/unsharded fleet, "
+              f"replica killed mid-decode, {snap['migrations']} "
+              f"stream(s) migrated token-exact across layouts, "
+              f"{len(fprompts)}/{len(fprompts)} bitwise the no-chaos "
+              f"run")
+    finally:
+        router.shutdown()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=4)
@@ -476,6 +594,12 @@ def main():
                          "(router.replica_kill), all requests must "
                          "complete bitwise-equal to a no-chaos run "
                          "(docs/serving.md 'Fleet failover')")
+    ap.add_argument("--sharded-check", action="store_true",
+                    help="sharded-serving smoke: fixed+paged engines "
+                         "on a model=4 CPU mesh bitwise the unsharded "
+                         "streams, and a mixed sharded/unsharded "
+                         "fleet survives a replica kill token-exactly "
+                         "(docs/serving.md 'Sharded serving')")
     ap.add_argument("--spec-check", action="store_true",
                     help="decode-fast-path smoke: a speculative "
                          "(self-draft) engine's greedy streams must "
@@ -545,6 +669,8 @@ def main():
         spec_check(model, params, prompts, args.max_new_tokens)
     if args.fleet_check:
         fleet_check(model, params, deferred_monkey)
+    if args.sharded_check:
+        sharded_check(model, params, prompts, args.max_new_tokens)
     if args.failover_check:
         failover_check(model, params, n_requests=max(args.requests, 4))
 

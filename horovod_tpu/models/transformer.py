@@ -1010,6 +1010,53 @@ def init_slot_cache(model: TransformerLM, num_slots: int):
         shapes["cache"])
 
 
+def _serve_kv_axis(axis: Optional[str]) -> str:
+    """The mesh axis serving KV shards ride (``HVD_SERVE_MESH_AXIS``,
+    default the tensor-parallel ``model`` axis — KV heads live with
+    their query groups' attention shards)."""
+    if axis is not None:
+        return axis
+    from horovod_tpu.runtime.config import config as _cfg
+    return _cfg.serve_mesh_axis or AXIS_MODEL
+
+
+def shard_slot_cache(cache, mesh, axis: Optional[str] = None):
+    """Commit a slot-pool cache (`init_slot_cache` layout) onto
+    ``mesh``: KV leaves shard along the HEADS axis — dim 3 of
+    [num_slots, 1, max_len, Hkv, ...] (K/V values and their int8-KV
+    scale twins both carry Hkv there) — over the serving mesh axis;
+    the per-slot fill-index vectors replicate (host-replicated int32
+    metadata, one host decision drives all shards). GQA-aware via
+    `safe_spec`: a heads count the axis size doesn't divide keeps the
+    leaf replicated — KV heads partition with their query groups only
+    when they can, never unevenly."""
+    from jax.tree_util import tree_flatten_with_path, tree_unflatten
+    from horovod_tpu.parallel.mesh import _place, safe_spec, sharding
+    axis = _serve_kv_axis(axis)
+    flat, treedef = tree_flatten_with_path(cache)
+    out = []
+    for path, leaf in flat:
+        spec = (P() if "index" in str(path) else
+                safe_spec(mesh, P(None, None, None, axis), leaf.shape))
+        out.append(_place(leaf, sharding(mesh, *spec)))
+    return tree_unflatten(treedef, out)
+
+
+def shard_paged_pools(pools, mesh, axis: Optional[str] = None):
+    """Commit paged block pools (`init_paged_pools` layout) onto
+    ``mesh``: every pool leaf is [num_blocks, 1, block_size, Hkv, ...]
+    — the heads axis sits at dim 3 exactly as in the linear slot
+    cache — so each device holds its head slice of EVERY block, and a
+    host-side block id names a mesh-wide block SHARD set. Same
+    GQA-aware degrade as `shard_slot_cache`."""
+    from horovod_tpu.parallel.mesh import _place, safe_spec, sharding
+    axis = _serve_kv_axis(axis)
+    return [
+        _place(p, sharding(mesh, *safe_spec(
+            mesh, P(None, None, None, axis), p.shape)))
+        for p in pools]
+
+
 @functools.partial(jax.jit, static_argnames=("dec_model",),
                    donate_argnums=(1,))
 def slot_reset(dec_model, cache, slot):

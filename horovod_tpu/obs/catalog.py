@@ -58,6 +58,28 @@ def serving_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
             "hvd_serving_compiles_total",
             "First-time-shape XLA compiles in the slot pool "
             "(0 growth inside a warmed serving window)"),
+        # Sharded serving (docs/serving.md "Sharded serving"): mesh
+        # width per engine, and per-shard block occupancy — one host
+        # allocator decision drives every shard, so the per-shard rows
+        # agree by construction; the `shard` label makes per-device
+        # KV accounting scrapeable on a real pod.
+        "mesh_devices": reg.gauge(
+            "hvd_serving_mesh_devices",
+            "Devices in the engine's serving mesh (1 = unsharded; "
+            "KV head shards ride the HVD_SERVE_MESH_AXIS axis)",
+            ("engine",)),
+        "kv_blocks_free_shard": reg.gauge(
+            "hvd_kv_blocks_free_per_shard",
+            "Paged-KV block shards on the free list, per mesh shard",
+            ("engine", "shard")),
+        "kv_blocks_used_shard": reg.gauge(
+            "hvd_kv_blocks_used_per_shard",
+            "Paged-KV block shards owned by live sequences, per mesh "
+            "shard", ("engine", "shard")),
+        "kv_blocks_cached_shard": reg.gauge(
+            "hvd_kv_blocks_cached_per_shard",
+            "Refcount-0 prefix-cache-resident block shards, per mesh "
+            "shard", ("engine", "shard")),
         # Paged KV cache + shared-prefix caching (docs/serving.md
         # "Paged KV cache"): block occupancy per engine and the
         # process-wide prefix-cache accounting.

@@ -180,12 +180,56 @@ def sharding(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
+def safe_spec(mesh: Mesh, spec, shape) -> P:
+    """Degrade a P(...) spec to what ``mesh`` can actually shard on a
+    CONCRETE array: axes absent from the mesh are dropped, and so are
+    axes whose size doesn't divide the dimension — the placement-time
+    twin of `constrain`'s rule, used where arrays are committed with
+    `device_put` rather than constrained inside a program. This is
+    what makes KV-cache sharding GQA-aware: a heads dimension the
+    model axis doesn't divide stays replicated instead of erroring."""
+    sizes = dict(mesh.shape)
+    spec = tuple(spec) if isinstance(spec, (tuple, list)) else (spec,)
+    assert len(spec) <= len(shape), (
+        f"spec {spec} has more entries than array rank {len(shape)} "
+        f"(shape {shape})")
+
+    def keep(entry, dim):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept, degree = [], 1
+            for e in entry:
+                if e in sizes and dim % (degree * sizes[e]) == 0:
+                    kept.append(e)
+                    degree *= sizes[e]
+            return tuple(kept) if kept else None
+        if entry in sizes and dim % sizes[entry] == 0:
+            return entry
+        return None
+
+    return P(*(keep(s, d) for s, d in zip(spec, shape)))
+
+
+def place_with_specs(mesh: Mesh, tree, specs):
+    """Commit a plain-array pytree onto ``mesh`` per a matching
+    P(...)-spec pytree (e.g. from `parallel.tensor.param_specs`),
+    degrading each spec through `safe_spec` first. The sharded-serving
+    analogue of `shard_params` for trees whose `nn.Partitioned` boxes
+    were already stripped (pools and engines hold unboxed params)."""
+    return jax.tree.map(
+        lambda x, s: _place(x, NamedSharding(
+            mesh, safe_spec(mesh, s, x.shape))),
+        tree, specs)
+
+
 def _place(x, sh: NamedSharding):
     """device_put that also works inside a `use()` mesh context, where
     jax requires the source to be host-resident or already mesh-committed
     (single-device jax Arrays are rejected) — round-trip through numpy."""
     if isinstance(x, jax.Array) and not isinstance(
             x.sharding, NamedSharding):
+        # hvd: disable=HVD001(one-shot committed placement at pool/engine CONSTRUCTION (and clone_fresh restart) — never per tick; the coarse call graph reaches it through the pool __init__ chain)
         x = np.asarray(x)
     return jax.device_put(x, sh)
 

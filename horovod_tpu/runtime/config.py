@@ -197,6 +197,17 @@ register_knob(
     "block matmul kernels int8 + per-channel scales), "
     "docs/serving.md 'Decode fast path'")
 register_knob(
+    "HVD_SERVE_MESH", "str", "(unset)", "runtime/config.py",
+    "Serving: shard the engine over a model-parallel mesh when "
+    "ServingEngine(mesh=) isn't passed — a device count ('4' = "
+    "model=4 over the first 4 devices) or 'axis=N[,axis=N...]' axis "
+    "sizes; unset = unsharded, docs/serving.md 'Sharded serving'")
+register_knob(
+    "HVD_SERVE_MESH_AXIS", "str", "model", "runtime/config.py",
+    "Serving: mesh axis name the KV-cache head shards ride (KV heads "
+    "partition with their query groups' tensor-parallel shards), "
+    "docs/serving.md 'Sharded serving'")
+register_knob(
     "HOROVOD_TIMELINE", "str", "(unset)", "runtime/config.py",
     "Write a Chrome-trace timeline to this path, docs/timeline.md")
 register_knob(
@@ -423,6 +434,11 @@ class Config:
     paged_kernel: str = "auto"
     spec_k: int = DEFAULT_SPEC_K
     weight_quant: str = ""
+    # Sharded serving (docs/serving.md "Sharded serving"): the default
+    # engine mesh ("" = unsharded) and the axis the KV head shards
+    # ride.
+    serve_mesh: str = ""
+    serve_mesh_axis: str = "model"
     # Serving fleet (ServingRouter, docs/serving.md "Fleet failover").
     router_replicas: int = DEFAULT_ROUTER_REPLICAS
     router_poll_s: float = DEFAULT_ROUTER_POLL_S
@@ -462,6 +478,8 @@ class Config:
         self.paged_kernel = env_str("HVD_PAGED_KERNEL", "auto")
         self.spec_k = _env_int("HVD_SPEC_K", DEFAULT_SPEC_K)
         self.weight_quant = env_str("HVD_WEIGHT_QUANT")
+        self.serve_mesh = env_str("HVD_SERVE_MESH")
+        self.serve_mesh_axis = env_str("HVD_SERVE_MESH_AXIS", "model")
         self.router_replicas = _env_int("HVD_ROUTER_REPLICAS",
                                         DEFAULT_ROUTER_REPLICAS)
         self.router_poll_s = _env_float("HVD_ROUTER_POLL",

@@ -76,6 +76,68 @@ _IDLE_WAIT_S = 0.05
 _ENGINE_IDS = itertools.count()
 
 
+def _resolve_serving_mesh(mesh):
+    """Normalize `ServingEngine`'s ``mesh`` argument to a built
+    `jax.sharding.Mesh` (or None = unsharded).
+
+    Accepted forms (docs/serving.md "Sharded serving"):
+
+    * None — read ``HVD_SERVE_MESH`` (unset keeps the engine
+      unsharded, the default);
+    * a built ``Mesh`` — used as-is (tests build exact-device meshes);
+    * a ``MeshSpec`` — resolved over every visible device;
+    * an int N — a 1-axis mesh of the first N devices on the serving
+      axis (``HVD_SERVE_MESH_AXIS``, default ``model``);
+    * a str — either a device count ("4") or comma-separated
+      "axis=N" sizes ("model=2,data=2"), built over the first
+      prod(N) devices.
+    """
+    if mesh is None:
+        from horovod_tpu.runtime.config import config as _cfg
+        mesh = _cfg.serve_mesh.strip() or None
+    if mesh is None:
+        return None
+    import jax
+    from jax.sharding import Mesh
+    from horovod_tpu.parallel.mesh import MeshSpec, make_mesh
+    if isinstance(mesh, Mesh):
+        return mesh
+    if isinstance(mesh, MeshSpec):
+        return make_mesh(spec=mesh)
+    if isinstance(mesh, str):
+        s = mesh.strip()
+        if "=" in s:
+            sizes = {}
+            for part in s.split(","):
+                k, _, v = part.partition("=")
+                sizes[k.strip()] = int(v)
+            need = 1
+            for v in sizes.values():
+                need *= v
+            devs = jax.devices()
+            if need > len(devs):
+                raise ValueError(
+                    f"serving mesh {sizes} needs {need} devices, "
+                    f"only {len(devs)} visible (HVD_SERVE_MESH)")
+            return make_mesh(devices=devs[:need], **sizes)
+        mesh = int(s)
+    if isinstance(mesh, int):
+        if mesh < 1:
+            raise ValueError(
+                f"serving mesh device count must be >= 1, got {mesh}")
+        devs = jax.devices()
+        if mesh > len(devs):
+            raise ValueError(
+                f"serving mesh needs {mesh} devices, only "
+                f"{len(devs)} visible (HVD_SERVE_MESH)")
+        from horovod_tpu.runtime.config import config as _cfg
+        axis = _cfg.serve_mesh_axis or "model"
+        return make_mesh(devices=devs[:mesh], **{axis: mesh})
+    raise TypeError(
+        f"mesh must be None, an int device count, a 'axis=N' str, a "
+        f"MeshSpec, or a built Mesh; got {type(mesh).__name__}")
+
+
 class RequestHandle:
     """The caller's view of one in-flight request."""
 
@@ -137,7 +199,12 @@ class ServingEngine:
         the engine returns ragged per-request tokens, not a rectangle.
     default_timeout_s : per-request deadline applied when `submit`
         gets no explicit ``timeout_s`` (None = no deadline).
-    mesh : optional mesh for TP-sharded params, as in `generate`.
+    mesh : serving mesh (docs/serving.md "Sharded serving"). None reads
+        ``HVD_SERVE_MESH`` (unset = unsharded); an int N, an "axis=N"
+        str, a `MeshSpec`, or a built `Mesh` shard the whole decode hot
+        path: params go in through their partition specs, KV caches
+        shard along the heads axis, and the token stream stays bitwise
+        identical to the single-device program.
     auto_restart : self-healing (docs/resilience.md): a watchdog
         thread detects a dead dispatch thread (uncaught exception) or
         a stuck one (no heartbeat for ``tick_deadline_s`` with work
@@ -244,6 +311,12 @@ class ServingEngine:
             raise ValueError(
                 f"eos_id must be in [0, vocab_size={model.vocab_size}"
                 f"), got {eos_id}")
+        # Sharded serving (docs/serving.md "Sharded serving"): the
+        # engine owns mesh construction — None reads HVD_SERVE_MESH,
+        # and ints/strs/MeshSpecs normalize to a built Mesh here so
+        # pools and params all see the ONE resolved layout.
+        mesh = _resolve_serving_mesh(mesh)
+        self.mesh = mesh
         # Weight-only quantization at the engine door (docs/serving.md
         # "Decode fast path"): the block-matmul kernels land int8 +
         # per-channel f32 scales, halving decode's weight HBM reads.
@@ -263,6 +336,20 @@ class ServingEngine:
                 model = model.clone(weight_quant=weight_quant)
                 params = quantize_lm_params(params)
         self.weight_quant = model.weight_quant
+        if mesh is not None:
+            # Sharded params AT THE DOOR, specs derived from the
+            # FINAL model — after the quantization clone above, so an
+            # int8 tree's kernel_q blocks and their kernel_scale rows
+            # carry the same partition axes as the f32 kernels they
+            # replace (scales shard with their blocks).
+            import jax
+            import jax.numpy as jnp
+            from horovod_tpu.models.transformer import lm_param_specs
+            from horovod_tpu.parallel.mesh import place_with_specs
+            specs = lm_param_specs(
+                model, jax.random.PRNGKey(0),
+                jnp.zeros((1, model.max_len), jnp.int32))
+            params = place_with_specs(mesh, params, specs)
         # Speculative decoding (docs/serving.md "Decode fast path"):
         # ``spec_draft`` = (draft_model, draft_params) turns the slot
         # tick into a draft-verify ROUND retiring 1..spec_k+1 tokens.
@@ -290,6 +377,7 @@ class ServingEngine:
         self.slo = slo
         self.metrics = EngineMetrics(
             engine_label=str(self._engine_id), slo=slo)
+        self.metrics.observe_mesh(self.mesh_devices, self._mesh_shape())
         self.auto_restart = auto_restart
         self.max_restarts = max_restarts
         self.tick_deadline_s = tick_deadline_s
@@ -435,6 +523,12 @@ class ServingEngine:
                 "closing": self._closing,
                 "restarts": self._restart_count,
                 "queue_depth": len(self.queue),
+                # Mesh stamp: /healthz (and the flight-recorder
+                # bundle's health snapshot) names the layout a
+                # replica is serving from — a sharded and an
+                # unsharded replica are otherwise indistinguishable.
+                "mesh_devices": self.mesh_devices,
+                "mesh": self._mesh_shape(),
                 # Drives /healthz's HTTP code: a dead (or draining)
                 # dispatch thread must read 503 to a status-code
                 # probe, not 200-with-fine-print.
@@ -652,7 +746,7 @@ class ServingEngine:
             # to preserve (no-op unless HVD_FLIGHT_DIR is set).
             _flightrec.trigger(
                 "serving.dispatch_crash", engine=self._engine_id,
-                error=repr(e))
+                error=repr(e), mesh=self._mesh_shape())
             scheduler.fail_inflight(lambda req: EngineClosedError(
                 f"serving dispatch thread died: {e!r}"))
             queue.close(drain=False)  # fails queued futures too
@@ -759,7 +853,7 @@ class ServingEngine:
         # as "queued".
         _flightrec.trigger(
             "serving.restart", engine=self._engine_id, reason=reason,
-            generation=epoch,
+            generation=epoch, mesh=self._mesh_shape(),
             requeued_trace_ids=[r.trace_id for r in requeued])
         # Fresh device state: the old pool's cache is mid-unknown-
         # tick; compiled programs are shared so this is cheap.
@@ -792,7 +886,8 @@ class ServingEngine:
         # incident, and the bundle is the only record of what was in
         # flight when the engine gave up.
         _flightrec.trigger("serving.contain",
-                           engine=self._engine_id, reason=why)
+                           engine=self._engine_id, reason=why,
+                           mesh=self._mesh_shape())
         sched = self.scheduler
         for req in sched.abandon():
             sched._resolve(req.future, exc=EngineClosedError(
@@ -880,6 +975,20 @@ class ServingEngine:
         snap["warmup_compiles"] = ((self.warmup_info or {})
                                    .get("compiles", 0))
         return snap
+
+    @property
+    def mesh_devices(self) -> int:
+        """Devices in the serving mesh (1 = unsharded)."""
+        return (int(self.mesh.devices.size) if self.mesh is not None
+                else 1)
+
+    def _mesh_shape(self):
+        """Non-trivial mesh axes as {axis: size} (None = unsharded) —
+        the stamp /healthz, /metrics.json, and flight-recorder bundles
+        carry; size-1 canonical axes are noise and dropped."""
+        if self.mesh is None:
+            return None
+        return {k: int(v) for k, v in self.mesh.shape.items() if v > 1}
 
     @property
     def num_slots(self) -> int:

@@ -180,6 +180,11 @@ class EngineMetrics:
         self.kv_blocks_used = None
         self.kv_blocks_cached = None
         self.pipeline_depth = 0    # engine config (0 = sync ticks)
+        # Sharded serving (docs/serving.md "Sharded serving"): mesh
+        # width (1 = unsharded) and axis sizes, set once by the
+        # engine; observe_kv fans block occupancy out per shard.
+        self.mesh_devices = 1
+        self.mesh_shape = None
         self.warmup_s = None       # startup precompile cost, if run
         # Latency series (seconds).
         self.queue_wait_s = Series()
@@ -237,6 +242,18 @@ class EngineMetrics:
             if active > self.peak_active:
                 self.peak_active = active
 
+    def observe_mesh(self, devices: int, shape=None):
+        """Record the engine's serving-mesh width (constructor-time,
+        once): the `hvd_serving_mesh_devices` gauge row plus the
+        snapshot fields /metrics.json serves."""
+        with self._lock:
+            self.mesh_devices = max(1, int(devices))
+            self.mesh_shape = dict(shape) if shape else None
+            if self._closed:
+                return
+            self._obs["mesh_devices"].set(self.mesh_devices,
+                                          engine=self._engine_label)
+
     def observe_kv(self, stats: Dict):
         """Fold one paged-pool block-occupancy report into the gauges
         (engine loop cadence; `stats` = `PagedSlotPool.kv_stats()`).
@@ -255,6 +272,20 @@ class EngineMetrics:
                                             engine=eng)
             self._obs["kv_blocks_cached"].set(stats["blocks_cached"],
                                               engine=eng)
+            # Per-shard rows only when actually sharded (the shard
+            # label adds no cardinality to unsharded engines). A host
+            # block id names a mesh-wide shard set, so every shard's
+            # occupancy IS the pool's — emitted per shard so a pod
+            # scrape sees per-device KV without arithmetic.
+            if self.mesh_devices > 1:
+                for i in range(self.mesh_devices):
+                    s = str(i)
+                    self._obs["kv_blocks_free_shard"].set(
+                        stats["blocks_free"], engine=eng, shard=s)
+                    self._obs["kv_blocks_used_shard"].set(
+                        stats["blocks_used"], engine=eng, shard=s)
+                    self._obs["kv_blocks_cached_shard"].set(
+                        stats["blocks_cached"], engine=eng, shard=s)
 
     def observe_gauges(self, queue_depth: int, slots_busy: int,
                        num_slots: int):
@@ -321,8 +352,13 @@ class EngineMetrics:
             for name in ("queue_depth", "slots_busy", "slots_total",
                          "slot_occupancy", "engine_generation",
                          "kv_blocks_free", "kv_blocks_used",
-                         "kv_blocks_cached"):
+                         "kv_blocks_cached", "mesh_devices"):
                 self._obs[name].remove(engine=eng)
+            for i in range(self.mesh_devices):
+                for name in ("kv_blocks_free_shard",
+                             "kv_blocks_used_shard",
+                             "kv_blocks_cached_shard"):
+                    self._obs[name].remove(engine=eng, shard=str(i))
 
     def snapshot(self) -> Dict:
         """One JSON-ready dict: counters, gauges, p50/p95/p99
@@ -351,6 +387,8 @@ class EngineMetrics:
                     round(self.host_syncs / self.tokens_out, 4)
                     if self.tokens_out else None),
                 "pipeline_depth": self.pipeline_depth,
+                "mesh_devices": self.mesh_devices,
+                "mesh": self.mesh_shape,
                 "warmup_s": (round(self.warmup_s, 3)
                              if self.warmup_s is not None else None),
                 "restarts": self.restarts,

@@ -63,9 +63,10 @@ from horovod_tpu.models.transformer import (
     TransformerLM, init_paged_pools, init_slot_cache,
     paged_cache_spec, paged_copy_block, paged_decode_tick,
     paged_prefill_chunk, paged_spec_round, prefill_chunks,
-    slot_decode_model, slot_prefill_advance, slot_reset,
+    shard_paged_pools, shard_slot_cache, slot_decode_model,
+    slot_prefill_advance, slot_reset,
 )
-from horovod_tpu.parallel.mesh import use
+from horovod_tpu.parallel.mesh import replicate, use
 from horovod_tpu.serving.slots import (
     Admission, TickHandle, _first_token, validate_spec_draft,
 )
@@ -548,6 +549,24 @@ class PagedSlotPool:
         self._live = jnp.zeros((num_slots,), bool)
         self._done = jnp.zeros((num_slots,), bool)
         self._free_lanes: List[int] = list(range(num_slots))
+        # Sharded serving (docs/serving.md "Sharded serving"): block
+        # pools commit sharded along the heads axis — each device
+        # holds its head slice of EVERY block, so a host block id
+        # names a mesh-wide block SHARD set and the allocator
+        # (admission math, prefix digests, COW, eviction) runs
+        # unchanged. Block tables and fills stay host-replicated
+        # int32 metadata; one host decision drives all shards.
+        if mesh is not None:
+            self._pools = shard_paged_pools(self._pools, mesh)
+            if self._drf_cache is not None:
+                self._drf_cache = shard_slot_cache(self._drf_cache,
+                                                   mesh)
+            (self._tables, self._fills, self._toks, self._temps,
+             self._top_ps, self._rngs, self._live, self._done,
+             self._eos) = replicate(
+                mesh, (self._tables, self._fills, self._toks,
+                       self._temps, self._top_ps, self._rngs,
+                       self._live, self._done, self._eos))
         # Host-side admission state: what admit() granted, consumed by
         # begin_prefill/finish_prefill; plus a CONSERVATIVE per-lane
         # fill estimate driving the copy-on-write gate (over-estimating

@@ -51,10 +51,11 @@ import jax.numpy as jnp
 from horovod_tpu.annotations import hot_path
 from horovod_tpu.models.transformer import (
     TransformerLM, init_slot_cache, prefill_chunks, sample_token,
-    slot_decode_model, slot_decode_tick, slot_prefill_advance,
-    slot_prefill_chunk, slot_reset, slot_spec_round,
+    shard_slot_cache, slot_decode_model, slot_decode_tick,
+    slot_prefill_advance, slot_prefill_chunk, slot_reset,
+    slot_spec_round,
 )
-from horovod_tpu.parallel.mesh import use
+from horovod_tpu.parallel.mesh import replicate, use
 
 
 def validate_spec_draft(model: TransformerLM, spec_draft,
@@ -199,6 +200,22 @@ class SlotPool:
         self._live = jnp.zeros((num_slots,), bool)
         self._done = jnp.zeros((num_slots,), bool)
         self._free: List[int] = list(range(num_slots))
+        # Sharded serving (docs/serving.md "Sharded serving"): commit
+        # the KV cache sharded along the heads axis and replicate the
+        # per-lane decision vectors across the mesh, so every jitted
+        # slot primitive runs GSPMD-partitioned under `use(mesh)` —
+        # the PROGRAM is unchanged; the sharding enters through the
+        # committed operand layouts. One host decision (slot ids,
+        # sampling state) drives all shards.
+        if mesh is not None:
+            self._cache = shard_slot_cache(self._cache, mesh)
+            if self._drf_cache is not None:
+                self._drf_cache = shard_slot_cache(self._drf_cache,
+                                                   mesh)
+            (self._toks, self._temps, self._top_ps, self._rngs,
+             self._live, self._done, self._eos) = replicate(
+                mesh, (self._toks, self._temps, self._top_ps,
+                       self._rngs, self._live, self._done, self._eos))
         # Compile awareness for the engine watchdog: True while a
         # device call whose shape this pool has not executed before is
         # in flight — a first-time XLA compile can take arbitrarily
