@@ -807,6 +807,106 @@ def _router_ab(model, params, args, prompts, rate, log):
             "fleet_chaos": fleet_chaos}
 
 
+def _disagg_leg(model, params, args, prompts, rate, *, disagg, log,
+                refs=None):
+    """One leg of the --disagg A/B: Poisson arrivals through a router
+    over TWO paged engines — as a plain 2-replica fleet (``disagg=
+    False``, the shared-program baseline) or as a prefill pool +
+    decode pool with KV-block handoffs (``disagg=True``). Equal
+    engine count and equal per-engine KV geometry on both sides, so
+    the columns isolate the PLACEMENT lever. ``refs`` (the baseline
+    leg's streams) pins the bitwise-handoff bit in the artifact."""
+    import numpy as np
+
+    from horovod_tpu.serving import ServingEngine, ServingRouter
+
+    steps, n_req = args.decode_steps, len(prompts)
+    S = args.serving_slots
+    bs = args.serving_kv_block_size
+
+    def factory():
+        return ServingEngine(
+            model, params, num_slots=S, max_queue=2 * n_req,
+            warmup=True, paged=True,
+            kv_blocks=S * args.seq // bs + 1, kv_block_size=bs,
+            pipeline_depth=args.serving_pipeline_depth,
+            prefill_chunk_budget=args.prefill_chunk_budget)
+
+    gaps = np.random.RandomState(7).exponential(1.0 / rate,
+                                                size=n_req)
+    if disagg:
+        router = ServingRouter(factory,
+                               disagg={"prefill": 1, "decode": 1})
+    else:
+        router = ServingRouter(factory, num_replicas=2,
+                               health_poll_s=0.01)
+    t0 = time.time()
+    handles = []
+    try:
+        for i, p in enumerate(prompts):
+            handles.append(router.submit(p, steps, temperature=0.7,
+                                         seed=i))
+            if i < n_req - 1:
+                time.sleep(float(gaps[i]))
+        results = [h.result() for h in handles]
+    finally:
+        snap = router.metrics_snapshot()
+        router.shutdown()
+    dt = time.time() - t0
+    streams = [list(r.tokens) for r in results]
+    ttfts = sorted(r.ttft_s for r in results)
+    tpots = sorted(r.tpot_s for r in results
+                   if r.tpot_s is not None)
+    e2es = sorted(r.e2e_s for r in results)
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)) * 1e3, 3)
+
+    rec = {
+        "disagg": bool(disagg),
+        "engines": 2,
+        "tok_s": round(sum(len(s) for s in streams) / dt, 2),
+        "completed": snap["completed"],
+        "failed": snap["failed"],
+        "ttft_ms_p50": pct(ttfts, 50), "ttft_ms_p95": pct(ttfts, 95),
+        "tpot_ms_p50": pct(tpots, 50), "tpot_ms_p95": pct(tpots, 95),
+        "e2e_ms_p50": pct(e2es, 50), "e2e_ms_p95": pct(e2es, 95),
+        "prefix_tokens_cached": int(sum(r.prefix_tokens_cached
+                                        for r in results)),
+    }
+    if disagg:
+        rec["handoffs"] = snap["disagg"]["handoffs"]
+        rec["fallbacks"] = snap["disagg"]["fallbacks"]
+    if refs is not None:
+        # THE handoff acceptance bit: disagg streams bitwise equal the
+        # shared-program baseline's (same prompts + seeds =>
+        # deterministic decode; the handoff moves WHERE, never WHAT).
+        rec["token_exact_vs_baseline"] = streams == refs
+    label = "disagg" if disagg else "baseline"
+    log(f"disagg leg {label}: {rec['tok_s']} tok/s, ttft p50/p95 "
+        f"{rec['ttft_ms_p50']}/{rec['ttft_ms_p95']} ms, tpot p50 "
+        f"{rec['tpot_ms_p50']} ms"
+        + (f", {rec['handoffs']} handoff(s), {rec['fallbacks']} "
+           f"fallback(s), token-exact="
+           f"{rec.get('token_exact_vs_baseline')}" if disagg else ""))
+    return rec, streams
+
+
+def _disagg_ab(model, params, args, prompts, rate, log):
+    """--serving --disagg: the disaggregated prefill/decode A/B
+    (docs/serving.md "Disaggregated serving") at the highest rate —
+    2 shared-program replicas vs prefill-pool(1) + decode-pool(1)
+    with KV-block handoffs, equal engine count. The headline is TTFT
+    under admission pressure: decode ticks no longer queue behind
+    other requests' prompt chunks."""
+    baseline, b_streams = _disagg_leg(
+        model, params, args, prompts, rate, disagg=False, log=log)
+    disagg, _ = _disagg_leg(
+        model, params, args, prompts, rate, disagg=True, log=log,
+        refs=b_streams)
+    return {"rate": rate, "baseline": baseline, "disagg": disagg}
+
+
 def _serving_trace_check(model, params, args, prompts, log):
     """Observability acceptance evidence: run a few requests with the
     event log, the (Python-writer) Timeline and the shared metric
@@ -1252,6 +1352,14 @@ def run_serving(args, devices, n_chips, log):
         # seeded router.replica_kill chaos) at the highest rate.
         out["router_ab"] = _router_ab(model, params, args, prompts,
                                       max(rates), log)
+    if getattr(args, "disagg", False) and not chaos_mode:
+        if args.seq % args.serving_kv_block_size:
+            raise ValueError(
+                f"--serving-kv-block-size "
+                f"{args.serving_kv_block_size} must divide --seq "
+                f"{args.seq} for the disagg A/B's paged pools")
+        out["disagg_ab"] = _disagg_ab(model, params, args, prompts,
+                                      max(rates), log)
     return out
 
 
@@ -1682,6 +1790,16 @@ def main():
     ap.add_argument("--router-replicas", type=int, default=3,
                     help="serving: fleet width for the --router A/B "
                          "(HVD_ROUTER_REPLICAS parity)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="serving: add the disaggregated prefill/"
+                         "decode A/B at the highest rate — 2 shared-"
+                         "program replicas vs a prefill pool + decode "
+                         "pool with KV-block handoffs (equal engine "
+                         "count, equal paged KV geometry); records "
+                         "TTFT/TPOT per leg, handoff/fallback counts "
+                         "and the bitwise-vs-baseline bit "
+                         "(HVD_DISAGG parity; docs/serving.md "
+                         "'Disaggregated serving')")
     ap.add_argument("--serving-slo",
                     default="ttft=30,tpot=5,shed=0.1,target=0.9,"
                             "fast=5,slow=60,burn=5",
@@ -2245,6 +2363,12 @@ def _bench_body(args, devices, n_chips, metric, unit,
             # router.replica_kill chaos, incl. the token-exact bit.
             result["router_ab"] = r["router_ab"]
             result["router_replicas"] = args.router_replicas
+        if "disagg_ab" in r:
+            # The disaggregated prefill/decode A/B (docs/serving.md
+            # "Disaggregated serving"): shared-program fleet vs
+            # prefill pool + decode pool with KV-block handoffs at
+            # equal engine count, incl. the bitwise-vs-baseline bit.
+            result["disagg_ab"] = r["disagg_ab"]
         _set_best(result)
         emit(_BEST_RESULT)
         write_out(args)

@@ -108,6 +108,18 @@ JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4 \
 JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 3 \
     --sharded-check
 
+# Disaggregated-serving smoke (docs/serving.md "Disaggregated
+# serving"): a prefill pool and a decode pool behind a DisaggRouter —
+# every stream prefills on one engine, hands its KV blocks (digest-
+# verified manifest) to the other, and resumes mid-flight BITWISE the
+# shared-program engine's stream, with the full prompt blocks grafted
+# into the decode pool's prefix cache (only the sub-block tail
+# re-prefills). A chaos-corrupted transfer (disagg.block_corrupt)
+# must be rejected by byte-digest verification and the stream
+# recovered via token-level recompute — still bitwise.
+JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4 \
+    --disagg-check
+
 # Resume smoke (docs/resilience.md "Exact resume"): a short training
 # run over a sharded shuffled dataset is killed mid-epoch AND
 # mid-checkpoint-save via HVD_CHAOS, restarted with full TrainSnapshot

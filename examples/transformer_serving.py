@@ -421,6 +421,91 @@ def failover_check(model, params, n_requests=6, replicas=3):
         router.shutdown()
 
 
+def disagg_check(model, params, n_requests=4):
+    """The disaggregated-serving smoke (docs/serving.md
+    "Disaggregated serving"): a prefill pool and a decode pool behind
+    a `DisaggRouter`, KV blocks migrating between them at prefill-
+    complete. Every stream must be BITWISE a single shared-program
+    engine's, every handoff must actually graft the full prompt
+    blocks into the decode pool (the decode side re-prefills only the
+    sub-block tail), and a chaos-corrupted transfer
+    (``disagg.block_corrupt``) must be rejected by digest
+    verification and recovered via recompute — still bitwise."""
+    import time
+
+    from horovod_tpu.resilience import chaos
+    from horovod_tpu.serving import DisaggRouter, ServingEngine, \
+        ServingRouter
+
+    del time
+    bs = 8
+    rs = np.random.RandomState(9)
+    # Two FULL KV blocks plus a tail, so every handoff has an
+    # exportable manifest.
+    prompts = [rs.randint(0, 128, (2 * bs + 2,))
+               for _ in range(n_requests + 1)]
+    steps = 12
+    seeds = list(range(n_requests + 1))
+    with ServingEngine(model, params, num_slots=2, paged=True,
+                       kv_block_size=bs,
+                       max_queue=2 * n_requests + 2) as eng:
+        refs = [list(h.result(timeout=600).tokens) for h in
+                [eng.submit(p, steps, temperature=0.7, seed=s)
+                 for p, s in zip(prompts, seeds)]]
+    # The last (prompt, seed, ref) is reserved for the corruption
+    # drill: its blocks must not already be cached in the decode pool
+    # by an earlier identical request.
+    (prompts, drill_prompt) = (prompts[:-1], prompts[-1])
+    (refs, drill_ref) = (refs[:-1], refs[-1])
+    (seeds, drill_seed) = (seeds[:-1], seeds[-1])
+
+    def factory():
+        return ServingEngine(model, params, num_slots=2, paged=True,
+                             kv_block_size=bs,
+                             max_queue=2 * n_requests)
+
+    router = ServingRouter(factory,
+                           disagg={"prefill": 1, "decode": 1})
+    assert isinstance(router, DisaggRouter), type(router)
+    try:
+        handles = [router.submit(p, steps, temperature=0.7, seed=s)
+                   for p, s in zip(prompts, seeds)]
+        results = [h.result(timeout=600) for h in handles]
+        for r, ref in zip(results, refs):
+            assert list(r.tokens) == ref, (
+                "disaggregated stream diverged from the single-"
+                "engine reference", list(r.tokens), ref)
+            assert r.prefix_tokens_cached == 2 * bs, (
+                "handoff did not graft the full prompt blocks",
+                r.prefix_tokens_cached)
+        snap = router.metrics_snapshot()
+        assert snap["completed"] == n_requests, snap
+        assert snap["disagg"]["handoffs"] == n_requests, snap
+        assert snap["disagg"]["fallbacks"] == 0, snap
+        # The corruption drill: one transferred block's bytes flip in
+        # flight; the byte digest rejects the graft, the stream
+        # recomputes its prompt on the decode side, bitwise anyway.
+        with chaos.armed("disagg.block_corrupt:1") as monkey:
+            r = router.submit(drill_prompt, steps, temperature=0.7,
+                              seed=drill_seed).result(timeout=600)
+        assert monkey.fired("disagg.block_corrupt") == 1, (
+            "the corruption site never fired")
+        assert list(r.tokens) == drill_ref, (
+            "stream diverged across a corrupted transfer",
+            list(r.tokens), drill_ref)
+        assert r.prefix_tokens_cached == 0, (
+            "a corrupted transfer must graft NOTHING",
+            r.prefix_tokens_cached)
+        print(f"disagg check OK: {n_requests} streams prefilled on "
+              f"one pool, decoded on another, bitwise the shared-"
+              f"program run ({snap['disagg']['handoffs']} KV-block "
+              f"handoffs, {2 * bs} prompt tokens grafted each); "
+              f"corrupted transfer rejected by digest verify and "
+              f"recovered bitwise")
+    finally:
+        router.shutdown()
+
+
 def spec_check(model, params, prompts, max_new):
     """The decode-fast-path smoke (docs/serving.md "Decode fast
     path"): the SAME greedy workload through a plain engine and a
@@ -600,6 +685,13 @@ def main():
                          "streams, and a mixed sharded/unsharded "
                          "fleet survives a replica kill token-exactly "
                          "(docs/serving.md 'Sharded serving')")
+    ap.add_argument("--disagg-check", action="store_true",
+                    help="disaggregated-serving smoke: prefill pool "
+                         "-> KV-block handoff -> decode pool, streams "
+                         "bitwise the shared-program engine, and a "
+                         "chaos-corrupted transfer rejected + "
+                         "recovered (docs/serving.md 'Disaggregated "
+                         "serving')")
     ap.add_argument("--spec-check", action="store_true",
                     help="decode-fast-path smoke: a speculative "
                          "(self-draft) engine's greedy streams must "
@@ -673,6 +765,8 @@ def main():
         sharded_check(model, params, prompts, args.max_new_tokens)
     if args.failover_check:
         failover_check(model, params, n_requests=max(args.requests, 4))
+    if args.disagg_check:
+        disagg_check(model, params, n_requests=max(args.requests, 4))
 
 
 if __name__ == "__main__":

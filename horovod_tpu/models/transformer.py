@@ -1057,6 +1057,17 @@ def shard_paged_pools(pools, mesh, axis: Optional[str] = None):
         for p in pools]
 
 
+def gather_block_rows(pools, block_ids):
+    """Pull the [len(block_ids), 1, block_size, ...] rows of every
+    pool leaf for a block-id list (KV-block export: the per-leaf
+    device buffers a prefill pool hands to a decode pool). Plain
+    fancy-index gather — stays on device; callers decide whether to
+    bounce through the host (`np.asarray`) or `device_put` straight
+    into the destination layout."""
+    idx = jnp.asarray(block_ids, jnp.int32)
+    return [p[idx] for p in pools]
+
+
 @functools.partial(jax.jit, static_argnames=("dec_model",),
                    donate_argnums=(1,))
 def slot_reset(dec_model, cache, slot):

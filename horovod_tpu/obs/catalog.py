@@ -422,6 +422,46 @@ def event_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
     }
 
 
+def disagg_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """Disaggregated serving (docs/serving.md "Disaggregated
+    serving"): KV-block transfers between prefill and decode pools,
+    the digest-verify outcomes, the fallback ladder, and the handoff
+    latency from prefill-complete to decode-pool admission."""
+    reg = reg or registry()
+    return {
+        "transfers": reg.counter(
+            "hvd_disagg_transfers_total",
+            "KV-block transfers between pools by outcome (exported, "
+            "ingested, rejected, export_failed)", ("outcome",)),
+        "blocks": reg.counter(
+            "hvd_disagg_blocks_total",
+            "KV blocks newly adopted into a destination pool's "
+            "prefix cache via transfer ingest"),
+        "bytes": reg.counter(
+            "hvd_disagg_bytes_total",
+            "KV bytes shipped in accepted block transfers"),
+        "verify_failures": reg.counter(
+            "hvd_disagg_verify_failures_total",
+            "Transfers rejected on ingest: chain/byte digest "
+            "mismatch or incompatible geometry (each one falls back "
+            "to token-level recompute)"),
+        "fallbacks": reg.counter(
+            "hvd_disagg_fallbacks_total",
+            "Handoffs that degraded to PR 9's token-level "
+            "forced-prefix recompute, by reason (prefill_failed, "
+            "export_failed, verify_failed, no_prefill_capacity)",
+            ("reason",)),
+        "handoffs": reg.counter(
+            "hvd_disagg_handoffs_total",
+            "Prefill->decode handoffs the DisaggRouter completed "
+            "(the request resumed on a decode replica)"),
+        "handoff": reg.histogram(
+            "hvd_disagg_handoff_seconds",
+            "Prefill-complete to decode-pool submit latency (the "
+            "disaggregation seam's own cost)"),
+    }
+
+
 def declare_standard_metrics(
         reg: Optional[MetricRegistry] = None) -> Dict[str, Dict]:
     """Idempotently declare every standard family; the exporter calls
@@ -435,6 +475,7 @@ def declare_standard_metrics(
         "detector": detector_metrics(reg),
         "training": training_metrics(reg),
         "collectives": collective_metrics(reg),
+        "disagg": disagg_metrics(reg),
         "slo": slo_metrics(reg),
         "flightrec": flight_metrics(reg),
         "events": event_metrics(reg),

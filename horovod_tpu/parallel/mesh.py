@@ -234,6 +234,19 @@ def _place(x, sh: NamedSharding):
     return jax.device_put(x, sh)
 
 
+def put_like(x, ref):
+    """Commit ``x`` onto ``ref``'s sharding (cross-pool KV-block
+    transfer ingest: a block row exported from one engine's pool —
+    possibly a different mesh, possibly host-resident — re-enters
+    under the DESTINATION pool's committed layout). NamedSharding
+    applies shape-agnostically as long as the sharded dims divide, so
+    the same helper covers host-bounce and device-to-device rows."""
+    sh = getattr(ref, "sharding", None)
+    if not isinstance(sh, NamedSharding):
+        return jax.device_put(x)
+    return _place(x, sh)
+
+
 def shard_batch(mesh: Mesh, batch,
                 axes: Sequence[str] = (AXIS_DATA,)):
     """Place a host batch onto the mesh, dim 0 split over `axes`.

@@ -325,6 +325,11 @@ _SITE_DOCS: Dict[str, str] = {
                     "minority member must adopt the commit that "
                     "excludes it and exit MembershipError, never "
                     "split-brain at the old generation",
+    "disagg.block_corrupt": "a transferred KV block's bytes flip in "
+                            "flight (prefill->decode handoff) — the "
+                            "byte-digest verify must reject the "
+                            "graft and the stream fall back to "
+                            "token-level recompute, bitwise-exact",
 }
 
 _SITE_CALL_RE = (r'(?:chaos\s*\.\s*)?(?:fires|slow_site)\(\s*'

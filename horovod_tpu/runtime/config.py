@@ -53,6 +53,11 @@ DEFAULT_RETRY_BUDGET = 16
 # Serving decode fast path (docs/serving.md "Decode fast path"):
 # speculative-decode proposals per round (the draft-verify depth).
 DEFAULT_SPEC_K = 4
+# Disaggregated serving (docs/serving.md "Disaggregated serving"):
+# prefill/decode pool widths and the KV-block transfer mode.
+DEFAULT_DISAGG_PREFILL = 1
+DEFAULT_DISAGG_DECODE = 1
+DEFAULT_DISAGG_TRANSFER = "host"
 
 
 # ---------------------------------------------------------------------------
@@ -403,6 +408,30 @@ register_knob(
     "Serving fleet: router retry-budget token-bucket capacity for "
     "shed/failed submits (refills at capacity/60 per second; 0 "
     "disables retries — first answer wins)")
+register_knob(
+    "HVD_DISAGG", "flag", "0",
+    "serving/disagg.py",
+    "Disaggregated serving: 1 makes ServingRouter construct a "
+    "DisaggRouter — requests prefill on a dedicated pool, migrate "
+    "their KV blocks to a decode pool at prefill-complete "
+    "(docs/serving.md \"Disaggregated serving\")")
+register_knob(
+    "HVD_DISAGG_PREFILL", "int", str(DEFAULT_DISAGG_PREFILL),
+    "serving/disagg.py",
+    "Disaggregated serving: prefill-pool replica count (sized "
+    "independently of the decode pool — the MPMD split's point)")
+register_knob(
+    "HVD_DISAGG_DECODE", "int", str(DEFAULT_DISAGG_DECODE),
+    "serving/disagg.py",
+    "Disaggregated serving: decode-pool replica count (the base "
+    "router fleet; HVD_ROUTER_REPLICAS is ignored when disagg is "
+    "on)")
+register_knob(
+    "HVD_DISAGG_TRANSFER", "str", DEFAULT_DISAGG_TRANSFER,
+    "serving/transfer.py",
+    "KV-block transfer mode between pools: 'host' bounces rows "
+    "through host memory (any layout pair), 'device' keeps them "
+    "device-resident and device_puts into the destination layout")
 
 
 # ---------------------------------------------------------------------------
@@ -445,6 +474,13 @@ class Config:
     router_replacements: int = DEFAULT_ROUTER_REPLACEMENTS
     hedge_quantile: float = DEFAULT_HEDGE_QUANTILE
     retry_budget: int = DEFAULT_RETRY_BUDGET
+    # Disaggregated serving (docs/serving.md "Disaggregated
+    # serving"): the DisaggRouter switch, the independent pool
+    # widths, and the KV-block transfer mode.
+    disagg: int = 0
+    disagg_prefill: int = DEFAULT_DISAGG_PREFILL
+    disagg_decode: int = DEFAULT_DISAGG_DECODE
+    disagg_transfer: str = DEFAULT_DISAGG_TRANSFER
     # TPU-specific additions
     allreduce_dtype: str = ""          # e.g. "bfloat16" to reduce in bf16
     mesh_axis_name: str = "data"       # default 1-D data-parallel axis
@@ -490,6 +526,13 @@ class Config:
                                          DEFAULT_HEDGE_QUANTILE)
         self.retry_budget = _env_int("HVD_RETRY_BUDGET",
                                      DEFAULT_RETRY_BUDGET)
+        self.disagg = _env_int("HVD_DISAGG", 0)
+        self.disagg_prefill = _env_int("HVD_DISAGG_PREFILL",
+                                       DEFAULT_DISAGG_PREFILL)
+        self.disagg_decode = _env_int("HVD_DISAGG_DECODE",
+                                      DEFAULT_DISAGG_DECODE)
+        self.disagg_transfer = env_str("HVD_DISAGG_TRANSFER",
+                                       DEFAULT_DISAGG_TRANSFER)
         self.timeline_path = env_str("HOROVOD_TIMELINE")
         self.stall_warning_time = _env_float(
             "HOROVOD_STALL_CHECK_TIME", DEFAULT_STALL_WARNING_TIME)

@@ -20,6 +20,12 @@ The layer that turns concurrent requests into batched device work:
   health-gated + load-aware routing, retry budgets, hedged
   slow-starters, and token-exact migration of in-flight streams off
   dead replicas (docs/serving.md "Fleet failover").
+* `disagg.DisaggRouter` / `transfer` — disaggregated prefill/decode
+  placement: a dedicated prefill pool runs prompts, the KV blocks
+  themselves migrate (digest-verified) into the decode pool's prefix
+  cache, and the stream resumes mid-flight bitwise-exact
+  (``ServingRouter(disagg=...)`` / ``HVD_DISAGG=1``; docs/serving.md
+  "Disaggregated serving").
 * `admission` — bounded queue, deadlines, cancellation, load shedding
   (degrade by shedding, never by hanging).
 * `metrics` — TTFT/TPOT/tokens-per-second with p50/p95, queue depth,
@@ -32,6 +38,7 @@ from horovod_tpu.serving.admission import (
     AdmissionQueue, DeadlineExceededError, EngineClosedError,
     QueueFullError, SamplingParams, ServingError,
 )
+from horovod_tpu.serving.disagg import DisaggRouter
 from horovod_tpu.serving.engine import RequestHandle, ServingEngine
 from horovod_tpu.serving.metrics import EngineMetrics
 from horovod_tpu.serving.paging import BlockPool, PagedSlotPool
@@ -42,6 +49,11 @@ from horovod_tpu.serving.scheduler import (
     CompletedRequest, ContinuousBatchingScheduler,
 )
 from horovod_tpu.serving.slots import Admission, SlotPool
+from horovod_tpu.serving.transfer import (
+    BlockTransfer, TransferCompatError, TransferError,
+    TransferExportError, TransferVerifyError, export_blocks,
+    ingest_blocks,
+)
 
 __all__ = [
     "ServingEngine", "RequestHandle", "CompletedRequest",
@@ -50,4 +62,7 @@ __all__ = [
     "QueueFullError", "DeadlineExceededError", "EngineClosedError",
     "Admission", "BlockPool", "PagedSlotPool",
     "ServingRouter", "RouterHandle", "RetryBudget",
+    "DisaggRouter", "BlockTransfer", "TransferError",
+    "TransferExportError", "TransferCompatError",
+    "TransferVerifyError", "export_blocks", "ingest_blocks",
 ]

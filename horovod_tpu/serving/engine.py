@@ -34,6 +34,7 @@ in chrome://tracing.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import itertools
 import sys
@@ -429,11 +430,17 @@ class ServingEngine:
         self._compile_baseline = self.pool.compiles
         self.metrics.observe_pipeline(self.pipeline_depth)
         self.queue = AdmissionQueue(max_queue)
+        # Disaggregated serving inbox (serving/transfer.py): inbound
+        # KV-block transfers, appended by `offer_transfer` from any
+        # thread, drained on the dispatch thread. Survives watchdog
+        # restarts — the replacement scheduler inherits the deque, so
+        # an offer in flight across a restart still grafts.
+        self._grafts: "collections.deque" = collections.deque()
         self.scheduler = ContinuousBatchingScheduler(
             self.pool, self.queue, self.metrics, eos_id=eos_id,
             stall=self.stall,
             prefill_chunk_budget=self.prefill_chunk_budget,
-            pipeline_depth=self.pipeline_depth)
+            pipeline_depth=self.pipeline_depth, grafts=self._grafts)
         self._ids = itertools.count()
         self._lock = threading.Lock()
         self._closing = False
@@ -662,6 +669,20 @@ class ServingEngine:
                      prompt_tokens=P, max_new_tokens=max_new_tokens)
         return RequestHandle(req)
 
+    def offer_transfer(self, transfer) -> bool:
+        """Enqueue an inbound KV-block transfer (serving/transfer.py)
+        for ingest on the dispatch thread. Callable from any thread
+        (deque append is atomic); the scheduler drains the inbox
+        before every admission peek, so an offer made BEFORE the
+        submit it accelerates is grafted before that request's prompt
+        is matched. False when this engine cannot ingest (non-paged
+        pool, or closing) — the caller's submit still works, it just
+        re-prefills (the fallback ladder)."""
+        if transfer is None or not self.paged or self._closing:
+            return False
+        self._grafts.append(transfer)
+        return True
+
     # -- dispatch side ------------------------------------------------
 
     def _dispatch_loop(self, epoch: int,
@@ -862,7 +883,7 @@ class ServingEngine:
             self.pool, self.queue, self.metrics, eos_id=self.eos_id,
             stall=self.stall,
             prefill_chunk_budget=self.prefill_chunk_budget,
-            pipeline_depth=self.pipeline_depth)
+            pipeline_depth=self.pipeline_depth, grafts=self._grafts)
         with self._lock:
             self._heartbeat = time.time()
             self._thread = threading.Thread(

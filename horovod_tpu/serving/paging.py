@@ -359,6 +359,32 @@ class BlockPool:
                 self._hash_of[bid] = h
                 self._epoch += 1
 
+    def chain_digests(self, tokens, nblocks: int) -> List[bytes]:
+        """Public chain-digest accessor (the transfer layer's
+        manifest identity; see serving/transfer.py)."""
+        return self._chain(tokens, nblocks)
+
+    def adopt(self, digest: bytes) -> Optional[int]:
+        """Register a FOREIGN block under ``digest`` as a refcount-0
+        LRU-resident cached block — the ingest half of a KV-block
+        transfer (serving/transfer.py). The caller scatters the
+        block's device bytes into the returned id; from then on it is
+        indistinguishable from a locally published prefix block: an
+        admission `match` pins it, eviction reclaims it oldest-first.
+        Returns None when the digest is already resident (idempotent
+        ingest) or when no block can be claimed without eviction
+        pressure the caller should not pay (full pool, empty LRU)."""
+        if not self.prefix_cache or digest in self._cache:
+            return None
+        if not self._free and not self._lru:
+            return None
+        bid = self._free.pop() if self._free else self._evict_one()
+        self._cache[digest] = bid
+        self._hash_of[bid] = digest
+        self._lru[bid] = digest
+        self._epoch += 1
+        return bid
+
     def fork(self, src: int, dst: int):
         """Share ``src``'s whole chain with a new sequence ``dst``
         (n-best sampling / speculative branches): every block gains a
@@ -821,6 +847,17 @@ class PagedSlotPool:
             off += c
         return self.finish_prefill(slot, logits, temperature, top_p,
                                    seed)
+
+    def graft(self, transfer) -> int:
+        """Ingest a `BlockTransfer` into this pool's prefix cache
+        (serving/transfer.py `ingest_blocks`): verify digests, adopt
+        the blocks under fresh ids, scatter the rows. Dispatch-thread
+        only, like every other pool mutation. Returns blocks newly
+        adopted; raises `TransferError` on any verification failure
+        (the pool is left untouched — callers fall back to
+        token-level recompute)."""
+        from horovod_tpu.serving.transfer import ingest_blocks
+        return ingest_blocks(self, transfer)
 
     def fork(self, slot: int) -> Optional[int]:
         """Clone lane ``slot`` into a fresh lane sharing its ENTIRE

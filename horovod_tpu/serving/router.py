@@ -255,7 +255,28 @@ class ServingRouter:
     max_replacements : cold replacements (death or drain) the router
         may build; None reads ``HVD_ROUTER_REPLACEMENTS``.
     backoff_s : base of the jittered exponential retry backoff.
+    disagg : disaggregated prefill/decode placement (docs/serving.md
+        "Disaggregated serving"). Truthy — True, a prefill-pool
+        width, or a dict with ``prefill``/``decode``/``transfer``/
+        ``prefill_factory`` keys — constructs a `DisaggRouter`
+        instead (so does ``HVD_DISAGG=1`` when the argument is left
+        None). The base router accepts and ignores it.
     """
+
+    def __new__(cls, *args, disagg=None, **kwargs):
+        # `ServingRouter(disagg=...)` — or HVD_DISAGG=1 — quietly
+        # builds the disaggregated subclass: type.__call__ invokes
+        # type(obj).__init__ since isinstance(obj, cls) holds, so the
+        # caller's arguments reach DisaggRouter.__init__ unchanged.
+        if cls is ServingRouter:
+            want = disagg
+            if want is None:
+                from horovod_tpu.runtime.config import config as _cfg
+                want = getattr(_cfg, "disagg", 0)
+            if want:
+                from horovod_tpu.serving.disagg import DisaggRouter
+                return super().__new__(DisaggRouter)
+        return super().__new__(cls)
 
     def __init__(self, factory: Callable[[], object],
                  num_replicas: Optional[int] = None, *,
@@ -263,7 +284,8 @@ class ServingRouter:
                  hedge_quantile: Optional[float] = None,
                  health_poll_s: Optional[float] = None,
                  max_replacements: Optional[int] = None,
-                 backoff_s: float = 0.005):
+                 backoff_s: float = 0.005, disagg=None):
+        del disagg   # consumed by __new__ / DisaggRouter.__init__
         from horovod_tpu.runtime.config import config as _cfg
         if num_replicas is None:
             num_replicas = _cfg.router_replicas
@@ -563,6 +585,11 @@ class ServingRouter:
                     f"request {rr.id}: deadline passed during "
                     f"placement ({len(forced)} tokens in)",
                     partial_tokens=list(forced))
+            # Placement hook (DisaggRouter): runs BEFORE the submit so
+            # anything it enqueues on the engine — a KV-block transfer
+            # offer — is drained by the scheduler before this
+            # request's admission peek.
+            self._pre_place(rr, rep)
             try:
                 handle = rep.engine.submit(
                     rr.prompt, rr.max_new_tokens,
@@ -596,6 +623,12 @@ class ServingRouter:
                 lambda fut, rr=rr, a=attempt: self._attempt_done(
                     rr, a, fut))
             return None
+
+    def _pre_place(self, rr: _RouterRequest, rep: "_Replica"):
+        """Subclass hook, called just before each engine submit of
+        ``rr`` on ``rep`` (see `DisaggRouter`: this is where a
+        prefill-pool KV-block transfer is offered to the decode
+        engine, and re-offered on every migration re-placement)."""
 
     # -- attempt resolution (engine callback threads) ------------------
 

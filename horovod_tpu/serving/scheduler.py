@@ -161,10 +161,18 @@ class ContinuousBatchingScheduler:
                  metrics: EngineMetrics, *,
                  eos_id: Optional[int] = None, stall=None,
                  prefill_chunk_budget: Optional[int] = None,
-                 pipeline_depth: int = 1):
+                 pipeline_depth: int = 1, grafts=None):
         self.pool = pool
         self.queue = queue
         self.metrics = metrics
+        # Disaggregated serving (serving/transfer.py): a deque of
+        # inbound `BlockTransfer`s the engine's `offer_transfer`
+        # appends from ANY thread (GIL-atomic append; all jax work
+        # stays here on the dispatch thread). Drained at the top of
+        # every step AND just before each admission peek — an offer
+        # that lands before the submit it accelerates is therefore
+        # grafted before the request's prompt is matched.
+        self._grafts = grafts
         self.eos_id = eos_id
         self.stall = stall           # optional utils.stall.StallMonitor
         if prefill_chunk_budget is None:
@@ -255,6 +263,7 @@ class ContinuousBatchingScheduler:
         # never pops the queue, and a 100 ms deadline must not wait
         # minutes for a slot to free.
         self.queue.sweep(now, on_drop=self._queue_drop)
+        self._drain_grafts()
         progressed = self._advance_prefills(now)
         if getattr(self.pool, "spec_on", False):
             # Speculative mode replaces the pipelined S=1 tick ring
@@ -417,6 +426,12 @@ class ContinuousBatchingScheduler:
                     slot = self._prefill_order[0]
                     job = self.prefilling[slot]
             if job is None:
+                # Graft inbound KV-block transfers BEFORE the peek:
+                # an offer enqueued before its request's submit (the
+                # disagg router's ordering) is then resident when the
+                # admission below hashes the prompt — the handoff's
+                # whole point.
+                self._drain_grafts()
                 # PEEK first: admission gates on the POOL's capacity —
                 # free lanes for both pools, and block availability
                 # (after prefix-cache credit) on the paged pool. A
@@ -516,6 +531,42 @@ class ContinuousBatchingScheduler:
             if left is not None and left <= 0:
                 break
         return progressed
+
+    def _drain_grafts(self):
+        """Ingest every queued KV-block transfer into the pool's
+        prefix cache (disaggregated serving; serving/transfer.py). A
+        transfer that fails verification is dropped LOUDLY — counter +
+        event — and the request it was meant to accelerate simply
+        re-prefills its prompt through the normal path, bitwise the
+        same stream (the fallback ladder)."""
+        q = self._grafts
+        if not q or getattr(self.pool, "graft", None) is None:
+            return
+        from horovod_tpu.obs import catalog as _obs_catalog
+        from horovod_tpu.serving.transfer import TransferError
+        cat = _obs_catalog.disagg_metrics()
+        while q:
+            try:
+                tr = q.popleft()
+            except IndexError:   # pragma: no cover — single drainer
+                break
+            try:
+                adopted = self.pool.graft(tr)
+            except TransferError as e:
+                reason = type(e).__name__
+                cat["transfers"].inc(outcome="rejected")
+                cat["verify_failures"].inc()
+                cat["fallbacks"].inc(reason="verify_failed")
+                _events.emit("disagg.transfer_rejected",
+                             trace_id=tr.trace_id, error=str(e),
+                             error_kind=reason)
+                continue
+            cat["transfers"].inc(outcome="ingested")
+            cat["blocks"].inc(adopted)
+            cat["bytes"].inc(tr.nbytes)
+            _events.emit("disagg.transfer_ingested",
+                         trace_id=tr.trace_id, blocks=adopted,
+                         bytes=tr.nbytes)
 
     def _finish_prefill(self, slot: int, job: _PrefillJob):
         """Chunk schedule drained: sample the first token (the one

@@ -1,0 +1,295 @@
+"""KV-block transfer between paged pools (disaggregated serving).
+
+Disaggregated prefill/decode (docs/serving.md "Disaggregated
+serving") splits the serving workload MPMD-style: a PREFILL pool runs
+the compute-bound prompt pass, a DECODE pool runs the
+bandwidth-bound token loop, and the thing that crosses between them
+is the KV cache itself — the filled blocks of the finished prefill —
+not the tokens. This module is the wire format and the two
+primitives:
+
+* **`export_blocks`** — pull a finished prompt's FULL block rows out
+  of a source `PagedSlotPool` as host (or device-resident) buffers,
+  stamped with two digest layers: PR 7's blake2b *chain* digests
+  (each block's identity commits to the entire token prefix behind
+  it) and per-block *byte* digests over the exported KV rows
+  themselves, bound to the chain (content x position).
+* **`ingest_blocks`** — verify both layers against the manifest and
+  graft the rows into a DESTINATION pool's `BlockPool` as
+  refcount-0 LRU-resident cached blocks (fresh block ids; the
+  destination's own allocator owns them from the first instant).
+  Pools may sit on different meshes: rows re-commit under the
+  destination's `safe_spec` layouts (`put_like` / the pool's
+  `shard_paged_pools` re-commit), so sharded -> unsharded, 2 -> 4
+  device and every other layout pair ingest identically.
+
+The graft deliberately lands in the PREFIX CACHE, not in a live
+lane: the decode engine then admits the request through its ordinary
+front door with the prefill's first sampled token as a one-token
+forced prefix, `BlockPool.match` hits the grafted chain, and prefill
+covers only the sub-block prompt tail — composing two properties the
+test suite already pins bitwise (prefix-cache hits and forced-prefix
+continuation) instead of inventing a third resume path.
+
+Any failure — geometry mismatch, digest mismatch (the
+`disagg.block_corrupt` chaos site flips a byte here), an export that
+raced the allocator — raises a typed `TransferError`; callers fall
+back to PR 9's token-level forced-prefix recompute, loudly (counter +
+event), and the stream stays bitwise-exact either way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+import jax
+
+from horovod_tpu.models.transformer import gather_block_rows
+from horovod_tpu.parallel.mesh import put_like
+from horovod_tpu.resilience import chaos
+from horovod_tpu.serving.admission import ServingError
+
+_DIGEST_SIZE = 16
+_EXPORT_RETRIES = 5
+
+
+class TransferError(ServingError):
+    """A KV-block transfer could not be completed; callers fall back
+    to token-level forced-prefix recompute."""
+
+
+class TransferExportError(TransferError):
+    """Export raced the source allocator past its retry budget."""
+
+
+class TransferCompatError(TransferError):
+    """Destination pool geometry/dtype does not match the manifest."""
+
+
+class TransferVerifyError(TransferError):
+    """Digest verification failed on ingest — the bytes on the wire
+    are not the bytes the manifest committed to."""
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockTransfer:
+    """One prefill->decode handoff manifest: the prompt it came from,
+    the block rows (one stacked [n, 1, block_size, ...] array per
+    cache leaf), and the two digest layers binding them together.
+
+    ``rows`` are numpy in ``"host"`` mode (bounced through the host —
+    always works, any layout pair) or jax Arrays in ``"device"`` mode
+    (gathered on the source mesh; ingest `device_put`s them into the
+    destination layout). Everything else is host metadata.
+    """
+
+    prompt: np.ndarray                       # int64 [P]
+    emitted: Tuple[int, ...]                 # tokens prefill sampled
+    block_size: int
+    chain_digests: Tuple[bytes, ...]         # PR 7 prefix chain
+    byte_digests: Tuple[bytes, ...]          # KV-row content x chain
+    rows: List                               # per-leaf [n, 1, bs, ...]
+    kv_shapes: Tuple[Tuple[int, ...], ...]   # per-leaf row shape [1:]
+    kv_dtypes: Tuple[str, ...]
+    mode: str = "host"
+    trace_id: str = ""
+    t_export: float = 0.0
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.chain_digests)
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(r.nbytes for r in self.rows))
+
+
+def _byte_digest(leaf_rows: List[np.ndarray], chain: bytes) -> bytes:
+    """Content digest of ONE transferred block: the block's row bytes
+    from every cache leaf, bound to its chain digest — so a row that
+    is valid KV for some OTHER prefix position still fails verify."""
+    h = hashlib.blake2b(digest_size=_DIGEST_SIZE)
+    for r in leaf_rows:
+        h.update(np.ascontiguousarray(r).tobytes())
+    h.update(chain)
+    return h.digest()
+
+
+def export_blocks(pool, prompt, emitted=(), *, mode: str = "host",
+                  trace_id: str = "") -> Optional[BlockTransfer]:
+    """Extract ``prompt``'s full resident prefix blocks from a
+    `PagedSlotPool` as a `BlockTransfer`, or None when there is
+    nothing worth shipping (non-paged pool, prefix cache off, prompt
+    shorter than one block, or no blocks resident).
+
+    Runs on the SOURCE engine's dispatch thread (or after it has
+    quiesced): the rows are read behind an epoch check — the
+    allocator's `_epoch` is recorded before the digest lookup and
+    re-checked after the host read, and a bump in between (an evict
+    recycling one of our blocks mid-gather) retries the whole export.
+    Epoch-stable implies content-stable: every allocator mutation
+    bumps `_epoch`, and committed jax arrays are immutable.
+    """
+    if mode not in ("host", "device"):
+        raise ValueError(
+            f"transfer mode must be host|device, got {mode!r}")
+    blocks = getattr(pool, "blocks", None)
+    if blocks is None or not getattr(blocks, "prefix_cache", False):
+        return None
+    bs = pool.block_size
+    # hvd: disable=HVD001(prompt is host-side tokens from the router, never a device array — no sync)
+    prompt = np.ascontiguousarray(np.asarray(prompt, np.int64))
+    n = len(prompt) // bs
+    if n == 0:
+        return None
+    chain = blocks._chain(prompt, n)
+    for _ in range(_EXPORT_RETRIES):
+        epoch = blocks._epoch
+        bids = []
+        for h in chain:
+            bid = blocks._cache.get(h)
+            if bid is None:
+                break
+            bids.append(bid)
+        if not bids:
+            return None
+        with pool._ctx():
+            dev_rows = gather_block_rows(pool._pools, bids)
+        if mode == "host":
+            # hvd: disable=HVD001(the transfer's designed host bounce — export runs off the decode tick ring, once per handoff)
+            rows = [np.asarray(r) for r in dev_rows]
+        else:
+            rows = [r for r in dev_rows]
+            jax.block_until_ready(rows)  # hvd: disable=HVD001(materialize before the epoch re-check — once per handoff, off the tick ring)
+        if blocks._epoch != epoch:
+            continue   # an evict/alloc raced the gather — retry
+        m = len(bids)
+        if mode == "host":
+            host_rows = rows
+        else:
+            # hvd: disable=HVD001(digest wants host bytes; rows are already ready — once per handoff)
+            host_rows = [np.asarray(r) for r in rows]
+        byte_digests = tuple(
+            _byte_digest([hr[i] for hr in host_rows], chain[i])
+            for i in range(m))
+        return BlockTransfer(
+            prompt=prompt, emitted=tuple(int(t) for t in emitted),
+            block_size=bs, chain_digests=tuple(chain[:m]),
+            byte_digests=byte_digests, rows=rows,
+            kv_shapes=tuple(tuple(r.shape[1:]) for r in rows),
+            kv_dtypes=tuple(str(np.dtype(r.dtype)) for r in rows),
+            mode=mode, trace_id=trace_id, t_export=time.time())
+    raise TransferExportError(
+        f"block export raced the allocator {_EXPORT_RETRIES} times "
+        f"(pool under eviction pressure)")
+
+
+def _check_compat(pool, tr: BlockTransfer):
+    if tr.block_size != pool.block_size:
+        raise TransferCompatError(
+            f"block_size mismatch: transfer {tr.block_size}, "
+            f"destination {pool.block_size}")
+    if len(tr.rows) != len(pool._pools):
+        raise TransferCompatError(
+            f"cache leaf count mismatch: transfer {len(tr.rows)}, "
+            f"destination {len(pool._pools)}")
+    for k, (r, p) in enumerate(zip(tr.rows, pool._pools)):
+        if tuple(r.shape[1:]) != tuple(p.shape[1:]):
+            raise TransferCompatError(
+                f"leaf {k} row shape mismatch: transfer "
+                f"{tuple(r.shape[1:])}, destination "
+                f"{tuple(p.shape[1:])}")
+        if np.dtype(r.dtype) != np.dtype(p.dtype):
+            raise TransferCompatError(
+                f"leaf {k} dtype mismatch: transfer {r.dtype}, "
+                f"destination {p.dtype}")
+
+
+def ingest_blocks(pool, tr: BlockTransfer) -> int:
+    """Verify ``tr`` and graft its blocks into ``pool``'s prefix
+    cache under fresh destination block ids. Returns how many blocks
+    were NEWLY adopted (already-resident digests are skipped —
+    ingest is idempotent, so a re-offered transfer after a failed
+    handoff costs nothing).
+
+    Runs on the DESTINATION engine's dispatch thread. Adoption is
+    capacity-aware: it stops once taking another block would evict a
+    block of its own chain (tiny pools), and a partial graft is fine
+    — `match` simply hits a shorter prefix and prefill covers more
+    tail. Any verification failure raises `TransferVerifyError` and
+    leaves the pool untouched.
+    """
+    blocks = getattr(pool, "blocks", None)
+    if blocks is None or not getattr(blocks, "prefix_cache", False):
+        return 0
+    _check_compat(pool, tr)
+    m = tr.num_blocks
+    if not (len(tr.byte_digests) == m
+            and all(len(r) == m for r in tr.rows)):
+        raise TransferVerifyError(
+            f"manifest arity mismatch: {m} chain digests, "
+            f"{len(tr.byte_digests)} byte digests, rows "
+            f"{[len(r) for r in tr.rows]}")
+    # Host copies for verification (and for the corrupt drill —
+    # flipping the copy models a wire fault without touching the
+    # caller's buffers).
+    # hvd: disable=HVD001(verify wants host bytes; once per handoff, off the tick ring)
+    rows_h = [np.array(r, copy=True) for r in tr.rows]
+    if chaos.fires("disagg.block_corrupt"):
+        rows_h[0].view(np.uint8).reshape(-1)[0] ^= 0xFF
+    # Layer 1: the chain digests must be the prompt's own chain —
+    # block i's identity commits to tokens[0 : (i+1)*block_size].
+    expect = blocks._chain(tr.prompt, m)
+    if tuple(expect) != tuple(tr.chain_digests):
+        raise TransferVerifyError(
+            "chain digest mismatch: manifest digests are not the "
+            "prompt's prefix chain")
+    # Layer 2: the row bytes must be the bytes the exporter hashed.
+    for i in range(m):
+        got = _byte_digest([r[i] for r in rows_h],
+                           tr.chain_digests[i])
+        if got != tr.byte_digests[i]:
+            raise TransferVerifyError(
+                f"block {i} byte digest mismatch (transfer "
+                f"corrupted in flight)")
+    # Re-commit the row stacks under the destination's layouts ONCE:
+    # the stacked [m, 1, bs, ...] arrays are rank-aligned with the
+    # pool leaves ([num_blocks, 1, bs, ...]), so `put_like` lands the
+    # heads shards exactly where the destination leaf holds them —
+    # whatever mesh (or none) the rows came from.
+    rows_dev = [put_like(r, pool._pools[k])
+                for k, r in enumerate(tr.rows)]
+    # Adopt in chain order; stop before cannibalizing our own chain
+    # (evicting an earlier grafted block to make room for a later one
+    # would break the contiguous prefix `match` needs).
+    ours = set()
+    adopted = 0
+    for i in range(m):
+        h = tr.chain_digests[i]
+        if h in blocks._cache:
+            ours.add(blocks._cache[h])
+            continue
+        evictable = sum(1 for bid in blocks._lru
+                        if bid not in ours)
+        if blocks.free_blocks + evictable < 1:
+            break
+        bid = blocks.adopt(h)
+        if bid is None:
+            break
+        ours.add(bid)
+        with pool._ctx():
+            for k, leaf in enumerate(rows_dev):
+                pool._pools[k] = pool._pools[k].at[bid].set(leaf[i])
+        adopted += 1
+    if adopted and pool.mesh is not None:
+        # Restore the committed safe_spec layouts after the scatter
+        # (a `.at[].set` can decay the sharding on some backends).
+        from horovod_tpu.models.transformer import shard_paged_pools
+        with pool._ctx():
+            pool._pools = shard_paged_pools(pool._pools, pool.mesh)
+    return adopted

@@ -1,0 +1,479 @@
+"""Disaggregated prefill/decode serving tests (`serving/disagg.py` +
+`serving/transfer.py`).
+
+The contract under test is ONE sentence: moving a stream's KV blocks
+from a prefill pool into a decode pool changes WHERE the tokens are
+computed, never WHAT they are. Every acceptance test pins the
+disaggregated stream bitwise against a single shared-program engine
+serving the same (prompt, seed) — across {fp32, int8} x {greedy,
+seeded}, across pools on DIFFERENT meshes (2->4, sharded->unsharded
+and back), and across every way the handoff can go wrong: prefill
+death mid-prompt, decode death mid-stream, and a corrupted transfer
+(the ``disagg.block_corrupt`` chaos site) that digest verification
+must reject and recompute around. The transfer layer's unit surface
+(export/ingest round-trip, adoption invariants, tamper/compat
+rejection, idempotent re-ingest) is tested at pool level first.
+"""
+
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models.transformer import TransformerLM
+from horovod_tpu.parallel.mesh import make_mesh
+from horovod_tpu.parallel.tensor import unbox
+from horovod_tpu.resilience import chaos
+from horovod_tpu.serving import (
+    DisaggRouter, ServingEngine, ServingRouter, TransferCompatError,
+    TransferVerifyError, export_blocks, ingest_blocks,
+)
+
+VOCAB = 64
+MAX_LEN = 64
+BS = 8
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_compile_state():
+    # Same XLA:CPU workaround as test_sharded_serving.py: the GSPMD
+    # compiles below segfault when stacked on the full suite's
+    # accumulated executables.
+    jax.clear_caches()
+
+
+def _model():
+    return TransformerLM(vocab_size=VOCAB, num_layers=2, num_heads=4,
+                         head_dim=8, max_len=MAX_LEN,
+                         dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def lm(hvd):
+    model = _model()
+    params = unbox(model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 16), jnp.int32))["params"])
+    return model, params
+
+
+def _mesh(n):
+    return make_mesh(devices=jax.devices()[:n], model=n)
+
+
+def _prompts(n, seed=0, length=2 * BS + 2):
+    # Two FULL blocks plus a sub-block tail: the exported manifest
+    # covers tokens [0, 16) and the decode side re-prefills the tail.
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, VOCAB, (length,)) for _ in range(n)]
+
+
+def _wait(cond, timeout=120.0, dt=0.005):
+    t0 = time.time()
+    while not cond():
+        if time.time() - t0 > timeout:
+            raise AssertionError("condition not reached in time")
+        time.sleep(dt)
+
+
+def _factory(model, params, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_queue", 16)
+    kw.setdefault("paged", True)
+    kw.setdefault("kv_block_size", BS)
+    return lambda: ServingEngine(model, params, **kw)
+
+
+def _oracle(model, params, prompts, steps, *, seeds=None,
+            temperature=0.0, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_queue", 2 * len(prompts) + 2)
+    refs = []
+    with ServingEngine(model, params, paged=True, kv_block_size=BS,
+                       **kw) as eng:
+        hs = [eng.submit(p, steps, temperature=temperature,
+                         seed=(seeds[i] if seeds else 0))
+              for i, p in enumerate(prompts)]
+        for h in hs:
+            refs.append(list(h.result(timeout=300).tokens))
+    return refs
+
+
+# ---------------------------------------------------------------------------
+# Transfer layer: pool-level unit surface
+# ---------------------------------------------------------------------------
+
+
+class TestTransferUnit:
+    def _exported(self, model, params, prompt, **kw):
+        """Serve ``prompt`` for one token on a throwaway engine and
+        export its (now LRU-resident) full prompt blocks."""
+        with ServingEngine(model, params, num_slots=2, paged=True,
+                           kv_block_size=BS, **kw) as eng:
+            res = eng.submit(prompt, 1).result(timeout=300)
+            tr = export_blocks(eng.pool, prompt,
+                               (int(res.tokens[0]),))
+        return tr, int(res.tokens[0])
+
+    def test_export_ingest_roundtrip_bitwise(self, lm):
+        """The core primitive: blocks exported from pool A, grafted
+        into pool B, matched by B's ordinary admission — and B's
+        stream is bitwise the cold-prefill stream."""
+        model, params = lm
+        prompt = _prompts(1, seed=5)[0]
+        ref = _oracle(model, params, [prompt], 6)[0]
+        tr, _ = self._exported(model, params, prompt)
+        assert tr is not None and tr.num_blocks == 2
+        assert tr.nbytes > 0
+        with ServingEngine(model, params, num_slots=2, paged=True,
+                           kv_block_size=BS) as eng:
+            assert ingest_blocks(eng.pool, tr) == 2
+            eng.pool.blocks.check_invariants()
+            res = eng.submit(prompt, 6).result(timeout=300)
+        assert list(res.tokens) == ref
+        assert res.prefix_tokens_cached == 2 * BS
+
+    def test_reingest_is_idempotent(self, lm):
+        model, params = lm
+        prompt = _prompts(1, seed=6)[0]
+        tr, _ = self._exported(model, params, prompt)
+        with ServingEngine(model, params, num_slots=2, paged=True,
+                           kv_block_size=BS) as eng:
+            assert ingest_blocks(eng.pool, tr) == 2
+            # Every digest already resident: nothing new to adopt.
+            assert ingest_blocks(eng.pool, tr) == 0
+            eng.pool.blocks.check_invariants()
+
+    def test_tampered_bytes_rejected(self, lm):
+        """Satellite 2's fault model, pool level: one flipped byte in
+        a transferred block must fail the byte digest and leave the
+        destination pool untouched."""
+        model, params = lm
+        prompt = _prompts(1, seed=7)[0]
+        tr, _ = self._exported(model, params, prompt)
+        rows = [np.array(r, copy=True) for r in tr.rows]
+        rows[0].view(np.uint8).reshape(-1)[3] ^= 0xFF
+        bad = dataclasses.replace(tr, rows=rows)
+        with ServingEngine(model, params, num_slots=2, paged=True,
+                           kv_block_size=BS) as eng:
+            before = eng.pool.blocks.free_blocks
+            with pytest.raises(TransferVerifyError):
+                ingest_blocks(eng.pool, bad)
+            assert eng.pool.blocks.free_blocks == before
+            eng.pool.blocks.check_invariants()
+
+    def test_wrong_prompt_chain_rejected(self, lm):
+        """Digest-chain binding: the same bytes presented under a
+        DIFFERENT prompt (a misdirected transfer) must fail the chain
+        verification, not graft silently."""
+        model, params = lm
+        p1, p2 = _prompts(2, seed=8)
+        tr, _ = self._exported(model, params, p1)
+        bad = dataclasses.replace(tr, prompt=tuple(int(t) for t in p2))
+        with ServingEngine(model, params, num_slots=2, paged=True,
+                           kv_block_size=BS) as eng:
+            with pytest.raises(TransferVerifyError):
+                ingest_blocks(eng.pool, bad)
+
+    def test_block_size_mismatch_rejected(self, lm):
+        model, params = lm
+        prompt = _prompts(1, seed=9)[0]
+        tr, _ = self._exported(model, params, prompt)
+        with ServingEngine(model, params, num_slots=2, paged=True,
+                           kv_block_size=4) as eng:
+            with pytest.raises(TransferCompatError):
+                ingest_blocks(eng.pool, tr)
+
+    def test_export_none_without_full_blocks(self, lm):
+        """Nothing exportable: a sub-block prompt (no full block), or
+        a non-paged pool, answers None — the caller degrades to a
+        forced-prefix-only handoff, never errors."""
+        model, params = lm
+        short = _prompts(1, seed=10, length=BS - 2)[0]
+        tr, _ = self._exported(model, params, short)
+        assert tr is None
+        with ServingEngine(model, params, num_slots=2) as eng:
+            res = eng.submit(short, 1).result(timeout=300)
+            assert export_blocks(eng.pool, short,
+                                 (int(res.tokens[0]),)) is None
+
+    def test_device_mode_roundtrip(self, lm):
+        """``HVD_DISAGG_TRANSFER=device``: rows stay device arrays end
+        to end; digests and the graft behave identically."""
+        model, params = lm
+        prompt = _prompts(1, seed=11)[0]
+        ref = _oracle(model, params, [prompt], 5)[0]
+        with ServingEngine(model, params, num_slots=2, paged=True,
+                           kv_block_size=BS) as eng:
+            res = eng.submit(prompt, 1).result(timeout=300)
+            tr = export_blocks(eng.pool, prompt,
+                               (int(res.tokens[0]),), mode="device")
+        assert tr is not None and tr.mode == "device"
+        with ServingEngine(model, params, num_slots=2, paged=True,
+                           kv_block_size=BS) as eng:
+            assert ingest_blocks(eng.pool, tr) == 2
+            res = eng.submit(prompt, 5).result(timeout=300)
+        assert list(res.tokens) == ref
+        assert res.prefix_tokens_cached == 2 * BS
+
+
+# ---------------------------------------------------------------------------
+# The acceptance matrix: disaggregated streams are bitwise-exact
+# ---------------------------------------------------------------------------
+
+
+class TestDisaggBitwise:
+    @pytest.mark.parametrize("quant", [None, "int8"],
+                             ids=["fp32", "int8"])
+    @pytest.mark.parametrize("seeded", [False, True],
+                             ids=["greedy", "seeded"])
+    def test_disagg_matches_single_engine(self, lm, quant, seeded):
+        model, params = lm
+        prompts = _prompts(3, seed=20)
+        steps = 6
+        seeds = [100 + i for i in range(len(prompts))]
+        temperature = 0.9 if seeded else 0.0
+        ref = _oracle(model, params, prompts, steps,
+                      seeds=seeds if seeded else None,
+                      temperature=temperature, weight_quant=quant)
+        router = ServingRouter(
+            _factory(model, params, weight_quant=quant),
+            disagg={"prefill": 1, "decode": 1})
+        assert isinstance(router, DisaggRouter)
+        try:
+            hs = [router.submit(p, steps, temperature=temperature,
+                                seed=(seeds[i] if seeded else 0))
+                  for i, p in enumerate(prompts)]
+            got = [list(h.result(timeout=300).tokens) for h in hs]
+            snap = router.metrics_snapshot()
+        finally:
+            router.shutdown()
+        assert got == ref, (quant, seeded)
+        assert snap["completed"] == len(prompts)
+        assert snap["disagg"]["handoffs"] == len(prompts)
+        assert snap["disagg"]["fallbacks"] == 0
+
+    def test_handoff_grafts_full_prompt_blocks(self, lm):
+        """The graft PROOF: the decode leg's admission matched every
+        full prompt block from the transferred manifest — the decode
+        pool re-prefilled only the sub-block tail, not the prompt."""
+        model, params = lm
+        prompt = _prompts(1, seed=21)[0]
+        router = ServingRouter(_factory(model, params),
+                               disagg={"prefill": 1, "decode": 1})
+        try:
+            res = router.submit(prompt, 5).result(timeout=300)
+        finally:
+            router.shutdown()
+        assert res.prefix_tokens_cached == 2 * BS
+
+    def test_one_token_requests_skip_the_handoff(self, lm):
+        """max_new_tokens=1 IS the prefill — it must take the plain
+        path (no decode budget exists for a handoff)."""
+        model, params = lm
+        prompt = _prompts(1, seed=22)[0]
+        ref = _oracle(model, params, [prompt], 1)[0]
+        router = ServingRouter(_factory(model, params),
+                               disagg={"prefill": 1, "decode": 1})
+        try:
+            res = router.submit(prompt, 1).result(timeout=300)
+            snap = router.metrics_snapshot()
+        finally:
+            router.shutdown()
+        assert list(res.tokens) == ref
+        assert snap["disagg"]["handoffs"] == 0
+
+    def test_decode_length_validated_synchronously(self, lm):
+        """The decode leg's length bound surfaces AT SUBMIT (the
+        prefill leg alone — max_new=1 — would accept it)."""
+        model, params = lm
+        prompt = _prompts(1, seed=23, length=MAX_LEN - 4)[0]
+        router = ServingRouter(_factory(model, params),
+                               disagg={"prefill": 1, "decode": 1})
+        try:
+            with pytest.raises(ValueError):
+                router.submit(prompt, 16)
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Cross-layout: pools on different meshes
+# ---------------------------------------------------------------------------
+
+
+class TestCrossLayout:
+    @pytest.mark.parametrize("src,dst", [(2, 4), (2, None), (None, 2)],
+                             ids=["mesh2-to-mesh4",
+                                  "sharded-to-unsharded",
+                                  "unsharded-to-sharded"])
+    def test_cross_mesh_handoff_bitwise(self, lm, src, dst):
+        """The reshard seam: blocks exported from a pool laid out on
+        one mesh graft into a pool on a DIFFERENT mesh (ingest
+        re-commits under the destination's safe_spec layouts) — and
+        the stream is still bitwise, with the graft fully matched."""
+        model, params = lm
+        prompts = _prompts(2, seed=30)
+        steps = 5
+        ref = _oracle(model, params, prompts, steps)
+        router = ServingRouter(
+            _factory(model, params,
+                     mesh=None if dst is None else _mesh(dst)),
+            disagg={"prefill": 1, "decode": 1,
+                    "prefill_factory": _factory(
+                        model, params,
+                        mesh=None if src is None else _mesh(src))})
+        try:
+            hs = [router.submit(p, steps) for p in prompts]
+            results = [h.result(timeout=300) for h in hs]
+            snap = router.metrics_snapshot()
+        finally:
+            router.shutdown()
+        assert [list(r.tokens) for r in results] == ref, (src, dst)
+        assert all(r.prefix_tokens_cached == 2 * BS for r in results)
+        assert snap["disagg"]["fallbacks"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Kill points and the fallback ladder
+# ---------------------------------------------------------------------------
+
+
+class TestKillPointsAndFallbacks:
+    def test_corrupted_transfer_falls_back_bitwise(self, lm):
+        """Satellite 2 end to end: the ``disagg.block_corrupt`` site
+        flips a byte in flight; digest verification rejects the graft
+        on the decode side, the request re-prefills from the prompt,
+        and the stream is bitwise-exact anyway — corruption costs
+        work, never correctness."""
+        model, params = lm
+        prompt = _prompts(1, seed=40)[0]
+        ref = _oracle(model, params, [prompt], 6)[0]
+        router = ServingRouter(_factory(model, params),
+                               disagg={"prefill": 1, "decode": 1})
+        try:
+            with chaos.armed("disagg.block_corrupt:1") as monkey:
+                res = router.submit(prompt, 6).result(timeout=300)
+            assert monkey.fired("disagg.block_corrupt") == 1
+            snap = router.metrics_snapshot()
+        finally:
+            router.shutdown()
+        assert list(res.tokens) == ref
+        # The graft was rejected wholesale: the decode leg matched
+        # nothing and recomputed the whole prompt.
+        assert res.prefix_tokens_cached == 0
+        assert snap["completed"] == 1
+
+    def test_mid_decode_kill_migrates_bitwise(self, lm):
+        """Decode-replica death mid-stream: base-router migration
+        (token-exact forced prefix) re-places the stream on the
+        surviving decode replica, re-offering the transfer — bitwise
+        across the kill."""
+        model, params = lm
+        prompts = _prompts(3, seed=41)
+        steps = 20
+        seeds = [7, 8, 9]
+        ref = _oracle(model, params, prompts, steps, seeds=seeds,
+                      temperature=0.8)
+        router = ServingRouter(_factory(model, params),
+                               disagg={"prefill": 1, "decode": 2},
+                               health_poll_s=0.01)
+        try:
+            hs = [router.submit(p, steps, temperature=0.8, seed=s)
+                  for p, s in zip(prompts, seeds)]
+            _wait(lambda: any(len(h.tokens_so_far()) >= 3
+                              for h in hs))
+            victim = max(
+                router.replicas(),
+                key=lambda rid: router.engine_of(rid).pool.busy_slots)
+            router.kill_replica(victim)
+            got = [list(h.result(timeout=300).tokens) for h in hs]
+            snap = router.metrics_snapshot()
+        finally:
+            router.shutdown()
+        assert got == ref
+        assert snap["completed"] == 3
+        assert snap["replica_deaths"] == 1
+        assert snap["migrations"] >= 1
+
+    def test_prefill_kill_degrades_and_replaces(self, lm):
+        """Prefill-replica death with prompts in flight: every stream
+        still completes bitwise (handed off already, or recomputed on
+        the decode pool via the prefill_failed fallback), and the
+        monitor cold-replaces the prefill leg."""
+        model, params = lm
+        prompts = _prompts(4, seed=42)
+        steps = 6
+        ref = _oracle(model, params, prompts, steps)
+        router = ServingRouter(_factory(model, params),
+                               disagg={"prefill": 1, "decode": 1},
+                               health_poll_s=0.01)
+        try:
+            (pid,) = router.prefill_replicas()
+            hs = [router.submit(p, steps) for p in prompts]
+            router.kill_prefill(pid)
+            got = [list(h.result(timeout=300).tokens) for h in hs]
+            _wait(lambda: any(
+                state == "up" for state
+                in router.prefill_replicas().values()))
+            snap = router.metrics_snapshot()
+        finally:
+            router.shutdown()
+        assert got == ref
+        assert snap["completed"] == 4
+        assert snap["disagg"]["prefill_deaths"] == 1
+
+    def test_no_prefill_capacity_falls_back_to_shared_path(self, lm):
+        """The bottom rung: with the prefill tier gone and no
+        replacement budget, submits take the ordinary shared-program
+        path — degraded placement, identical tokens."""
+        model, params = lm
+        prompt = _prompts(1, seed=43)[0]
+        ref = _oracle(model, params, [prompt], 6)[0]
+        router = ServingRouter(_factory(model, params),
+                               disagg={"prefill": 1, "decode": 1},
+                               health_poll_s=0.01,
+                               max_replacements=0)
+        try:
+            (pid,) = router.prefill_replicas()
+            router.kill_prefill(pid)
+            _wait(lambda: not any(
+                state == "up" for state
+                in router.prefill_replicas().values()))
+            res = router.submit(prompt, 6).result(timeout=300)
+            snap = router.metrics_snapshot()
+        finally:
+            router.shutdown()
+        assert list(res.tokens) == ref
+        assert snap["disagg"]["fallbacks"] >= 1
+        assert snap["disagg"]["handoffs"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Prefix-cache interaction
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixInteraction:
+    def test_transferred_prefix_serves_followup_requests(self, lm):
+        """A grafted prefix is a FIRST-CLASS cache entry in the
+        destination pool: a later identical prompt matches it through
+        ordinary admission (plus its own published blocks), bitwise
+        both times."""
+        model, params = lm
+        prompt = _prompts(1, seed=50)[0]
+        ref = _oracle(model, params, [prompt], 6)[0]
+        router = ServingRouter(_factory(model, params),
+                               disagg={"prefill": 1, "decode": 1})
+        try:
+            r1 = router.submit(prompt, 6).result(timeout=300)
+            r2 = router.submit(prompt, 6).result(timeout=300)
+        finally:
+            router.shutdown()
+        assert list(r1.tokens) == ref
+        assert list(r2.tokens) == ref
+        assert r1.prefix_tokens_cached == 2 * BS
+        assert r2.prefix_tokens_cached >= 2 * BS
