@@ -907,6 +907,123 @@ def _disagg_ab(model, params, args, prompts, rate, log):
     return {"rate": rate, "baseline": baseline, "disagg": disagg}
 
 
+def _overload_leg(model, params, args, prompts, rate, *, preempt,
+                  log, refs=None):
+    """One leg of the --overload A/B: Poisson arrivals into ONE paged
+    engine whose pool is deliberately undersized (fits ~1.5 worst-case
+    streams), with every 4th request a priority-5 "paid" submit and
+    the rest priority-0 "free" flood. ``preempt=False`` is shed-only:
+    the paid head waits in its WFQ lane until a lane drains.
+    ``preempt=True`` is the overload control plane (docs/serving.md
+    "Overload control"): watermark admission + token-exact preemption
+    — the paid head evicts the cheapest free victims (swap when the
+    host budget allows, else recompute) and the victims resume
+    bitwise. Equal pool geometry on both legs, so the columns isolate
+    the PREEMPTION lever; the headline is paid-tenant TTFT under
+    saturation. ``refs`` (the shed leg's streams) pins the
+    preempt-resume-bitwise bit in the artifact."""
+    import numpy as np
+
+    from horovod_tpu.serving import ServingEngine
+
+    steps, n_req = args.decode_steps, len(prompts)
+    S = args.serving_slots
+    bs = args.serving_kv_block_size
+    # Undersized on purpose: ~1.5 worst-case streams (prompt + steps,
+    # +1 for the partial-block tail). The shed leg still always makes
+    # progress (one stream fits), the preempt leg has victims to take.
+    per_req = (max(len(p) for p in prompts) + steps + bs - 1) // bs + 1
+    kv_blocks = 1 + per_req + max(2, per_req // 2)
+    hi = set(range(3, n_req, 4))
+    gaps = np.random.RandomState(7).exponential(1.0 / rate,
+                                                size=n_req)
+    eng = ServingEngine(
+        model, params, num_slots=S, max_queue=4 * n_req + 8,
+        warmup=True, paged=True,
+        kv_blocks=kv_blocks, kv_block_size=bs,
+        pipeline_depth=args.serving_pipeline_depth,
+        prefill_chunk_budget=args.prefill_chunk_budget,
+        preempt=preempt, swap_bytes=(256 << 20) if preempt else 0,
+        tenant_weights="paid=3,free=1")
+    t0 = time.time()
+    handles = []
+    try:
+        for i, p in enumerate(prompts):
+            if i in hi:
+                handles.append(eng.submit(p, steps, temperature=0.7,
+                                          seed=i, priority=5,
+                                          tenant="paid"))
+            else:
+                handles.append(eng.submit(p, steps, temperature=0.7,
+                                          seed=i, tenant="free"))
+            if i < n_req - 1:
+                time.sleep(float(gaps[i]))
+        results = [h.result() for h in handles]
+    finally:
+        snap = eng.metrics_snapshot()
+        eng.shutdown()
+    dt = time.time() - t0
+    streams = [list(r.tokens) for r in results]
+    hi_ttfts = sorted(results[i].ttft_s for i in sorted(hi))
+    ttfts = sorted(r.ttft_s for r in results)
+
+    def pct(xs, q):
+        return round(float(np.percentile(xs, q)) * 1e3, 3)
+
+    rec = {
+        "preempt": bool(preempt),
+        "kv_blocks": kv_blocks,
+        "tok_s": round(sum(len(s) for s in streams) / dt, 2),
+        "completed": snap["completed"],
+        "rejected": snap["rejected"],
+        "hi_ttft_ms_p50": pct(hi_ttfts, 50),
+        "hi_ttft_ms_p95": pct(hi_ttfts, 95),
+        "ttft_ms_p50": pct(ttfts, 50), "ttft_ms_p95": pct(ttfts, 95),
+        "preemptions_swap": snap.get("preemptions_swap", 0),
+        "preemptions_recompute": snap.get("preemptions_recompute", 0),
+        "preempt_tokens_recomputed": snap.get(
+            "preempt_tokens_recomputed", 0),
+        "preempt_tokens_swapped_in": snap.get(
+            "preempt_tokens_swapped_in", 0),
+        # THE anti-starvation bit: every request (flood victims
+        # included) finished — shedding/preempting the low band never
+        # stranded anyone.
+        "starvation_free": (len(results) == n_req
+                            and snap["rejected"] == 0
+                            and snap["timed_out"] == 0),
+    }
+    if refs is not None:
+        # THE preempt-resume acceptance bit: streams with preemption
+        # bitwise equal the shed leg's (same prompts + seeds =>
+        # deterministic decode; preemption moves WHEN, never WHAT).
+        rec["token_exact_vs_baseline"] = streams == refs
+    label = "preempt" if preempt else "shed-only"
+    log(f"overload leg {label}: {rec['tok_s']} tok/s, hi ttft "
+        f"p50/p95 {rec['hi_ttft_ms_p50']}/{rec['hi_ttft_ms_p95']} "
+        f"ms, starvation-free={rec['starvation_free']}"
+        + (f", {rec['preemptions_swap']} swap / "
+           f"{rec['preemptions_recompute']} recompute preemption(s), "
+           f"token-exact={rec.get('token_exact_vs_baseline')}"
+           if preempt else ""))
+    return rec, streams
+
+
+def _overload_ab(model, params, args, prompts, rate, log):
+    """--serving --overload: the overload-control A/B (docs/serving.md
+    "Overload control") at the highest rate — shed-only vs token-exact
+    preemption on an EQUAL undersized paged pool, priority-5 "paid"
+    trickle against a priority-0 "free" flood. The headline is paid
+    TTFT under saturation: shed-only parks the paid head behind the
+    flood's KV residency; preemption evicts the cheapest victims and
+    resumes them bitwise."""
+    shed, s_streams = _overload_leg(
+        model, params, args, prompts, rate, preempt=False, log=log)
+    pre, _ = _overload_leg(
+        model, params, args, prompts, rate, preempt=True, log=log,
+        refs=s_streams)
+    return {"rate": rate, "shed_only": shed, "preempt": pre}
+
+
 def _serving_trace_check(model, params, args, prompts, log):
     """Observability acceptance evidence: run a few requests with the
     event log, the (Python-writer) Timeline and the shared metric
@@ -1360,6 +1477,14 @@ def run_serving(args, devices, n_chips, log):
                 f"{args.seq} for the disagg A/B's paged pools")
         out["disagg_ab"] = _disagg_ab(model, params, args, prompts,
                                       max(rates), log)
+    if getattr(args, "overload", False) and not chaos_mode:
+        if args.seq % args.serving_kv_block_size:
+            raise ValueError(
+                f"--serving-kv-block-size "
+                f"{args.serving_kv_block_size} must divide --seq "
+                f"{args.seq} for the overload A/B's paged pools")
+        out["overload_ab"] = _overload_ab(model, params, args,
+                                          prompts, max(rates), log)
     return out
 
 
@@ -1800,6 +1925,17 @@ def main():
                          "and the bitwise-vs-baseline bit "
                          "(HVD_DISAGG parity; docs/serving.md "
                          "'Disaggregated serving')")
+    ap.add_argument("--overload", action="store_true",
+                    help="serving: add the overload-control A/B at "
+                         "the highest rate — shed-only vs token-exact "
+                         "KV preemption on an EQUAL undersized paged "
+                         "pool, a priority-5 'paid' trickle against a "
+                         "priority-0 'free' flood; records paid-"
+                         "tenant TTFT, swap/recompute preemption "
+                         "counts, the starvation-free bit and the "
+                         "preempt-resume-bitwise bit (HVD_PREEMPT "
+                         "parity; docs/serving.md 'Overload "
+                         "control')")
     ap.add_argument("--serving-slo",
                     default="ttft=30,tpot=5,shed=0.1,target=0.9,"
                             "fast=5,slow=60,burn=5",
@@ -2369,6 +2505,12 @@ def _bench_body(args, devices, n_chips, metric, unit,
             # prefill pool + decode pool with KV-block handoffs at
             # equal engine count, incl. the bitwise-vs-baseline bit.
             result["disagg_ab"] = r["disagg_ab"]
+        if "overload_ab" in r:
+            # The overload-control A/B (docs/serving.md "Overload
+            # control"): shed-only vs token-exact preemption on an
+            # equal undersized pool — paid-tenant TTFT, preemption
+            # counts, the starvation-free and bitwise bits.
+            result["overload_ab"] = r["overload_ab"]
         _set_best(result)
         emit(_BEST_RESULT)
         write_out(args)

@@ -65,6 +65,20 @@ JAX_PLATFORMS=cpu python -m pytest -q --collect-only \
 JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 4 \
     --warmup --interleave-check --obs-check --prefix-check --spec-check
 
+# Overload-control smoke (PR 17, docs/serving.md "Overload control"):
+# two tenants (HVD_TENANT_WEIGHTS-style weighted lanes) against a TINY
+# paged pool — a low-priority "free" flood saturates it, then a
+# priority-5 "paid" request must be admitted by token-exact PREEMPTION
+# (bounded TTFT, not parked behind the flood). Two phases pin both
+# resume modes: >= 1 swap preemption (KV blocks shelved in host RAM
+# and re-grafted on resume) and >= 1 recompute preemption
+# (swap_bytes=0: forced-prefix re-prefill). Every stream must be
+# bitwise the unpressured run's and no flood request may starve (the
+# WFQ aging guarantee). Knobs: HVD_PREEMPT, HVD_SWAP_BYTES,
+# HVD_TENANT_WEIGHTS, HVD_BROWNOUT (runtime/config.py registry).
+JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 2 \
+    --preempt-check
+
 # Fleet-observability smoke (docs/observability.md "Fleet view" /
 # "Flight recorder"): on a 2-engine host, one /fleet scrape must show
 # the fleet-merged hvd_fleet_* histograms (both engines' requests

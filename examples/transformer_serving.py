@@ -49,6 +49,12 @@ snapshot. Two extra CI legs exercise the PR-3 hot-path guarantees:
   a speculative (self-draft) engine must produce BITWISE-equal
   streams with >= 1 multi-token round observed — the serving-side
   twin of `tests/test_spec_serving.py`'s oracle.
+* ``--preempt-check`` is the overload-control smoke (docs/serving.md
+  "Overload control"): a low-priority tenant flood saturates a tiny
+  block pool, a priority-5 request must be admitted by preemption
+  (bounded TTFT) with >= 1 swap AND >= 1 recompute preemption across
+  the two phases, every stream token-exact vs the unpressured run
+  and no flood request starved.
 * ``--failover-check`` is the serving-fleet failover smoke
   (docs/serving.md "Fleet failover"): THREE engine replicas behind a
   `ServingRouter`, one killed abruptly (the ``router.replica_kill``
@@ -59,7 +65,8 @@ snapshot. Two extra CI legs exercise the PR-3 hot-path guarantees:
 
 Run:  python examples/transformer_serving.py --requests 4 \
           [--warmup] [--interleave-check] [--obs-check] \
-          [--prefix-check] [--fleet-check] [--failover-check]
+          [--prefix-check] [--preempt-check] [--fleet-check] \
+          [--failover-check]
 """
 
 import argparse
@@ -240,6 +247,69 @@ def prefix_check(model, params, repeats=3):
     assert best_hit < best_cold, (
         f"cache-hit TTFT {best_hit * 1e3:.2f} ms not below cold "
         f"{best_cold * 1e3:.2f} ms — prefix skip not paying?")
+
+
+def preempt_check(model, params, ttft_bound_s=10.0):
+    """The overload-control smoke (docs/serving.md "Overload
+    control"): two tenants against a TINY block pool — a low-priority
+    "free" flood saturates it, then a priority-5 "paid" request
+    arrives and must be admitted by PREEMPTING a flood stream (its
+    TTFT bounded, not parked behind the whole flood). Two phases pin
+    both resume modes: a roomy swap shelf (>= 1 swap preemption) and
+    ``swap_bytes=0`` (>= 1 recompute preemption). Every stream —
+    preempted-and-resumed or not — must be token-exact vs the
+    unpressured run, and NOTHING starves: all flood requests
+    complete."""
+    import time as _time
+
+    rs = np.random.RandomState(11)
+    steps = 12
+    flood_p = [rs.randint(0, 128, (8,)) for _ in range(6)]
+    hi_p = rs.randint(0, 128, (8,))
+    refs = []
+    with ServingEngine(model, params, num_slots=2, max_queue=32,
+                       warmup=True, paged=True, kv_block_size=4,
+                       kv_blocks=64) as eng:
+        for p in flood_p + [hi_p]:
+            refs.append(list(eng.submit(p, steps)
+                             .result(timeout=600).tokens))
+
+    def phase(swap_bytes, expect):
+        with ServingEngine(model, params, num_slots=2, max_queue=32,
+                           warmup=True, paged=True, kv_block_size=4,
+                           kv_blocks=9, preempt=True,
+                           swap_bytes=swap_bytes,
+                           tenant_weights="paid=3,free=1") as eng:
+            flood = [eng.submit(p, steps, tenant="free")
+                     for p in flood_p]
+            t0 = _time.time()
+            while not any(len(h.tokens_so_far()) >= 2 for h in flood):
+                assert _time.time() - t0 < 120, "flood never decoded"
+                _time.sleep(0.005)
+            hi = eng.submit(hi_p, steps, priority=5, tenant="paid")
+            got = [list(h.result(timeout=600).tokens) for h in flood]
+            rhi = hi.result(timeout=600)
+            snap = eng.metrics_snapshot()
+        assert got + [list(rhi.tokens)] == refs, (
+            f"{expect} phase: streams diverged across preemption")
+        assert rhi.ttft_s < ttft_bound_s, (
+            f"high-priority TTFT {rhi.ttft_s:.2f}s not bounded "
+            f"(flood starved it?)")
+        n = snap[f"preemptions_{expect}"]
+        assert n >= 1, (f"no {expect} preemption happened", snap)
+        return snap, rhi.ttft_s
+
+    swap_snap, swap_ttft = phase(64 << 20, "swap")
+    reco_snap, reco_ttft = phase(0, "recompute")
+    print(f"preempt check: swap phase "
+          f"{swap_snap['preemptions_swap']} swap / "
+          f"{swap_snap['preemptions_recompute']} recompute "
+          f"preemptions ({swap_snap['preempt_swap_bytes']} bytes "
+          f"shelved), hi ttft {swap_ttft * 1e3:.1f} ms; recompute "
+          f"phase {reco_snap['preemptions_recompute']} recompute "
+          f"({reco_snap['preempt_tokens_recomputed']} tokens "
+          f"re-prefilled), hi ttft {reco_ttft * 1e3:.1f} ms; "
+          f"7/7 streams token-exact, none starved")
 
 
 def fleet_check(model, params, deferred_monkey=None):
@@ -692,6 +762,13 @@ def main():
                          "chaos-corrupted transfer rejected + "
                          "recovered (docs/serving.md 'Disaggregated "
                          "serving')")
+    ap.add_argument("--preempt-check", action="store_true",
+                    help="overload-control smoke: a low-priority "
+                         "flood on a tiny pool, a priority-5 submit "
+                         "must preempt in (bounded TTFT) with >= 1 "
+                         "swap AND >= 1 recompute preemption, every "
+                         "stream token-exact and none starved "
+                         "(docs/serving.md 'Overload control')")
     ap.add_argument("--spec-check", action="store_true",
                     help="decode-fast-path smoke: a speculative "
                          "(self-draft) engine's greedy streams must "
@@ -757,6 +834,8 @@ def main():
         obs_check(model, params)
     if args.prefix_check:
         prefix_check(model, params)
+    if args.preempt_check:
+        preempt_check(model, params)
     if args.spec_check:
         spec_check(model, params, prompts, args.max_new_tokens)
     if args.fleet_check:

@@ -462,6 +462,83 @@ def disagg_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
     }
 
 
+def preempt_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """The preemption plane (docs/serving.md "Overload control"):
+    token-exact evictions of lower-priority decode streams when a
+    higher-priority head cannot be admitted, by mode — `swap` shelves
+    the victim's KV blocks in the host-RAM SwapStore (re-grafted on
+    resume, only the sub-block tail re-prefills) and `recompute` drops
+    them (resume re-prefills the forced prefix)."""
+    reg = reg or registry()
+    return {
+        "preemptions": reg.counter(
+            "hvd_preempt_total",
+            "Decode streams preempted to admit higher-priority work "
+            "or unstrand a watermark-admitted lane, by mode (swap = "
+            "KV shelved in the SwapStore, recompute = KV dropped)",
+            ("mode",)),
+        "tokens": reg.counter(
+            "hvd_preempt_tokens_total",
+            "Token accounting across preempt/resume cycles, by kind "
+            "(recomputed = prefilled again on resume, swapped_in = "
+            "restored from shelved blocks without recompute)",
+            ("kind",)),
+        "swap_bytes": reg.counter(
+            "hvd_preempt_swap_bytes_total",
+            "KV bytes shelved into the SwapStore by swap preemptions"),
+        "swap_store_bytes": reg.gauge(
+            "hvd_preempt_swap_store_bytes",
+            "Host-RAM bytes currently held by the engine's SwapStore "
+            "(bounded by HVD_SWAP_BYTES)", ("engine",)),
+        "swap_store_entries": reg.gauge(
+            "hvd_preempt_swap_store_entries",
+            "Preempted streams currently shelved in the SwapStore",
+            ("engine",)),
+    }
+
+
+def tenant_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """The per-tenant isolation plane (docs/serving.md "Overload
+    control"): tenant-scoped SLO burn rates and the brownout ladder —
+    a fast-burning tenant is degraded (no hedging → spec-k cap →
+    preemption) instead of flipping the fleet-wide /healthz 503."""
+    reg = reg or registry()
+    return {
+        "burn_rate": reg.gauge(
+            "hvd_tenant_slo_burn_rate",
+            "Per-tenant error-budget burn rate per objective and "
+            "window (the tenant-scoped twin of hvd_slo_burn_rate)",
+            ("tenant", "objective", "window")),
+        "breaching": reg.gauge(
+            "hvd_tenant_slo_breaching",
+            "1 while the tenant's objective is fast-burning on both "
+            "windows (feeds the brownout ladder, NOT /healthz)",
+            ("tenant", "objective")),
+        "breaches": reg.counter(
+            "hvd_tenant_slo_breaches_total",
+            "Per-tenant fast-burn breach TRANSITIONS per objective",
+            ("tenant", "objective")),
+        "requests": reg.counter(
+            "hvd_tenant_requests_total",
+            "Engine-level request outcomes per tenant (submitted, "
+            "shed, preempted)", ("tenant", "outcome")),
+        "brownout_level": reg.gauge(
+            "hvd_tenant_brownout_level",
+            "The tenant's brownout rung (0 normal, 1 no hedging, "
+            "2 + spec-k capped, 3 + lowest-priority streams "
+            "preempted)", ("tenant",)),
+        "brownout_transitions": reg.counter(
+            "hvd_tenant_brownout_transitions_total",
+            "Brownout ladder transitions per tenant, by direction "
+            "(escalate, recover) — every rung change is also a "
+            "serving.brownout event", ("tenant", "direction")),
+        "hedges_suppressed": reg.counter(
+            "hvd_tenant_hedges_suppressed_total",
+            "Router hedges skipped because the tenant sits at "
+            "brownout level >= 1", ("tenant",)),
+    }
+
+
 def declare_standard_metrics(
         reg: Optional[MetricRegistry] = None) -> Dict[str, Dict]:
     """Idempotently declare every standard family; the exporter calls
@@ -476,6 +553,8 @@ def declare_standard_metrics(
         "training": training_metrics(reg),
         "collectives": collective_metrics(reg),
         "disagg": disagg_metrics(reg),
+        "preempt": preempt_metrics(reg),
+        "tenant": tenant_metrics(reg),
         "slo": slo_metrics(reg),
         "flightrec": flight_metrics(reg),
         "events": event_metrics(reg),

@@ -86,7 +86,8 @@ class SLOMonitor:
     def __init__(self, objectives: List[Objective], *,
                  fast_window_s: float = DEFAULT_FAST_WINDOW_S,
                  slow_window_s: float = DEFAULT_SLOW_WINDOW_S,
-                 fast_burn: float = DEFAULT_FAST_BURN):
+                 fast_burn: float = DEFAULT_FAST_BURN,
+                 _tenant: Optional[str] = None):
         if not objectives:
             raise ValueError("SLOMonitor needs at least one objective")
         if fast_window_s >= slow_window_s:
@@ -106,8 +107,17 @@ class SLOMonitor:
         # dead protection path, so it warns loudly at construction
         # (the spec grammar can't reject it: target/burn may arrive
         # in either order).
+        # Per-tenant isolation (docs/serving.md "Overload control"):
+        # the engine-wide monitor (``_tenant=None``) lazily spawns one
+        # CHILD monitor per tenant with the same objectives/windows.
+        # Children publish the labeled ``hvd_tenant_slo_*`` family and
+        # feed `tenant_breaching()` (the brownout ladder's input); they
+        # NEVER touch the parent's breach state, so one tenant burning
+        # its budget cannot flip the replica-wide /healthz to 503.
+        self._tenant = _tenant
+        self._children: Dict[str, "SLOMonitor"] = {}
         for o in self.objectives.values():
-            if o.budget * self.fast_burn > 1.0:
+            if _tenant is None and o.budget * self.fast_burn > 1.0:
                 import sys
                 sys.stderr.write(
                     f"WARNING: SLO objective {o.name!r}: budget "
@@ -130,17 +140,34 @@ class SLOMonitor:
         self._breach_count = 0
         from horovod_tpu.obs import catalog as _obs_catalog
         self._m = _obs_catalog.slo_metrics()
+        self._tm = _obs_catalog.tenant_metrics()
 
     # -- the feed -----------------------------------------------------
 
+    def _child(self, tenant: str) -> "SLOMonitor":
+        with self._lock:
+            mon = self._children.get(tenant)
+            if mon is None:
+                mon = SLOMonitor(list(self.objectives.values()),
+                                 fast_window_s=self.fast_window_s,
+                                 slow_window_s=self.slow_window_s,
+                                 fast_burn=self.fast_burn,
+                                 _tenant=tenant)
+                self._children[tenant] = mon
+        return mon
+
     def record(self, name: str, value: Optional[float] = None, *,
                good: Optional[bool] = None,
-               now: Optional[float] = None):
+               now: Optional[float] = None,
+               tenant: Optional[str] = None):
         """One event for objective ``name``: a latency observation
         (``value`` seconds) or a pre-judged ``good`` flag (rate
         objectives). Unknown names are ignored (an engine feeding
         'tpot' into a ttft-only monitor is configuration, not a
-        crash)."""
+        crash). A non-empty ``tenant`` ALSO feeds that tenant's child
+        monitor — the per-tenant burn the brownout ladder reads."""
+        if tenant:
+            self._child(tenant).record(name, value, good=good, now=now)
         obj = self.objectives.get(name)
         if obj is None:
             return
@@ -224,24 +251,66 @@ class SLOMonitor:
                 }
         # Metric/event publication OUTSIDE the lock (the registry has
         # its own locks; a scrape evaluating via the health provider
-        # must not serialize against the submit-path record()).
+        # must not serialize against the submit-path record()). Child
+        # monitors publish the tenant-labeled family instead — their
+        # breaches page per-tenant dashboards, never the replica-wide
+        # hvd_slo_* gauges the load balancer's 503 path reads.
+        ten = self._tenant
         for name, st in out.items():
-            self._m["burn_rate"].set(st["burn_rate_fast"],
-                                     objective=name, window="fast")
-            self._m["burn_rate"].set(st["burn_rate_slow"],
-                                     objective=name, window="slow")
-            self._m["breaching"].set(1.0 if st["breaching"] else 0.0,
-                                     objective=name)
+            if ten is None:
+                self._m["burn_rate"].set(st["burn_rate_fast"],
+                                         objective=name, window="fast")
+                self._m["burn_rate"].set(st["burn_rate_slow"],
+                                         objective=name, window="slow")
+                self._m["breaching"].set(
+                    1.0 if st["breaching"] else 0.0, objective=name)
+            else:
+                self._tm["burn_rate"].set(
+                    st["burn_rate_fast"], tenant=ten,
+                    objective=name, window="fast")
+                self._tm["burn_rate"].set(
+                    st["burn_rate_slow"], tenant=ten,
+                    objective=name, window="slow")
+                self._tm["breaching"].set(
+                    1.0 if st["breaching"] else 0.0, tenant=ten,
+                    objective=name)
         if transitions:
             from horovod_tpu.obs import events as _events
             for name, breaching, bf, bs in transitions:
                 if breaching:
-                    self._m["breaches"].inc(objective=name)
-                    _events.emit("slo.breach", objective=name,
-                                 burn_rate_fast=round(bf, 4),
-                                 burn_rate_slow=round(bs, 4))
-                else:
+                    if ten is None:
+                        self._m["breaches"].inc(objective=name)
+                        _events.emit("slo.breach", objective=name,
+                                     burn_rate_fast=round(bf, 4),
+                                     burn_rate_slow=round(bs, 4))
+                    else:
+                        self._tm["breaches"].inc(tenant=ten,
+                                                 objective=name)
+                        _events.emit("slo.tenant_breach", tenant=ten,
+                                     objective=name,
+                                     burn_rate_fast=round(bf, 4),
+                                     burn_rate_slow=round(bs, 4))
+                elif ten is None:
                     _events.emit("slo.clear", objective=name)
+                else:
+                    _events.emit("slo.tenant_clear", tenant=ten,
+                                 objective=name)
+        return out
+
+    def tenant_breaching(self, now: Optional[float] = None
+                         ) -> Dict[str, List[str]]:
+        """{tenant: objectives in fast burn} — the brownout ladder's
+        feed. Evaluates every child so the answer is current; tenants
+        with no breaching objective are omitted."""
+        with self._lock:
+            kids = list(self._children.items())
+        now = time.time() if now is None else now
+        out: Dict[str, List[str]] = {}
+        for tenant, mon in kids:
+            mon.evaluate(now)
+            bad = mon.breaching()
+            if bad:
+                out[tenant] = bad
         return out
 
     def breaching(self) -> List[str]:
@@ -290,6 +359,7 @@ class SLOMonitor:
             "breaching": [n for n, st in state.items()
                           if st["breaching"]],
             "breach_count": self.breach_count,
+            "tenants_breaching": self.tenant_breaching(),
         }
 
     # -- construction from the knob -----------------------------------

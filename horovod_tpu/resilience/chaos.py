@@ -330,6 +330,13 @@ _SITE_DOCS: Dict[str, str] = {
                             "byte-digest verify must reject the "
                             "graft and the stream fall back to "
                             "token-level recompute, bitwise-exact",
+    "serving.overload_storm": "overload storm: every known tenant "
+                              "escalates one brownout rung per "
+                              "firing (hedging off -> spec-k capped "
+                              "-> lowest-priority streams "
+                              "preempted) — degradation must be "
+                              "graduated and per-tenant, never a "
+                              "fleet-wide 503",
 }
 
 _SITE_CALL_RE = (r'(?:chaos\s*\.\s*)?(?:fires|slow_site)\(\s*'

@@ -58,6 +58,9 @@ DEFAULT_SPEC_K = 4
 DEFAULT_DISAGG_PREFILL = 1
 DEFAULT_DISAGG_DECODE = 1
 DEFAULT_DISAGG_TRANSFER = "host"
+# Overload control (docs/serving.md "Overload control"): the
+# preemption swap shelf's host-RAM byte budget.
+DEFAULT_SWAP_BYTES = 256 << 20
 
 
 # ---------------------------------------------------------------------------
@@ -432,6 +435,33 @@ register_knob(
     "KV-block transfer mode between pools: 'host' bounces rows "
     "through host memory (any layout pair), 'device' keeps them "
     "device-resident and device_puts into the destination layout")
+register_knob(
+    "HVD_PREEMPT", "flag", "0",
+    "serving/engine.py",
+    "Overload control: 1 lets a blocked higher-priority request "
+    "preempt strictly lower-priority decode streams token-exactly "
+    "(swap or recompute), and switches paged admission to optimistic "
+    "watermark reservations (docs/serving.md \"Overload control\")")
+register_knob(
+    "HVD_SWAP_BYTES", "int", str(DEFAULT_SWAP_BYTES),
+    "serving/overload.py",
+    "Overload control: host-RAM byte budget for the preemption swap "
+    "shelf (preempted streams' KV blocks awaiting resume); 0 "
+    "degrades every preemption to recompute")
+register_knob(
+    "HVD_TENANT_WEIGHTS", "str", "",
+    "serving/admission.py",
+    "Overload control: per-tenant WFQ weights, "
+    "'name=<w>,name=<w>,...' — admission serves tenant lanes in "
+    "weight proportion and caps each named tenant's queue share at "
+    "weight/total; empty = every tenant weighs 1, no caps")
+register_knob(
+    "HVD_BROWNOUT", "flag", "1",
+    "serving/overload.py",
+    "Overload control: per-tenant graduated degradation ladder "
+    "(1 no hedging -> 2 spec-k capped -> 3 lowest-priority streams "
+    "preempted), driven by per-tenant SLO fast burn and the "
+    "serving.overload_storm chaos site; 0 disables")
 
 
 # ---------------------------------------------------------------------------
@@ -481,6 +511,13 @@ class Config:
     disagg_prefill: int = DEFAULT_DISAGG_PREFILL
     disagg_decode: int = DEFAULT_DISAGG_DECODE
     disagg_transfer: str = DEFAULT_DISAGG_TRANSFER
+    # Overload control plane (docs/serving.md "Overload control"):
+    # token-exact preemption switch, swap-shelf byte budget,
+    # per-tenant WFQ weights, and the brownout ladder switch.
+    preempt: bool = False
+    swap_bytes: int = DEFAULT_SWAP_BYTES
+    tenant_weights: str = ""
+    brownout: bool = True
     # TPU-specific additions
     allreduce_dtype: str = ""          # e.g. "bfloat16" to reduce in bf16
     mesh_axis_name: str = "data"       # default 1-D data-parallel axis
@@ -533,6 +570,11 @@ class Config:
                                       DEFAULT_DISAGG_DECODE)
         self.disagg_transfer = env_str("HVD_DISAGG_TRANSFER",
                                        DEFAULT_DISAGG_TRANSFER)
+        self.preempt = _env_int("HVD_PREEMPT", 0) != 0
+        self.swap_bytes = _env_int("HVD_SWAP_BYTES",
+                                   DEFAULT_SWAP_BYTES)
+        self.tenant_weights = env_str("HVD_TENANT_WEIGHTS")
+        self.brownout = _env_int("HVD_BROWNOUT", 1) != 0
         self.timeline_path = env_str("HOROVOD_TIMELINE")
         self.stall_warning_time = _env_float(
             "HOROVOD_STALL_CHECK_TIME", DEFAULT_STALL_WARNING_TIME)

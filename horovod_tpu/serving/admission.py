@@ -9,11 +9,28 @@ failed with `DeadlineExceededError` the moment the dispatcher would
 otherwise have started work it can no longer finish in time, and a
 cancelled request is dropped at the next pop. Nothing here blocks the
 submitting thread beyond one mutex.
+
+Since the overload control plane landed (docs/serving.md "Overload
+control"), the queue is no longer one FIFO: requests carry a
+``priority`` (higher preempts lower at the block pool) and a
+``tenant`` (the fairness/SLO isolation domain), and the queue keeps
+one lane per (priority, tenant) pair. Selection is priority bands
+first, then weighted fair queuing across tenants inside the band
+(virtual-time accounting: each pop charges the tenant 1/weight, the
+smallest virtual time goes next), with anti-starvation aging — a head
+older than ``aging_s`` is served oldest-first REGARDLESS of band, so
+a low-priority tenant under sustained high-priority load is delayed,
+never starved. When explicit tenant weights are configured
+(``HVD_TENANT_WEIGHTS``), each configured tenant's queue share is
+also capped at its weight fraction of ``max_depth`` — one tenant's
+burst sheds against its own share, not the fleet's. Single-tenant
+default-priority traffic degenerates to the old FIFO exactly.
 """
 
 from __future__ import annotations
 
 import collections
+import math
 import threading
 import time
 from concurrent.futures import CancelledError, InvalidStateError
@@ -115,6 +132,14 @@ class Request:
     # rng_skip), so the continuation is bitwise the original's.
     forced: tuple = ()
     tokens: List[int] = field(default_factory=list)  # generated so far
+    # Overload control plane (docs/serving.md "Overload control"):
+    # priority orders admission bands and bounds preemption (victims
+    # are strictly LOWER-priority than the blocked head); tenant names
+    # the WFQ lane, the shed-share cap and the per-tenant SLO domain.
+    # Defaults put everyone in one best-effort lane — single-tenant
+    # callers see plain FIFO.
+    priority: int = 0
+    tenant: str = ""
     _cancel: threading.Event = field(default_factory=threading.Event)
     # Set by AdmissionQueue.offer/requeue: lets cancel() release the
     # queue slot IMMEDIATELY instead of at the next dispatcher sweep
@@ -157,20 +182,45 @@ class Request:
 
 
 class AdmissionQueue:
-    """Bounded FIFO between `submit()` and the dispatch thread.
+    """Bounded priority/WFQ queue between `submit()` and the dispatch
+    thread.
 
     `offer` never blocks (full ⇒ `QueueFullError`); `pop_ready` is the
     dispatcher's non-blocking take that resolves dead requests
     (cancelled / deadline-expired) on the way instead of wasting a
     prefill on them; `wait` parks the idle dispatcher until work (or
-    shutdown) arrives.
+    shutdown) arrives. Internally one deque lane per
+    (priority, tenant): selection is aged-head-first (anti-starvation,
+    oldest wins globally once past ``aging_s``), then highest priority
+    band, then the tenant with the smallest WFQ virtual time inside
+    the band (each pop charges 1/weight). With no priorities, tenants
+    or weights in play there is exactly one lane and every method
+    behaves as the original FIFO did.
     """
 
-    def __init__(self, max_depth: int):
+    def __init__(self, max_depth: int, *,
+                 tenant_weights: Optional[dict] = None,
+                 aging_s: Optional[float] = 5.0):
         if max_depth < 1:
             raise ValueError(f"max_depth must be >= 1, got {max_depth}")
         self.max_depth = max_depth
-        self._q: collections.deque = collections.deque()
+        for t, w in (tenant_weights or {}).items():
+            if not w > 0:
+                raise ValueError(
+                    f"tenant weight must be > 0, got {t!r}={w!r}")
+        self._weights = dict(tenant_weights or {})
+        # None disables aging (pure priority/WFQ order).
+        self.aging_s = aging_s
+        # (priority, tenant) -> deque of Requests, oldest left. Lanes
+        # are created on first offer and deleted when empty so
+        # selection iterates live lanes only.
+        self._lanes: dict = {}
+        self._n = 0
+        # WFQ virtual-time accounting: per-tenant finish tags plus the
+        # global virtual clock lanes re-anchor to when they go idle
+        # (an idle tenant must not bank unbounded credit).
+        self._vtime: dict = {}
+        self._vclock = 0.0
         self._lock = threading.Lock()
         self._event = threading.Event()
         self._closed = False
@@ -181,7 +231,7 @@ class AdmissionQueue:
         self.on_drop = None
 
     def __len__(self) -> int:
-        return len(self._q)
+        return self._n
 
     def snapshot(self) -> List[Request]:
         """The queued requests, oldest first — a consistent copy for
@@ -189,7 +239,61 @@ class AdmissionQueue:
         provider). The Requests themselves stay live; callers must
         not mutate them."""
         with self._lock:
-            return list(self._q)
+            reqs = [r for dq in self._lanes.values() for r in dq]
+        return sorted(reqs, key=lambda r: (r.t_submit, r.id))
+
+    # -- WFQ internals (lock held) ------------------------------------
+
+    def _tenant_cap(self, tenant: str) -> Optional[int]:
+        """Queue-share cap for a CONFIGURED tenant: its weight
+        fraction of max_depth (>= 1 so a configured tenant can always
+        queue something). Unconfigured tenants are bounded only by
+        the global depth — caps exist to stop a named tenant's burst
+        from squeezing the others, not to strand capacity."""
+        if not self._weights or tenant not in self._weights:
+            return None
+        total = sum(self._weights.values())
+        share = self.max_depth * self._weights[tenant] / total
+        return max(1, math.ceil(share))
+
+    def _tenant_depth(self, tenant: str) -> int:
+        return sum(len(dq) for (_, t), dq in self._lanes.items()
+                   if t == tenant)
+
+    def _select_locked(self, now: float):
+        """The lane to serve next, or None when empty. Aged heads win
+        globally oldest-first (starvation-freedom: every queued
+        request's age only grows, so it eventually becomes the oldest
+        aged head and is served); otherwise highest priority band,
+        then smallest tenant virtual time, then tenant name."""
+        best_aged = None
+        best = None
+        for key, dq in self._lanes.items():
+            if not dq:
+                continue
+            prio, tenant = key
+            head = dq[0]
+            if (self.aging_s is not None
+                    and now - head.t_submit >= self.aging_s):
+                cand = (head.t_submit, -prio, tenant)
+                if best_aged is None or cand < best_aged[0]:
+                    best_aged = (cand, key)
+            v = max(self._vtime.get(tenant, 0.0), self._vclock)
+            cand = (-prio, v, tenant)
+            if best is None or cand < best[0]:
+                best = (cand, key)
+        if best_aged is not None:
+            return best_aged[1]
+        return None if best is None else best[1]
+
+    def _charge_locked(self, tenant: str):
+        """One pop's WFQ charge: advance the tenant's virtual finish
+        tag by 1/weight from max(own tag, virtual clock) — the
+        re-anchor forgets credit a lane banked while idle."""
+        w = float(self._weights.get(tenant, 1.0))
+        v = max(self._vtime.get(tenant, 0.0), self._vclock)
+        self._vclock = v
+        self._vtime[tenant] = v + 1.0 / w
 
     @property
     def closed(self) -> bool:
@@ -200,11 +304,20 @@ class AdmissionQueue:
             if self._closed:
                 raise EngineClosedError(
                     "engine is shut down; submit rejected")
-            if len(self._q) >= self.max_depth:
+            cap = self._tenant_cap(req.tenant)
+            if cap is not None and self._tenant_depth(req.tenant) >= cap:
+                raise QueueFullError(
+                    f"tenant {req.tenant!r} queue share full "
+                    f"({cap} of {self.max_depth}); request "
+                    f"{req.id} shed")
+            if self._n >= self.max_depth:
                 raise QueueFullError(
                     f"admission queue full ({self.max_depth} requests "
                     f"waiting); request {req.id} shed")
-            self._q.append(req)
+            lane = self._lanes.setdefault(
+                (req.priority, req.tenant), collections.deque())
+            lane.append(req)
+            self._n += 1
             # Armed under the lock so a cancel landing after submit
             # returns finds the request already discardable.
             req._on_cancel = self._discard_cancelled
@@ -217,11 +330,18 @@ class AdmissionQueue:
         capacity against live traffic. No-op if the dispatcher already
         popped it (the running-request cancel path retires it at the
         next tick as before)."""
+        key = (req.priority, req.tenant)
         with self._lock:
+            dq = self._lanes.get(key)
+            if dq is None:
+                return   # lane gone — the dispatcher owns the request
             try:
-                self._q.remove(req)
+                dq.remove(req)
             except ValueError:
                 return   # already popped/swept — the dispatcher owns it
+            self._n -= 1
+            if not dq:
+                del self._lanes[key]
         self._resolve_dead(req, "cancelled", time.time(), self.on_drop)
 
     @staticmethod
@@ -245,17 +365,27 @@ class AdmissionQueue:
         the head are removed and resolved inline either way; the
         first live one is returned, removed only when ``pop``.
         Single-consumer contract (the dispatch thread) — submitters
-        only ever append, so a peeked head stays the head until this
-        thread pops it (or it dies)."""
+        only ever append, so a peeked head stays selected until this
+        thread pops it, it dies, or a NEW offer changes the selection
+        (the scheduler's peek-check-pop admission gate tolerates the
+        pop returning a different, higher-ranked request: `admit`
+        returning None requeues it at the front of its lane)."""
         while True:
             with self._lock:
-                if not self._q:
+                if not self._n:
                     self._event.clear()
                     return None
-                req = self._q[0]
+                key = self._select_locked(now)
+                dq = self._lanes[key]
+                req = dq[0]
                 dead = req.cancelled or req.expired(now)
                 if dead or pop:
-                    self._q.popleft()
+                    dq.popleft()
+                    self._n -= 1
+                    if not dq:
+                        del self._lanes[key]
+                    if not dead:
+                        self._charge_locked(key[1])
             if not dead:
                 return req
             self._resolve_dead(
@@ -292,7 +422,10 @@ class AdmissionQueue:
             doomed = list(reqs) if self._closed else []
             if not self._closed:
                 for r in reversed(reqs):
-                    self._q.appendleft(r)
+                    lane = self._lanes.setdefault(
+                        (r.priority, r.tenant), collections.deque())
+                    lane.appendleft(r)
+                    self._n += 1
                     r._on_cancel = self._discard_cancelled
         for req in doomed:
             if not req.future.done():
@@ -310,10 +443,11 @@ class AdmissionQueue:
         deadlines were tightened."""
         with self._lock:
             n = 0
-            for r in self._q:
-                if r.deadline is None or r.deadline > now:
-                    r.deadline = now
-                    n += 1
+            for dq in self._lanes.values():
+                for r in dq:
+                    if r.deadline is None or r.deadline > now:
+                        r.deadline = now
+                        n += 1
         return n
 
     def sweep(self, now: float, on_drop=None) -> int:
@@ -323,12 +457,22 @@ class AdmissionQueue:
         free before its future resolves (the never-hang contract with
         every slot busy). Returns how many were resolved."""
         with self._lock:
-            dead = [r for r in self._q
-                    if r.cancelled or r.expired(now)]
-            if dead:
-                gone = set(map(id, dead))
-                self._q = collections.deque(
-                    r for r in self._q if id(r) not in gone)
+            dead = []
+            for key in list(self._lanes):
+                dq = self._lanes[key]
+                doomed = [r for r in dq
+                          if r.cancelled or r.expired(now)]
+                if not doomed:
+                    continue
+                dead.extend(doomed)
+                gone = set(map(id, doomed))
+                kept = collections.deque(
+                    r for r in dq if id(r) not in gone)
+                self._n -= len(doomed)
+                if kept:
+                    self._lanes[key] = kept
+                else:
+                    del self._lanes[key]
         for req in dead:
             self._resolve_dead(
                 req, "cancelled" if req.cancelled else "timeout",
@@ -347,9 +491,11 @@ class AdmissionQueue:
         dispatcher keeps popping until empty."""
         with self._lock:
             self._closed = True
-            doomed = [] if drain else list(self._q)
+            doomed = ([] if drain else
+                      [r for dq in self._lanes.values() for r in dq])
             if not drain:
-                self._q.clear()
+                self._lanes.clear()
+                self._n = 0
         for req in doomed:
             req.future.set_exception(EngineClosedError(
                 f"engine shut down before request {req.id} started"))

@@ -266,7 +266,8 @@ class DisaggRouter(ServingRouter):
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0,
                top_p: Optional[float] = None, seed: int = 0,
-               timeout_s: Optional[float] = None) -> RouterHandle:
+               timeout_s: Optional[float] = None,
+               priority: int = 0, tenant: str = "") -> RouterHandle:
         with self._lock:
             if self._closing:
                 raise EngineClosedError(
@@ -277,20 +278,23 @@ class DisaggRouter(ServingRouter):
             # A 1-token request IS its prefill — nothing to hand off.
             return super().submit(
                 prompt, max_new_tokens, temperature=temperature,
-                top_p=top_p, seed=seed, timeout_s=timeout_s)
+                top_p=top_p, seed=seed, timeout_s=timeout_s,
+                priority=priority, tenant=tenant)
         rep = self._pick_prefill()
         if rep is None:
             self._dm["fallbacks"].inc(reason="no_prefill_capacity")
             self._dcount("disagg_fallbacks")
             return super().submit(
                 prompt, max_new_tokens, temperature=temperature,
-                top_p=top_p, seed=seed, timeout_s=timeout_s)
+                top_p=top_p, seed=seed, timeout_s=timeout_s,
+                priority=priority, tenant=tenant)
         now = time.time()
         rr = _RouterRequest(
             next(self._req_ids), prompt, max_new_tokens,
             temperature=temperature, top_p=top_p, seed=seed,
             deadline=None if timeout_s is None else now + timeout_s,
-            trace_id=_tracing.new_trace_id(), t_submit=now)
+            trace_id=_tracing.new_trace_id(), t_submit=now,
+            priority=priority, tenant=tenant)
         rr._disagg = True
         rr._transfer = None
         with self._lock:
@@ -300,7 +304,8 @@ class DisaggRouter(ServingRouter):
             handle = rep.engine.submit(
                 rr.prompt, 1, temperature=temperature, top_p=top_p,
                 seed=seed, timeout_s=timeout_s,
-                trace_id=rr.trace_id)
+                trace_id=rr.trace_id,
+                priority=priority, tenant=tenant)
         except (QueueFullError, EngineClosedError):
             # The prefill tier shed — degrade to the shared-program
             # path rather than failing admission the decode tier
@@ -311,7 +316,8 @@ class DisaggRouter(ServingRouter):
             self._dcount("disagg_fallbacks")
             return super().submit(
                 prompt, max_new_tokens, temperature=temperature,
-                top_p=top_p, seed=seed, timeout_s=timeout_s)
+                top_p=top_p, seed=seed, timeout_s=timeout_s,
+                priority=priority, tenant=tenant)
         except ValueError:
             with self._lock:
                 self._requests.pop(rr.id, None)

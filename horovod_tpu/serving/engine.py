@@ -307,7 +307,11 @@ class ServingEngine:
                  paged_kernel: Optional[str] = None,
                  spec_draft=None, spec_k: Optional[int] = None,
                  weight_quant: Optional[str] = None,
-                 slo=None):
+                 slo=None,
+                 preempt: Optional[bool] = None,
+                 swap_bytes: Optional[int] = None,
+                 tenant_weights=None,
+                 brownout: Optional[bool] = None):
         if eos_id is not None and not 0 <= eos_id < model.vocab_size:
             raise ValueError(
                 f"eos_id must be in [0, vocab_size={model.vocab_size}"
@@ -429,7 +433,48 @@ class ServingEngine:
         # Hot-path compiles = pool compiles past this baseline.
         self._compile_baseline = self.pool.compiles
         self.metrics.observe_pipeline(self.pipeline_depth)
-        self.queue = AdmissionQueue(max_queue)
+        # Overload control plane (docs/serving.md "Overload control").
+        # Priority + weighted-fair admission is always on (an
+        # unconfigured queue is plain FIFO — every tenant weighs 1 and
+        # every request is priority 0, bitwise the old order); the
+        # PREEMPTION plane (HVD_PREEMPT) and the brownout ladder
+        # (HVD_BROWNOUT) are opt-in/out knobs.
+        from horovod_tpu.serving.overload import (
+            BrownoutController, OverloadControl, SwapStore,
+            parse_tenant_weights)
+        from horovod_tpu.runtime.config import config as _cfg
+        if tenant_weights is None:
+            weights = parse_tenant_weights(_cfg.tenant_weights)
+        elif isinstance(tenant_weights, str):
+            weights = parse_tenant_weights(tenant_weights)
+        else:
+            weights = dict(tenant_weights)
+        self._tenant_weights = weights
+        self.queue = AdmissionQueue(max_queue, tenant_weights=weights)
+        self.preempt = bool(_cfg.preempt if preempt is None
+                            else preempt)
+        self._overload = None
+        if self.preempt:
+            swap = None
+            if self.paged and self.pool.blocks.prefix_cache:
+                sb = int(_cfg.swap_bytes if swap_bytes is None
+                         else swap_bytes)
+                if sb > 0:
+                    swap = SwapStore(sb)
+            if self.paged:
+                # Optimistic (watermark) admission: reserve one
+                # block of decode headroom instead of the worst case
+                # — safe ONLY because overflow now preempts (the
+                # scheduler grows chains just-in-time and resolves
+                # stranded lanes) instead of deadlocking.
+                self.pool.blocks.watermark = self.pool.block_size
+            self._overload = OverloadControl(preempt=True, swap=swap)
+        self.brownout = None
+        if bool(_cfg.brownout if brownout is None else brownout):
+            self.brownout = BrownoutController(
+                slo=self.slo, metrics=self.metrics,
+                on_level=self._apply_brownout)
+        self._obs_tenant = _obs_catalog.tenant_metrics()
         # Disaggregated serving inbox (serving/transfer.py): inbound
         # KV-block transfers, appended by `offer_transfer` from any
         # thread, drained on the dispatch thread. Survives watchdog
@@ -440,7 +485,8 @@ class ServingEngine:
             self.pool, self.queue, self.metrics, eos_id=eos_id,
             stall=self.stall,
             prefill_chunk_budget=self.prefill_chunk_budget,
-            pipeline_depth=self.pipeline_depth, grafts=self._grafts)
+            pipeline_depth=self.pipeline_depth, grafts=self._grafts,
+            overload=self._overload)
         self._ids = itertools.count()
         self._lock = threading.Lock()
         self._closing = False
@@ -542,6 +588,29 @@ class ServingEngine:
                 "healthy": alive and not self._closing,
             }
 
+    # -- overload control ---------------------------------------------
+
+    def _apply_brownout(self, tenant: str, old: int, new: int):
+        """The brownout ladder's teeth (`BrownoutController.on_level`,
+        dispatch thread). Level 1 is enforced at the router via
+        `hedge_allowed`; level 2 caps speculative k ENGINE-WIDE
+        (bitwise-safe: greedy speculative decoding is token-exact for
+        any k, so capping mid-stream sheds draft compute without
+        changing a single emitted token); level 3 queues the tenant
+        for a lowest-priority preemption at the next scheduler step."""
+        if self.spec_k:
+            self.pool.spec_cap = (
+                max(1, self.spec_k // 2)
+                if self.brownout.max_level() >= 2 else None)
+        if new >= 3 and self._overload is not None:
+            self._overload.tenant_preempts.append(tenant)
+
+    def hedge_allowed(self, tenant: str = "") -> bool:
+        """Router hook: False while ``tenant`` sits at brownout level
+        >= 1 — hedging a burning tenant amplifies exactly the load
+        that is burning it."""
+        return self.brownout is None or self.brownout.level(tenant) < 1
+
     # -- submit side --------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *,
@@ -549,13 +618,22 @@ class ServingEngine:
                top_p: Optional[float] = None, seed: int = 0,
                timeout_s: Optional[float] = None,
                forced_prefix=None,
-               trace_id: Optional[str] = None) -> RequestHandle:
+               trace_id: Optional[str] = None,
+               priority: int = 0,
+               tenant: str = "") -> RequestHandle:
         """Enqueue one generation request; returns immediately.
 
         Raises `QueueFullError` when the admission queue is at
         capacity (load shedding — never blocks the caller) and
         `EngineClosedError` after shutdown. Validation errors raise
         before the request is queued.
+
+        ``priority`` (higher = more important, default 0) orders
+        admission in strict bands and decides preemption eligibility
+        (a blocked higher-priority head may evict strictly
+        lower-priority streams when HVD_PREEMPT is on). ``tenant``
+        names the submitter's WFQ lane / SLO bucket; "" is the
+        untenanted default lane.
 
         ``forced_prefix`` is the token-exact continuation hook
         (docs/serving.md "Fleet failover"): tokens a previous engine
@@ -628,7 +706,12 @@ class ServingEngine:
             # A request whose WORST-CASE block need exceeds the whole
             # pool could never admit — it would park at the queue head
             # starving everything behind it. Shed at the front door
-            # instead (the degrade-by-shedding contract).
+            # instead (the degrade-by-shedding contract). The need is
+            # NET of the forced prefix: a token-exact resume
+            # (migration, preemption) can only generate
+            # max_new - len(forced) more tokens, so counting max_new
+            # raw would falsely shed resumes of large near-complete
+            # streams.
             raise ValueError(
                 f"prompt ({P}) + max_new_tokens ({max_new_tokens}) "
                 f"needs more KV blocks than the paged pool holds "
@@ -647,23 +730,32 @@ class ServingEngine:
             deadline=None if timeout_s is None else now + timeout_s,
             future=Future(),
             trace_id=trace_id or _tracing.new_trace_id(),
-            t_submit=now, forced=forced, tokens=list(forced))
+            t_submit=now, forced=forced, tokens=list(forced),
+            priority=int(priority), tenant=str(tenant))
         self.metrics.count("submitted")
+        if req.tenant:
+            if self.brownout is not None:
+                self.brownout.touch(req.tenant)
+            self._obs_tenant["requests"].inc(tenant=req.tenant,
+                                             outcome="submitted")
         _span("begin_span", req.id, "QUEUE", trace_id=req.trace_id)
         try:
             self.queue.offer(req)
         except QueueFullError:
             self.metrics.count("rejected")
-            self.metrics.observe_admission(False)
+            self.metrics.observe_admission(False, tenant=req.tenant)
+            if req.tenant:
+                self._obs_tenant["requests"].inc(tenant=req.tenant,
+                                                 outcome="shed")
             _span("end_span", req.id, "QUEUE")
             _events.emit("serving.shed", request_id=req.id,
-                         trace_id=req.trace_id,
+                         trace_id=req.trace_id, tenant=req.tenant,
                          queue_depth=len(self.queue))
             raise
         except EngineClosedError:
             _span("end_span", req.id, "QUEUE")
             raise
-        self.metrics.observe_admission(True)
+        self.metrics.observe_admission(True, tenant=req.tenant)
         _events.emit("serving.submit", request_id=req.id,
                      trace_id=req.trace_id,
                      prompt_tokens=P, max_new_tokens=max_new_tokens)
@@ -717,6 +809,17 @@ class ServingEngine:
                 if self.paged:
                     self.metrics.observe_kv(
                         scheduler.pool.kv_stats())
+                # Brownout control loop: evaluated here on the
+                # dispatch thread (internally rate-limited) so the
+                # ladder's teeth — spec-k caps, tenant preemption
+                # mailbox — touch pool state only where jax work is
+                # allowed to happen.
+                if self.brownout is not None:
+                    self.brownout.step()
+                if (self._overload is not None
+                        and self._overload.swap is not None):
+                    self.metrics.observe_swap_store(
+                        self._overload.swap.stats())
                 if closing:
                     if not drain:
                         scheduler.abort_active()
@@ -879,11 +982,16 @@ class ServingEngine:
         # Fresh device state: the old pool's cache is mid-unknown-
         # tick; compiled programs are shared so this is cheap.
         self.pool = self.pool.clone_fresh()
+        # The overload plane survives the restart: the swap shelf's
+        # entries are HOST bytes, so a stream preempted-to-swap before
+        # the crash still restores into the successor pool (clone_fresh
+        # carries the watermark and spec cap).
         self.scheduler = ContinuousBatchingScheduler(
             self.pool, self.queue, self.metrics, eos_id=self.eos_id,
             stall=self.stall,
             prefill_chunk_budget=self.prefill_chunk_budget,
-            pipeline_depth=self.pipeline_depth, grafts=self._grafts)
+            pipeline_depth=self.pipeline_depth, grafts=self._grafts,
+            overload=self._overload)
         with self._lock:
             self._heartbeat = time.time()
             self._thread = threading.Thread(
@@ -995,6 +1103,10 @@ class ServingEngine:
         snap["compiles"] = self.pool.compiles - self._compile_baseline
         snap["warmup_compiles"] = ((self.warmup_info or {})
                                    .get("compiles", 0))
+        if self._overload is not None and self._overload.swap is not None:
+            snap["swap_store"] = self._overload.swap.stats()
+        if self.brownout is not None:
+            snap["brownout"] = self.brownout.summary()
         return snap
 
     @property

@@ -122,6 +122,7 @@ class EngineMetrics:
         self._engine_label = str(engine_label)
         self._obs = _obs_catalog.serving_metrics()
         self._obs_res = _obs_catalog.resilience_metrics()
+        self._obs_pre = _obs_catalog.preempt_metrics()
         # Counters.
         self.submitted = 0
         self.rejected = 0          # shed at the full queue
@@ -166,6 +167,17 @@ class EngineMetrics:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.spec_multi_token_ticks = 0
+        # Overload control plane (docs/serving.md "Overload control"):
+        # token-exact preemption, swap-shelf traffic and the brownout
+        # ladder — the evidence ci.sh --preempt-check asserts on.
+        self.preemptions_swap = 0
+        self.preemptions_recompute = 0
+        self.preempt_tokens_recomputed = 0
+        self.preempt_tokens_swapped_in = 0
+        self.preempt_swap_bytes = 0
+        self.preempt_swap_restore_failures = 0
+        self.brownout_transitions = 0
+        self.hedges_suppressed = 0
         # Gauges (set by the engine each loop).
         self.queue_depth = 0
         self.slots_busy = 0
@@ -225,8 +237,18 @@ class EngineMetrics:
                       "prefix_evictions", "prefill_tokens_skipped",
                       "spec_proposed", "spec_accepted"):
             self._obs[name].inc(n)
+        elif name == "preemptions_swap":
+            self._obs_pre["preemptions"].inc(n, mode="swap")
+        elif name == "preemptions_recompute":
+            self._obs_pre["preemptions"].inc(n, mode="recompute")
+        elif name == "preempt_tokens_recomputed":
+            self._obs_pre["tokens"].inc(n, kind="recomputed")
+        elif name == "preempt_tokens_swapped_in":
+            self._obs_pre["tokens"].inc(n, kind="swapped_in")
+        elif name == "preempt_swap_bytes":
+            self._obs_pre["swap_bytes"].inc(n)
 
-    def observe_admission(self, admitted: bool):
+    def observe_admission(self, admitted: bool, *, tenant: str = ""):
         """One admission decision into the SLO shed-rate objective
         (bad = shed). Called by `submit` AFTER the queue answered, so
         a shed request contributes exactly one (bad) event — counting
@@ -234,7 +256,12 @@ class EngineMetrics:
         (record() of an undeclared objective is a no-op, so a
         ttft-only monitor costs nothing here.)"""
         if self._slo is not None:
-            self._slo.record("shed", good=admitted)
+            # tenant kwarg only when tenanted: a bare record() keeps
+            # working against pre-tenant monitor stubs.
+            if tenant:
+                self._slo.record("shed", good=admitted, tenant=tenant)
+            else:
+                self._slo.record("shed", good=admitted)
 
     def observe_peak(self, active: int):
         """High-water mark of concurrently resident sequences."""
@@ -306,9 +333,21 @@ class EngineMetrics:
                 self._obs["slot_occupancy"].set(
                     slots_busy / num_slots, engine=eng)
 
+    def observe_swap_store(self, stats: Dict):
+        """Swap-shelf occupancy gauges (SwapStore.stats()), refreshed
+        by the dispatch loop alongside the KV gauges."""
+        eng = self._engine_label
+        with self._lock:
+            if self._closed:
+                return
+            self._obs_pre["swap_store_bytes"].set(
+                stats["bytes_used"], engine=eng)
+            self._obs_pre["swap_store_entries"].set(
+                stats["entries"], engine=eng)
+
     def observe_request(self, *, t_submit: float, t_prefill: float,
                         t_first: float, t_done: float, n_tokens: int,
-                        trace_id: str = ""):
+                        trace_id: str = "", tenant: str = ""):
         """Fold one finished request into the series (called by the
         dispatcher at retire time, successful finishes only).
         ``trace_id`` becomes the shared-registry histograms' exemplar
@@ -329,11 +368,14 @@ class EngineMetrics:
         self._obs["e2e"].observe(t_done - t_submit, exemplar=ex)
         if self._slo is not None:
             # The latency objectives' feed (obs/slo.py): each retired
-            # request is one good/bad event per declared objective.
-            self._slo.record("ttft", t_first - t_submit)
+            # request is one good/bad event per declared objective
+            # (tenant kwarg only when tenanted — see
+            # observe_admission).
+            kw = {"tenant": tenant} if tenant else {}
+            self._slo.record("ttft", t_first - t_submit, **kw)
             if n_tokens > 1:
                 self._slo.record(
-                    "tpot", (t_done - t_first) / (n_tokens - 1))
+                    "tpot", (t_done - t_first) / (n_tokens - 1), **kw)
 
     def close(self):
         """Drop this engine's labeled gauge rows from the shared
@@ -354,6 +396,8 @@ class EngineMetrics:
                          "kv_blocks_free", "kv_blocks_used",
                          "kv_blocks_cached", "mesh_devices"):
                 self._obs[name].remove(engine=eng)
+            for name in ("swap_store_bytes", "swap_store_entries"):
+                self._obs_pre[name].remove(engine=eng)
             for i in range(self.mesh_devices):
                 for name in ("kv_blocks_free_shard",
                              "kv_blocks_used_shard",
@@ -410,6 +454,17 @@ class EngineMetrics:
                     round(self.spec_accepted / self.spec_proposed, 4)
                     if self.spec_proposed else None),
                 "spec_multi_token_ticks": self.spec_multi_token_ticks,
+                "preemptions_swap": self.preemptions_swap,
+                "preemptions_recompute": self.preemptions_recompute,
+                "preempt_tokens_recomputed":
+                    self.preempt_tokens_recomputed,
+                "preempt_tokens_swapped_in":
+                    self.preempt_tokens_swapped_in,
+                "preempt_swap_bytes": self.preempt_swap_bytes,
+                "preempt_swap_restore_failures":
+                    self.preempt_swap_restore_failures,
+                "brownout_transitions": self.brownout_transitions,
+                "hedges_suppressed": self.hedges_suppressed,
                 # Tokens retired per decode tick ACROSS ALL LANES,
                 # excluding the prefill-sampled first tokens (which
                 # cost no tick): ~busy-lane count without spec

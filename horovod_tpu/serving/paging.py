@@ -165,6 +165,18 @@ class BlockPool:
         # reservation clamps too.
         self.max_seq_tokens = max_seq_tokens
         self._on_evict = on_evict
+        # Overload control (docs/serving.md "Overload control"): when
+        # set, admission reserves blocks for only min(max_new,
+        # watermark) decode tokens instead of the worst case — the
+        # pool admits deeper at the same bytes, chains GROW on demand
+        # (`extend`, driven by `PagedSlotPool.grow_for_tick`), and a
+        # growth failure is resolved by preempting a victim instead of
+        # deadlocking. None (the default) keeps the original
+        # worst-case reservation: running sequences can never hit
+        # allocation failure mid-decode. Only the engine's preemption
+        # wiring may set this — optimistic admission WITHOUT a
+        # preemption path reintroduces the mid-decode failure mode.
+        self.watermark: Optional[int] = None
         # Descending so pop() hands out ascending ids (debuggability).
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
         self._ref: Dict[int, int] = {}          # active blocks only
@@ -207,6 +219,13 @@ class BlockPool:
 
     def blocks_of(self, key: int) -> List[int]:
         return list(self._seqs.get(key, ()))
+
+    def resident(self, digest: bytes) -> bool:
+        """Whether a full block with this content digest is resident
+        in the prefix cache — the scheduler's swap-restore check (a
+        shelved transfer whose blocks are all still resident needs no
+        re-graft)."""
+        return digest in self._cache
 
     def _needed(self, prompt_len: int, max_new: int) -> int:
         """Worst-case blocks for one request: the prompt plus every
@@ -305,13 +324,21 @@ class BlockPool:
         in_lru = sum(1 for bid in matched if bid in self._lru)
         return len(self._free) + len(self._lru) - in_lru
 
+    def _reserve_new(self, max_new: int) -> int:
+        """Decode tokens RESERVED at admission: the worst case, or the
+        optimistic watermark when one is set (preemption armed)."""
+        if self.watermark is None:
+            return max_new
+        return min(max_new, max(1, int(self.watermark)))
+
     def can_admit(self, prompt, max_new: int) -> bool:
         """Would `admit` succeed right now? Pure check (nothing
         allocated or pinned) — the scheduler's peek-side gate, so a
         request that doesn't fit stays at the queue head instead of
         churning pop/requeue."""
         matched, _ = self.match(prompt)
-        need = self._needed(len(prompt), max_new) - len(matched)
+        need = self._needed(
+            len(prompt), self._reserve_new(max_new)) - len(matched)
         return need <= self._headroom(matched)
 
     def admit(self, key: int, prompt, max_new: int) -> Optional[
@@ -327,7 +354,7 @@ class BlockPool:
         if key in self._seqs:
             raise ValueError(f"sequence key {key} already admitted")
         matched, queried = self.match(prompt)
-        total = self._needed(len(prompt), max_new)
+        total = self._needed(len(prompt), self._reserve_new(max_new))
         need = total - len(matched)
         if need > self._headroom(matched):
             return None
@@ -341,6 +368,30 @@ class BlockPool:
                          skipped=len(matched) * self.block_size,
                          matched_blocks=len(matched),
                          queried_blocks=queried)
+
+    def extend(self, key: int, total_tokens: int) -> bool:
+        """Grow lane ``key``'s chain to cover ``total_tokens``
+        positions (clamped to ``max_seq_tokens`` — the device drops
+        writes past the row anyway). The on-demand half of
+        watermark-based optimistic admission: True when the chain
+        already covers it or new blocks were allocated, False when
+        the pool is out of blocks (the lane is STRANDED — the caller
+        must preempt someone before dispatching its next write, else
+        the write lands in the null block and corrupts the stream)."""
+        chain = self._seqs.get(key)
+        if chain is None:
+            raise ValueError(f"sequence key {key} not admitted")
+        tokens = total_tokens
+        if self.max_seq_tokens is not None:
+            tokens = min(tokens, self.max_seq_tokens)
+        need = -(-tokens // self.block_size) - len(chain)
+        if need <= 0:
+            return True
+        if need > len(self._free) + len(self._lru):
+            return False
+        for _ in range(need):
+            chain.append(self._alloc_one())
+        return True
 
     def publish(self, key: int, prompt):
         """Register lane ``key``'s full prompt blocks in the prefix
@@ -606,6 +657,12 @@ class PagedSlotPool:
         self.maybe_compiling = False
         self._seen_shapes: set = set()
         self.compiles = 0
+        # Brownout rung >= 2 (docs/serving.md "Overload control"):
+        # caps the speculative k mid-stream. Greedy spec decode is
+        # bitwise-identical for ANY k, so the cap sheds draft compute
+        # without touching token streams; a new effective k compiles
+        # one extra program (the shape key includes it).
+        self.spec_cap: Optional[int] = None
 
     # -- shared plumbing (mirrors SlotPool) ---------------------------
 
@@ -644,6 +701,12 @@ class PagedSlotPool:
             spec_draft=self.spec_draft, spec_k=self.spec_k)
         fresh._seen_shapes = set(self._seen_shapes)
         fresh.compiles = self.compiles
+        # Overload-control knobs survive a watchdog restart: the
+        # engine armed them once at construction, and a fresh pool
+        # silently back on worst-case reservation would shrink
+        # admission depth mid-flight.
+        fresh.blocks.watermark = self.blocks.watermark
+        fresh.spec_cap = self.spec_cap
         return fresh
 
     def fill_indices(self) -> np.ndarray:
@@ -885,6 +948,54 @@ class PagedSlotPool:
             self._ticking.add(dst)
         return dst
 
+    # -- watermark growth (docs/serving.md "Overload control") --------
+
+    def _spec_k_eff(self) -> int:
+        """The speculative k actually dispatched: ``spec_k`` unless a
+        brownout cap shrinks it (floor 1 — a zero-k round is a plain
+        tick the spec scheduling path never dispatches)."""
+        if self.spec_cap is None:
+            return self.spec_k
+        return max(1, min(self.spec_k, int(self.spec_cap)))
+
+    def grow_for_tick(self) -> List[int]:
+        """Under watermark admission, grow every ticking lane's chain
+        to cover the positions its NEXT dispatch writes (one for a
+        plain tick, up to k+1 for a spec round) and mirror any new
+        blocks into the device block-table row. Returns the lanes
+        that could NOT be grown (pool dry) — STRANDED: the scheduler
+        must preempt before dispatching, because a write past the
+        chain lands in null block 0 and corrupts the stream (the
+        write is misplaced AND later attention reads of the position
+        read null garbage). No-op (fast) when watermark is unset:
+        worst-case admission already covered every position."""
+        if self.blocks.watermark is None or not self._ticking:
+            return []
+        bs = self.block_size
+        span = self._spec_k_eff() + 1 if self.spec_on else 1
+        cap = self.spec.blocks_per_seq * bs
+        stranded: List[int] = []
+        updates: List[Tuple[int, int, int]] = []
+        for slot in sorted(self._ticking):
+            est = int(self._est_fill[slot])
+            top = min(est + span, cap)
+            if top <= est:
+                continue
+            before = len(self.blocks.blocks_of(slot))
+            if not self.blocks.extend(slot, top):
+                stranded.append(slot)
+                continue
+            chain = self.blocks.blocks_of(slot)
+            for idx in range(before, len(chain)):
+                updates.append((slot, idx, chain[idx]))
+        if updates:
+            with self._ctx():
+                tbl = self._tables
+                for slot, idx, bid in updates:
+                    tbl = tbl.at[slot, idx].set(bid)
+                self._tables = tbl
+        return stranded
+
     # -- the tick (split for pipelining) ------------------------------
 
     @hot_path
@@ -941,7 +1052,7 @@ class PagedSlotPool:
         `SlotPool.spec_round` — same contract, paged target): returns
         ``(emitted [L, k+1], n_emit [L], proposed [L])`` numpy."""
         assert self.spec_on, "spec_round on a pool without spec_draft"
-        k = self.spec_k
+        k = self._spec_k_eff()
         for slot in list(self._ticking):
             est = int(self._est_fill[slot])
             top = min(est + k + 1,
@@ -949,7 +1060,7 @@ class PagedSlotPool:
             if est < top:
                 self._cow_span(slot, est, top)
         self.maybe_compiling = (
-            ("paged_spec_round",) not in self._seen_shapes)
+            ("paged_spec_round", k) not in self._seen_shapes)
         try:
             with self._ctx():
                 (self._pools, self._fills, self._drf_cache, emitted,
@@ -960,7 +1071,7 @@ class PagedSlotPool:
                     self._drf_cache, self._tables, self._fills,
                     self._toks, self._live, self._done, self._eos,
                     k, fused=self._fused)
-            self._note_shape(("paged_spec_round",))
+            self._note_shape(("paged_spec_round", k))
         finally:
             self.maybe_compiling = False
         emitted = np.asarray(emitted)  # hvd: disable=HVD001(the spec round's ONE designed sync — acceptance counts are data-dependent and every retired token rides this read; docs/serving.md)

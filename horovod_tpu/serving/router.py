@@ -164,7 +164,8 @@ class _RouterRequest:
     def __init__(self, rid: int, prompt, max_new_tokens: int, *,
                  temperature: float, top_p, seed: int,
                  deadline: Optional[float], trace_id: str,
-                 t_submit: float):
+                 t_submit: float, priority: int = 0,
+                 tenant: str = ""):
         self.id = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -174,6 +175,8 @@ class _RouterRequest:
         self.deadline = deadline
         self.trace_id = trace_id
         self.t_submit = t_submit
+        self.priority = int(priority)
+        self.tenant = str(tenant)
         self.future: Future = Future()
         self.attempts: List[_Attempt] = []
         self.done = False
@@ -309,6 +312,19 @@ class ServingRouter:
         self.max_replacements = int(max_replacements)
         self.backoff_s = float(backoff_s)
         self.budget = RetryBudget(retry_budget)
+        # Per-tenant retry-budget ISOLATION (docs/serving.md "Overload
+        # control"): tenants named in HVD_TENANT_WEIGHTS spend a
+        # PRIVATE bucket sized by weight share instead of the fleet
+        # bucket, so one tenant's retry storm cannot drain everyone
+        # else's budget. Unnamed tenants (and "") share the fleet
+        # bucket as before.
+        from horovod_tpu.serving.overload import parse_tenant_weights
+        _weights = parse_tenant_weights(_cfg.tenant_weights)
+        _total = sum(_weights.values())
+        self._tenant_budgets: Dict[str, RetryBudget] = (
+            {t: RetryBudget(max(1, round(retry_budget * w / _total)))
+             for t, w in _weights.items()}
+            if _total and retry_budget > 0 else {})
         self._m = _obs_catalog.router_metrics()
         # Router-LOCAL counters behind `metrics_snapshot()` (the shared
         # hvd_router_* families are process-global — a second router in
@@ -429,11 +445,14 @@ class ServingRouter:
     def submit(self, prompt, max_new_tokens: int, *,
                temperature: float = 0.0,
                top_p: Optional[float] = None, seed: int = 0,
-               timeout_s: Optional[float] = None) -> RouterHandle:
+               timeout_s: Optional[float] = None,
+               priority: int = 0, tenant: str = "") -> RouterHandle:
         """`ServingEngine.submit`'s surface, fleet-routed. Raises
         `QueueFullError` only once every routable replica shed AND the
         retry budget ran dry — the router's degrade-by-shedding edge —
-        and `EngineClosedError` after `shutdown()`."""
+        and `EngineClosedError` after `shutdown()`. ``priority`` /
+        ``tenant`` ride through every placement (hedges, migrations,
+        disagg legs) into the engine's priority bands and WFQ lanes."""
         with self._lock:
             if self._closing:
                 raise EngineClosedError(
@@ -443,7 +462,8 @@ class ServingRouter:
             next(self._req_ids), prompt, max_new_tokens,
             temperature=temperature, top_p=top_p, seed=seed,
             deadline=None if timeout_s is None else now + timeout_s,
-            trace_id=_tracing.new_trace_id(), t_submit=now)
+            trace_id=_tracing.new_trace_id(), t_submit=now,
+            priority=priority, tenant=tenant)
         # Registered BEFORE placement: a fast attempt can resolve (and
         # its callback pop this entry) before _place returns —
         # registering after would leak a done request in the table
@@ -543,7 +563,7 @@ class ServingRouter:
             if max_tries is not None and attempt_no >= max_tries:
                 return last_err
             if attempt_no > 0 or not first_free:
-                if not self.budget.try_spend():
+                if not self._spend_retry(rr.tenant):
                     # A cause marker, not a request outcome — the
                     # caller's path (submit/migrate) records what the
                     # request ultimately became, so the outcomes sum
@@ -596,7 +616,8 @@ class ServingRouter:
                     temperature=rr.temperature, top_p=rr.top_p,
                     seed=rr.seed, timeout_s=timeout_s,
                     forced_prefix=list(forced) or None,
-                    trace_id=rr.trace_id)
+                    trace_id=rr.trace_id,
+                    priority=rr.priority, tenant=rr.tenant)
             except (QueueFullError, EngineClosedError) as e:
                 last_err = e
                 tried.add(rep.id)
@@ -623,6 +644,14 @@ class ServingRouter:
                 lambda fut, rr=rr, a=attempt: self._attempt_done(
                     rr, a, fut))
             return None
+
+    def _spend_retry(self, tenant: str) -> bool:
+        """Spend one retry token from ``tenant``'s private bucket when
+        it has one (HVD_TENANT_WEIGHTS), else from the fleet bucket.
+        A named tenant with a dry bucket sheds — it does NOT fall
+        through to the fleet bucket, which is the isolation point."""
+        b = self._tenant_budgets.get(tenant)
+        return (b if b is not None else self.budget).try_spend()
 
     def _pre_place(self, rr: _RouterRequest, rep: "_Replica"):
         """Subclass hook, called just before each engine submit of
@@ -1091,6 +1120,24 @@ class ServingRouter:
                 if rr.done or not rr.attempts:
                     continue
                 primary = rr.attempts[0]
+                rep = self._replicas.get(primary.replica_id)
+            if (rep is not None and not getattr(
+                    rep.engine, "hedge_allowed", lambda t: True)(rr.tenant)):
+                # Brownout rung 1+ for this tenant: a hedge would
+                # DOUBLE the load the ladder is trying to shed, so the
+                # duplicate is suppressed — `hedged` stays latched
+                # (this request had its chance; re-probing every scan
+                # would defeat the suppression).
+                with self._lock:
+                    self._counts["hedges_suppressed"] = (
+                        self._counts.get("hedges_suppressed", 0) + 1)
+                _em = getattr(rep.engine, "metrics", None)
+                if _em is not None:
+                    _em.count("hedges_suppressed")
+                _events.emit("router.hedge_suppressed", request_id=rr.id,
+                             trace_id=rr.trace_id, tenant=rr.tenant,
+                             primary_replica=primary.replica_id)
+                continue
             # Best-effort duplicate: ONE free probe (max_tries=1 —
             # hedges are not retries; a shedding fleet must not park
             # the monitor in the backoff loop burning client budget
@@ -1271,10 +1318,14 @@ class ServingRouter:
             c = dict(self._counts)
         out = {"replicas": states, "inflight": n_requests,
                "retry_budget_tokens": round(self.budget.tokens, 2)}
+        if self._tenant_budgets:
+            out["tenant_budget_tokens"] = {
+                t: round(b.tokens, 2)
+                for t, b in self._tenant_budgets.items()}
         for key in ("completed", "failed", "shed", "cancelled",
                     "timed_out", "budget_exhausted", "retries",
-                    "hedges", "hedge_wins", "migrations",
-                    "migrated_tokens", "replica_deaths",
+                    "hedges", "hedge_wins", "hedges_suppressed",
+                    "migrations", "migrated_tokens", "replica_deaths",
                     "replacements"):
             out[key] = c.get(key, 0)
         return out

@@ -44,6 +44,7 @@ engine degrades by shedding, never by hanging.
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import threading
 import time
@@ -161,10 +162,19 @@ class ContinuousBatchingScheduler:
                  metrics: EngineMetrics, *,
                  eos_id: Optional[int] = None, stall=None,
                  prefill_chunk_budget: Optional[int] = None,
-                 pipeline_depth: int = 1, grafts=None):
+                 pipeline_depth: int = 1, grafts=None,
+                 overload=None):
         self.pool = pool
         self.queue = queue
         self.metrics = metrics
+        # Overload control plane (serving/overload.py): None keeps the
+        # pre-PR-17 behavior (admission blocks at the pool, nothing is
+        # ever evicted mid-stream). When set, a blocked higher-priority
+        # head may PREEMPT lower-priority decode lanes token-exactly —
+        # swap (KV blocks shelved host-side, re-grafted on resume) or
+        # recompute (forced-prefix replay) — and the brownout ladder's
+        # level-3 rung feeds `tenant_preempts`.
+        self._ov = overload
         # Disaggregated serving (serving/transfer.py): a deque of
         # inbound `BlockTransfer`s the engine's `offer_transfer`
         # appends from ANY thread (GIL-atomic append; all jax work
@@ -263,8 +273,21 @@ class ContinuousBatchingScheduler:
         # never pops the queue, and a 100 ms deadline must not wait
         # minutes for a slot to free.
         self.queue.sweep(now, on_drop=self._queue_drop)
+        # Dead MID-PREFILL requests release their reserved blocks NOW
+        # too — a cancelled/hedge-lost prefill must not sit on
+        # reserved-but-unfilled blocks until the chunk loop next picks
+        # it (which, budget-starved, could be many steps away).
+        self._sweep_dead_prefills(now)
+        self._drain_tenant_preempts(now)
         self._drain_grafts()
         progressed = self._advance_prefills(now)
+        # Watermark admission's collection point: reservations are
+        # optimistic (BlockPool watermark), so every ticking lane's
+        # chain is grown to cover the next dispatch BEFORE the write;
+        # lanes the pool cannot grow are resolved by preemption, never
+        # by letting a device write land in the null block.
+        if self.active:
+            self._resolve_stranded(now)
         if getattr(self.pool, "spec_on", False):
             # Speculative mode replaces the pipelined S=1 tick ring
             # with synchronous draft-verify ROUNDS: each round's one
@@ -439,9 +462,23 @@ class ContinuousBatchingScheduler:
                 # head, FIFO intact, until retirements free blocks.
                 head = self.queue.peek_ready(now,
                                              on_drop=self._queue_drop)
-                if head is None or not self.pool.can_admit(
-                        head.full_prompt, head.remaining_new):
+                if head is None:
                     break
+                # A swap-preempted head's shelved KV blocks are grafted
+                # back BEFORE can_admit hashes the prompt, so the
+                # resume's admission credits them (only the sub-block
+                # tail re-prefills).
+                self._maybe_restore_swap(head)
+                if not self.pool.can_admit(head.full_prompt,
+                                           head.remaining_new):
+                    # The overload plane's make-room move: evict
+                    # strictly lower-priority decode lanes until the
+                    # head fits (token-exact — victims resume later,
+                    # bitwise). Without it (or with no eligible
+                    # victim) the head waits, FIFO intact, as before.
+                    if not self._try_preempt_for(head, now):
+                        break
+                    continue
                 req = self.queue.pop_ready(now, on_drop=self._queue_drop)
                 if req is None:
                     break
@@ -483,6 +520,19 @@ class ContinuousBatchingScheduler:
                     self.queue.requeue([blocked])
                     break
                 req.prefix_cached = adm.skipped
+                if (self._ov is not None
+                        and self._ov.swap is not None
+                        and self._ov.swap.discard(req.id)):
+                    # A swap-preempted stream just resumed: its shelf
+                    # entry is spent. Credit the tokens the shelved
+                    # blocks served vs the sub-block tail that must
+                    # re-prefill anyway.
+                    self.metrics.count("preempt_tokens_swapped_in",
+                                       adm.skipped)
+                    tail = int(full.shape[0]) - adm.skipped
+                    if tail > 0:
+                        self.metrics.count(
+                            "preempt_tokens_recomputed", tail)
                 if adm.queried_blocks:
                     self.metrics.count("prefix_hits",
                                        adm.matched_blocks)
@@ -531,6 +581,218 @@ class ContinuousBatchingScheduler:
             if left is not None and left <= 0:
                 break
         return progressed
+
+    # -- preemption (the overload control plane) ----------------------
+
+    def _sweep_dead_prefills(self, now: float):
+        """Release reserved-but-unfilled blocks of cancelled/expired
+        MID-PREFILL requests immediately. The chunk loop checks the
+        head job's liveness, but a budget-starved schedule can leave a
+        dead job parked for many steps — and its admission reservation
+        (blocks never to be filled) parked with it, blocking admission
+        of live requests the whole while."""
+        with self._handoff:
+            jobs = ([] if self.abandoned else
+                    [(s, self.prefilling[s])
+                     for s in list(self._prefill_order)])
+        for slot, job in jobs:
+            if job.req.cancelled or job.req.expired(now):
+                self._retire_prefill(
+                    slot, job,
+                    "cancelled" if job.req.cancelled else "timeout")
+
+    def _drain_tenant_preempts(self, now: float):
+        """Brownout level 3: the engine's ladder callback queued tenant
+        names whose lowest-priority streams should shed. One lane per
+        request, and always leave the tenant at least one live stream —
+        brownout degrades, it never blacks out."""
+        ov = self._ov
+        if ov is None or not ov.tenant_preempts:
+            return
+        while ov.tenant_preempts:
+            try:
+                tenant = ov.tenant_preempts.popleft()
+            except IndexError:   # pragma: no cover — single drainer
+                break
+            lanes = [(s, r) for s, r in self.active.items()
+                     if r.tenant == tenant]
+            if len(lanes) <= 1:
+                continue
+            lanes.sort(key=lambda sr: (sr[1].priority,
+                                       len(sr[1].tokens), sr[0]))
+            slot, req = lanes[0]
+            self._preempt(slot, req, now, reason="brownout")
+
+    def _resolve_stranded(self, now: float):
+        """Grow every ticking lane's block chain to cover the next
+        dispatch (watermark admission reserves optimistically, so
+        growth happens here, just-in-time). A lane the pool cannot grow
+        is STRANDED — its next device write would land in the null
+        block — so victims are preempted until growth succeeds.
+        Guaranteed progress: the policy ranks over all active lanes and
+        a stranded lane is itself active, so in the worst case the
+        stranded lane is evicted and leaves the ticking set."""
+        ov = self._ov
+        grow = getattr(self.pool, "grow_for_tick", None)
+        if grow is None:
+            return
+        while not self.abandoned:
+            stranded = grow()
+            if not stranded:
+                return
+            if ov is None or not ov.preempt or not self.active:
+                # No preemption plane (watermark is only ever set by
+                # the engine's preemption wiring, so this is a
+                # defensive arm) — evict the stranded lanes themselves.
+                for slot in stranded:
+                    req = self.active.get(slot)
+                    if req is not None:
+                        self._preempt(slot, req, now,
+                                      reason="stranded")
+                return
+            victims = ov.policy.order_victims(None, self.active,
+                                              self.pool)
+            if not victims:   # pragma: no cover — stranded ⊆ active
+                return
+            slot, req = victims[0]
+            self._preempt(slot, req, now, reason="stranded")
+
+    def _maybe_restore_swap(self, head: Request):
+        """If the queue head is a swap-preempted resume, re-graft its
+        shelved KV blocks so the admission peek's prefix match credits
+        them. A graft that fails verification drops the shelf entry and
+        the resume degrades to recompute — bitwise the same stream
+        either way (the fallback ladder)."""
+        ov = self._ov
+        if ov is None or ov.swap is None:
+            return
+        tr = ov.swap.peek(head.id)
+        if tr is None:
+            return
+        graft = getattr(self.pool, "graft", None)
+        blocks = getattr(self.pool, "blocks", None)
+        if graft is None or blocks is None:
+            ov.swap.discard(head.id)
+            return
+        if all(blocks.resident(d) for d in tr.chain_digests):
+            return   # still resident from before the preempt — free
+        from horovod_tpu.serving.transfer import TransferError
+        try:
+            graft(tr)
+        except TransferError as e:
+            ov.swap.discard(head.id)
+            self.metrics.count("preempt_swap_restore_failures")
+            _events.emit("serving.swap_restore_failed",
+                         request_id=head.id, trace_id=head.trace_id,
+                         error=f"{type(e).__name__}: {e}")
+
+    def _try_preempt_for(self, head: Request, now: float) -> bool:
+        """Make room for a blocked higher-priority head by preempting
+        strictly lower-priority active lanes, cheapest-capacity-first
+        (`PreemptionPolicy`). True once `can_admit` passes; False when
+        preemption is off or no eligible victim remains (the head then
+        waits at the queue head, exactly the pre-PR-17 behavior)."""
+        ov = self._ov
+        if ov is None or not ov.preempt or not self.active:
+            return False
+        while not self.abandoned:
+            victims = ov.policy.order_victims(head, self.active,
+                                              self.pool)
+            if not victims:
+                return False
+            slot, req = victims[0]
+            self._preempt(slot, req, now, reason="priority")
+            if self.pool.can_admit(head.full_prompt,
+                                   head.remaining_new):
+                return True
+        return False
+
+    def _preempt(self, slot: int, req: Request, now: float,
+                 reason: str):
+        """Evict one ACTIVE decode lane token-exactly and requeue its
+        request to resume later, bitwise-identical to the
+        uninterrupted stream.
+
+        Two modes, decided here per victim:
+
+        * **swap** — the filled KV blocks of the finalized stream are
+          exported (PR 16 `export_blocks`: digest-chained host copy)
+          into the bounded `SwapStore`; on resume they re-graft and the
+          prefix match skips them, so only the sub-block tail
+          re-prefills. Needs the paged pool's prefix cache and shelf
+          budget; the stream is `publish`ed first so its full blocks
+          are registered (decode-extended blocks aren't, until now).
+        * **recompute** — no blocks survive; the resume teacher-forces
+          the whole emitted prefix through prefill (the PR-9 forced-
+          prefix path) and re-samples with `rng_skip`, token-exact.
+
+        Export safety: the victim has n >= 1 emitted tokens; the
+        in-flight pipelined tick (if any) writes KV position P+n-1
+        while sampling token n+1, so the export stream stops at
+        ``tokens[:-1]`` (positions <= P+n-2) — every full block it
+        covers is final, never racing the device write, even at the
+        ``(P+n-1) % block_size == 0`` boundary where the write opens a
+        NEW block. The lagged tick's token for this slot is discarded
+        by `_sync_pending`'s identity check once the lane is freed."""
+        ov = self._ov
+        mode = "recompute"
+        blocks = getattr(self.pool, "blocks", None)
+        stream = None
+        if (ov is not None and ov.swap is not None
+                and blocks is not None
+                and getattr(blocks, "prefix_cache", False)):
+            stream = np.concatenate([
+                # hvd: disable=HVD001(prompt is host-side admission-queue ids, never a device array — no sync)
+                np.asarray(req.prompt, dtype=np.int64),
+                # hvd: disable=HVD001(tokens is the host-side emitted-int list — no sync)
+                np.asarray(req.tokens[:-1], dtype=np.int64)])
+            if len(stream) // self.pool.block_size >= 1:
+                from horovod_tpu.serving.transfer import (
+                    TransferError, export_blocks)
+                blocks.publish(slot, stream)
+                tr = None
+                try:
+                    tr = export_blocks(self.pool, stream,
+                                       trace_id=req.trace_id)
+                except TransferError:
+                    tr = None
+                if tr is not None and ov.swap.put(req.id, tr):
+                    mode = "swap"
+                    self.metrics.count("preempt_swap_bytes",
+                                       tr.nbytes)
+        self.pool.free(slot)
+        # hvd: disable=HVD004(active is dispatch-thread-owned; the handoff lock only orders the container handoff, and abandon() snapshots wholesale)
+        self.active.pop(slot, None)
+        _span("end_span", req.id, "DECODE")
+        # The resume: everything emitted becomes forced prefix (teacher
+        # forced in prefill, rng_skip re-aligns the sampled stream) and
+        # stays in `tokens` so a cancel/expiry mid-queue still returns
+        # the partial text. `t_submit` is preserved — the admission
+        # queue's aging sees the victim's true age, so preemption never
+        # starves its own victims. `dataclasses.replace` keeps the
+        # same cancel Event and future (cancel races stay safe).
+        resumed = dataclasses.replace(
+            req,
+            forced=tuple(int(t) for t in req.tokens),
+            tokens=[int(t) for t in req.tokens],
+            t_prefill=0.0, t_first=0.0, prefix_cached=0)
+        self.queue.requeue([resumed])
+        self.metrics.count("preemptions_swap" if mode == "swap"
+                           else "preemptions_recompute")
+        if mode == "recompute":
+            # Every token of prompt+emitted re-prefills on resume
+            # (minus whatever the prefix cache happens to still hold —
+            # credited at the resume's admission instead for swaps).
+            self.metrics.count("preempt_tokens_recomputed",
+                               len(resumed.full_prompt))
+        if req.tenant:
+            from horovod_tpu.obs import catalog as _obs_catalog
+            _obs_catalog.tenant_metrics()["requests"].inc(
+                tenant=req.tenant, outcome="preempted")
+        _events.emit("serving.preempt", request_id=req.id,
+                     trace_id=req.trace_id, mode=mode, reason=reason,
+                     tenant=req.tenant, priority=req.priority,
+                     tokens_emitted=len(req.tokens))
 
     def _drain_grafts(self):
         """Ingest every queued KV-block transfer into the pool's
@@ -605,6 +867,8 @@ class ContinuousBatchingScheduler:
     def _queue_drop(self, req: Request, kind: str):
         """A queued request died before reaching a slot (cancelled or
         deadline-expired); its future already carries the exception."""
+        if self._ov is not None and self._ov.swap is not None:
+            self._ov.swap.discard(req.id)
         self.metrics.count("cancelled" if kind == "cancelled"
                            else "timed_out")
         _span("end_span", req.id, "QUEUE")
@@ -671,6 +935,12 @@ class ContinuousBatchingScheduler:
         self._finalize(job.req, reason, time.time())
 
     def _finalize(self, req: Request, reason: str, now: float):
+        if self._ov is not None and self._ov.swap is not None:
+            # A preempted-then-resumed stream that finishes (or dies)
+            # with its shelf entry unclaimed — e.g. the resume's blocks
+            # stayed resident so the entry was never spent — releases
+            # the swap budget here.
+            self._ov.swap.discard(req.id)
         tl = _timeline()
         if tl is not None:
             tl.mark(f"request:{req.id}", reason.upper())
@@ -683,7 +953,7 @@ class ContinuousBatchingScheduler:
             self.metrics.observe_request(
                 t_submit=req.t_submit, t_prefill=req.t_prefill,
                 t_first=req.t_first, t_done=now, n_tokens=n,
-                trace_id=req.trace_id)
+                trace_id=req.trace_id, tenant=req.tenant)
             self._resolve(req.future, result=CompletedRequest(
                 request_id=req.id,
                 # hvd: disable=HVD001(req.prompt is the submitted numpy array, req.tokens a host list — retire-time packaging, no device read)

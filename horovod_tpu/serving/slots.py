@@ -229,6 +229,11 @@ class SlotPool:
         # hot-path compiles (the "no compile in the timed window"
         # guarantee ci.sh asserts).
         self.compiles = 0
+        # Brownout rung >= 2 (docs/serving.md "Overload control"):
+        # caps the speculative k mid-stream — greedy spec decode is
+        # bitwise for ANY k, so the cap sheds draft compute without
+        # touching token streams (one extra compile per new k).
+        self.spec_cap = None
 
     @property
     def spec_on(self) -> bool:
@@ -267,6 +272,7 @@ class SlotPool:
         # are warm for the clone too (and the compile count carries,
         # so hot-path-compile accounting survives a restart).
         fresh._seen_shapes = set(self._seen_shapes)
+        fresh.spec_cap = self.spec_cap
         fresh.compiles = self.compiles
         return fresh
 
@@ -481,7 +487,9 @@ class SlotPool:
         data-dependent — the scheduler must see the tokens to retire
         and truncate), amortized over every retired token."""
         assert self.spec_on, "spec_round on a pool without spec_draft"
-        self.maybe_compiling = ("spec_round",) not in self._seen_shapes
+        k = self.spec_k if self.spec_cap is None \
+            else max(1, min(self.spec_k, int(self.spec_cap)))
+        self.maybe_compiling = ("spec_round", k) not in self._seen_shapes
         try:
             with self._ctx():
                 (self._cache, self._drf_cache, emitted, n_emit,
@@ -489,8 +497,8 @@ class SlotPool:
                     self.dec_model, self.drf_model, self.params,
                     self.drf_params, self._cache, self._drf_cache,
                     self._toks, self._live, self._done, self._eos,
-                    self.spec_k)
-            self._note_shape(("spec_round",))
+                    k)
+            self._note_shape(("spec_round", k))
         finally:
             self.maybe_compiling = False
         emitted = np.asarray(emitted)  # hvd: disable=HVD001(the spec round's ONE designed sync — acceptance counts are data-dependent and every retired token rides this read; docs/serving.md)
