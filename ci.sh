@@ -24,6 +24,29 @@ JAX_PLATFORMS=cpu python -m horovod_tpu.analysis \
 JAX_PLATFORMS=cpu python -m horovod_tpu.analysis --rules HVD005 \
     bench.py bench_daemon.py
 
+# Runtime lock witness (docs/analysis.md "The runtime witness"): the
+# dynamic half of HVD007. Re-run the lock-heaviest suites (serving
+# engine/router, resilience, elastic membership) with every registered
+# lock ARMED (HVD_LOCK_CHECK=1) — each acquisition feeds the witness's
+# order graph. The dump must show ZERO observed order inversions (an
+# inversion here is a deadlock the suite actually walked), and
+# tests/test_lockcheck.py separately pins that observed edges are a
+# subset of the static lock_order_graph.
+rm -f /tmp/hvd_lock_witness.json
+HVD_LOCK_CHECK=1 HVD_LOCK_CHECK_OUT=/tmp/hvd_lock_witness.json \
+    JAX_PLATFORMS=cpu python -m pytest -q \
+    tests/test_serving.py tests/test_router.py \
+    tests/test_resilience.py tests/test_membership.py
+python - <<'EOF'
+import json
+snap = json.load(open("/tmp/hvd_lock_witness.json"))
+assert snap["inversions"] == [], (
+    "lock witness observed order inversions:\n"
+    + json.dumps(snap["inversions"], indent=2))
+print(f"lock witness: {sum(len(v) for v in snap['edges'].values())} "
+      f"edge(s), 0 inversions")
+EOF
+
 # Compat matrix (the reference sweeps {py27/34/36} x {TF 1.1/1.4/
 # nightly} x {OpenMPI,MPICH} in .travis.yml; this image pins ONE real
 # generation — TF 2.21 / Keras 3 — so the other Keras generations'

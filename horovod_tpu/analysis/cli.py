@@ -5,8 +5,10 @@
     python -m horovod_tpu.analysis [paths...]
         [--baseline .hvdlint-baseline.json] [--write-baseline]
         [--json] [--rules HVD001,HVD004] [--list-rules]
+        [--changed-only]
         [--write-env-table [docs/troubleshooting.md]]
         [--write-chaos-table [docs/resilience.md]]
+        [--write-event-table [docs/observability.md]]
 
 Exit codes: 0 clean (all findings baselined), 1 findings, 2 usage or
 analysis error. Default target: the installed ``horovod_tpu`` package
@@ -14,13 +16,22 @@ tree. The baseline defaults to ``.hvdlint-baseline.json`` in the
 current directory for BOTH reading and ``--write-baseline`` (a missing
 file is an empty baseline), so the CI gate is just ``python -m
 horovod_tpu.analysis`` from the repo root.
+
+``--changed-only`` is the edit-loop accelerator: the WHOLE package is
+still parsed (the symbol table, the lock graph and the drift catalogs
+need every module), but findings are reported only for files changed
+vs the git merge-base (plus the working tree and untracked files) and
+for files that import a changed module — the blast radius of the
+edit. CI keeps the full walk.
 """
 
 from __future__ import annotations
 
 import argparse
+import ast
 import json
 import os
+import subprocess
 import sys
 
 from horovod_tpu.analysis import baseline as baseline_mod
@@ -31,6 +42,8 @@ _ENV_TABLE_BEGIN = "<!-- hvdlint:env-table:begin -->"
 _ENV_TABLE_END = "<!-- hvdlint:env-table:end -->"
 _CHAOS_TABLE_BEGIN = "<!-- hvdlint:chaos-table:begin -->"
 _CHAOS_TABLE_END = "<!-- hvdlint:chaos-table:end -->"
+_EVENT_TABLE_BEGIN = "<!-- hvdlint:event-table:begin -->"
+_EVENT_TABLE_END = "<!-- hvdlint:event-table:end -->"
 
 
 def _package_root() -> str:
@@ -42,14 +55,84 @@ def _repo_root() -> str:
     return os.path.dirname(_package_root())
 
 
-def analyze(paths, rules=None, root=None):
+def analyze(paths, rules=None, root=None, changed_only=False):
     """API twin of the CLI: (active, suppressed) findings for
-    ``paths`` (defaults: whole package, all rules)."""
+    ``paths`` (defaults: whole package, all rules). With
+    ``changed_only``, the full file set is still parsed and analyzed
+    but findings are restricted to `changed_scope`."""
     root = root or _repo_root()
     paths = list(paths) if paths else [_package_root()]
     files = collect_files(paths, root)
     project = Project(files)
-    return run_rules(project, rules or ALL_RULES), len(files)
+    active, muted = run_rules(project, rules or ALL_RULES)
+    if changed_only:
+        scope = changed_scope(project, root)
+        active = [f for f in active if f.path in scope]
+        muted = [f for f in muted if f.path in scope]
+    return (active, muted), len(files)
+
+
+def _git_changed_files(root):
+    """Repo-relative paths changed vs the merge-base with the default
+    branch, plus working-tree and untracked changes. Empty on any git
+    failure (not a repo, no main ref) — caller treats that as 'no
+    scope', exit 2."""
+    def _run(*args):
+        try:
+            out = subprocess.run(
+                ["git", "-C", root, *args], capture_output=True,
+                text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        return out.stdout if out.returncode == 0 else None
+
+    base = None
+    for ref in ("origin/main", "origin/master", "main", "master"):
+        got = _run("merge-base", "HEAD", ref)
+        if got:
+            base = got.strip()
+            break
+    changed = set()
+    diffs = [_run("diff", "--name-only", base)] if base else []
+    diffs.append(_run("diff", "--name-only", "HEAD"))
+    diffs.append(_run("ls-files", "--others", "--exclude-standard"))
+    saw_git = False
+    for out in diffs:
+        if out is None:
+            continue
+        saw_git = True
+        changed |= {ln.strip() for ln in out.splitlines() if ln.strip()}
+    return changed if saw_git else None
+
+
+def changed_scope(project, root):
+    """The ``--changed-only`` reporting scope: analyzed files changed
+    per git, plus every analyzed file that imports a changed module
+    (its contracts — signatures, locks, metric names — may have moved
+    under it). Imports are scanned over the whole tree, not just the
+    top level, because this codebase imports obs/* function-locally."""
+    changed = _git_changed_files(root)
+    if changed is None:
+        raise SystemExit("hvdlint: --changed-only requires a git "
+                         "checkout (git diff failed)")
+    symbols = project.symbols
+    seed = {p for p in symbols.modules if p in changed}
+    scope = set(seed)
+    for path, mi in symbols.modules.items():
+        if path in seed:
+            continue
+        for node in ast.walk(mi.src.tree):
+            dotted = []
+            if isinstance(node, ast.Import):
+                dotted = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                dotted = [node.module] + [
+                    f"{node.module}.{a.name}" for a in node.names]
+            if any((t := symbols.module_by_dotted(d)) is not None
+                   and t.path in seed for d in dotted):
+                scope.add(path)
+                break
+    return scope
 
 
 def _write_marked_table(doc_path: str, begin: str, end: str,
@@ -93,6 +176,17 @@ def write_chaos_table(doc_path: str) -> bool:
                                _CHAOS_TABLE_END, site_table_md())
 
 
+def write_event_table(doc_path: str) -> bool:
+    """Regenerate the structured-event table between the hvdlint
+    markers in ``doc_path`` from `obs.events.EVENT_CATALOG` — the
+    same catalog HVD011 pins against the emit sites, so the doc can
+    neither name an event nothing emits nor miss one that ships.
+    Returns True when the file changed."""
+    from horovod_tpu.obs.events import event_table_md
+    return _write_marked_table(doc_path, _EVENT_TABLE_BEGIN,
+                               _EVENT_TABLE_END, event_table_md())
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m horovod_tpu.analysis",
@@ -115,6 +209,10 @@ def main(argv=None) -> int:
                     help="comma-separated rule ids to run "
                          "(default: all)")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report findings only for files changed vs "
+                         "the git merge-base (plus files importing "
+                         "them); the whole package is still parsed")
     ap.add_argument("--write-env-table", nargs="?", metavar="DOC",
                     const=os.path.join(_repo_root(), "docs",
                                        "troubleshooting.md"),
@@ -125,6 +223,12 @@ def main(argv=None) -> int:
                                        "resilience.md"),
                     help="regenerate the chaos-site table in DOC from "
                          "a source scan, then exit")
+    ap.add_argument("--write-event-table", nargs="?", metavar="DOC",
+                    const=os.path.join(_repo_root(), "docs",
+                                       "observability.md"),
+                    help="regenerate the structured-event table in "
+                         "DOC from obs.events.EVENT_CATALOG, then "
+                         "exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -147,6 +251,13 @@ def main(argv=None) -> int:
               f"{args.write_chaos_table}")
         return 0
 
+    if args.write_event_table:
+        changed = write_event_table(args.write_event_table)
+        print(f"hvdlint: event table "
+              f"{'updated' if changed else 'already current'} in "
+              f"{args.write_event_table}")
+        return 0
+
     rules = ALL_RULES
     if args.rules:
         try:
@@ -157,7 +268,8 @@ def main(argv=None) -> int:
                      f"(see --list-rules)")
 
     try:
-        (active, muted), nfiles = analyze(args.paths, rules)
+        (active, muted), nfiles = analyze(
+            args.paths, rules, changed_only=args.changed_only)
     except (SyntaxError, OSError, UnicodeDecodeError) as e:
         # Any unreadable/unparseable input is exit 2 (usage/analysis
         # error), never a traceback the gate can't tell from findings.
@@ -178,11 +290,21 @@ def main(argv=None) -> int:
     new, old = baseline_mod.split(active, baselined)
 
     if args.json:
+        by_rule = {}
+        for f in new:
+            by_rule.setdefault(f.rule, {"findings": 0,
+                                        "suppressed": 0})
+            by_rule[f.rule]["findings"] += 1
+        for f in muted:
+            by_rule.setdefault(f.rule, {"findings": 0,
+                                        "suppressed": 0})
+            by_rule[f.rule]["suppressed"] += 1
         print(json.dumps({
             "files": nfiles,
             "findings": [f.to_json() for f in new],
             "baselined": len(old),
             "suppressed": [f.to_json() for f in muted],
+            "by_rule": {r: by_rule[r] for r in sorted(by_rule)},
         }, indent=2))
     else:
         for f in new:

@@ -13,9 +13,15 @@ from horovod_tpu.analysis.rules import (
     locks,
     env_registry,
     broad_except,
+    lock_order,
+    cross_thread,
+    blocking_lock,
+    metric_catalog,
+    event_docs,
 )
 
 ALL_RULES = [host_sync, trace_safety, recompile, locks, env_registry,
-             broad_except]
+             broad_except, lock_order, cross_thread, blocking_lock,
+             metric_catalog, event_docs]
 
 BY_ID = {mod.RULE.id: mod for mod in ALL_RULES}

@@ -1,0 +1,575 @@
+"""Shared concurrency-analysis machinery for HVD007/008/009.
+
+The three concurrency rules see the same world: which attributes hold
+locks, which attributes hold *objects of analyzed classes* (so a call
+through ``self.scheduler.step()`` resolves to the real method instead
+of a name union), which functions are thread entry points, and which
+lock a ``with`` item names. That world is built here, once, and cached
+on the `Project`.
+
+Lock identity is a *node name*: ``ClassName.attr`` for instance locks
+(``self._lock = threading.Lock()`` in ``__init__``) and
+``modstem.NAME`` for module-level locks. The runtime witness
+(`horovod_tpu.analysis.lockcheck`) registers locks under the same
+names, which is what lets a test diff the static HVD007 graph against
+the dynamically observed one. A lock constructed through the witness
+wrapper — ``lockcheck.register("Engine._lock", threading.Lock())`` —
+is still recognized as a lock by every rule here (and by HVD004).
+
+Call resolution is deliberately *precise, not complete*: self-methods,
+module functions, imported functions, constructors, and attr-typed
+receivers (``self.pool.allocate()`` where ``self.pool = BlockPool()``)
+resolve; an unknown receiver resolves to nothing. The name-union
+fallback `symbols.resolve_call` uses for HVD001 reachability would
+manufacture lock-graph cycles and phantom cross-thread accesses out of
+coincidental method names — for these rules under-approximating calls
+is the safe direction (a missed edge is a missed finding; an invented
+edge is a false deadlock report).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from horovod_tpu.analysis.core import dotted_name
+
+LOCK_TYPES = {"Lock", "RLock", "Condition"}
+
+#: Mutating container-method names (shared with HVD004's idea of a
+#: write): calling one of these on ``self.X`` mutates ``self.X``.
+MUTATORS = {"append", "appendleft", "extend", "insert", "add",
+            "remove", "discard", "clear", "pop", "popleft", "popitem",
+            "update", "setdefault", "sort", "reverse"}
+
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+
+#: Internally-synchronized constructor leaves: mutator-shaped calls on
+#: an attribute holding one of these (``self._stop.clear()``,
+#: ``self._wake.set()``) are thread-safe by the object's own contract,
+#: not shared-state writes — HVD008 exempts them.
+SYNC_TYPES = {"Event", "Semaphore", "BoundedSemaphore", "Barrier",
+              "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue"}
+
+
+def unwrap_lock_ctor(value: ast.AST) -> Optional[str]:
+    """If ``value`` constructs a lock, return the witness name it was
+    registered under ('' when unwrapped/unnamed), else None. Sees
+    through the runtime witness: ``lockcheck.register(name, Lock())``
+    is a lock construction with an explicit name."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn = dotted_name(value.func) or ""
+    leaf = fn.split(".")[-1]
+    if leaf in LOCK_TYPES:
+        return ""
+    if leaf == "register" and len(value.args) == 2:
+        inner = unwrap_lock_ctor(value.args[1])
+        if inner is not None:
+            name = value.args[0]
+            if (isinstance(name, ast.Constant)
+                    and isinstance(name.value, str)):
+                return name.value
+            return ""
+    return None
+
+
+def class_lock_attrs(ci) -> Dict[str, str]:
+    """{attr: witness-name-or-''} for self attributes assigned a lock
+    in ``__init__`` (construction happens-before publication, so
+    ``__init__`` is where a lock is born)."""
+    init = ci.methods.get("__init__")
+    out: Dict[str, str] = {}
+    if init is None:
+        return out
+    for node in ast.walk(init.node):
+        if not isinstance(node, ast.Assign):
+            continue
+        wname = unwrap_lock_ctor(node.value)
+        if wname is None:
+            continue
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                out[tgt.attr] = wname
+    return out
+
+
+def module_lock_names(mi) -> Dict[str, str]:
+    """{global-name: node-name} for module-level lock assignments."""
+    stem = mi.path.rsplit("/", 1)[-1][:-3]
+    out: Dict[str, str] = {}
+    for node in mi.src.tree.body:
+        if isinstance(node, ast.Assign):
+            if unwrap_lock_ctor(node.value) is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = f"{stem}.{tgt.id}"
+    return out
+
+
+def sync_attrs(ci) -> Set[str]:
+    """self attributes assigned a `SYNC_TYPES` object anywhere in the
+    class — receivers whose methods synchronize internally."""
+    out: Set[str] = set()
+    for method in ci.methods.values():
+        for node in ast.walk(method.node):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            leaf = (dotted_name(node.value.func) or "").split(".")[-1]
+            if leaf not in SYNC_TYPES:
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    out.add(tgt.attr)
+    return out
+
+
+def local_closures(fn_node) -> Dict[str, ast.AST]:
+    """{name: def-node} for functions nested (at any depth) inside
+    ``fn_node``'s scope — the call-site held-lock modeling targets."""
+    out: Dict[str, ast.AST] = {}
+
+    def scan(scope):
+        for child in ast.iter_child_nodes(scope):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                out.setdefault(child.name, child)
+                scan(child)
+            elif not isinstance(child, (ast.Lambda, ast.ClassDef)):
+                scan(child)
+
+    scan(fn_node)
+    return out
+
+
+def _resolve_class(mi, table, name: Optional[str]):
+    """A dotted constructor name -> ClassInfo in the analyzed set."""
+    if not name:
+        return None
+    if name in mi.classes:
+        return mi.classes[name]
+    if name in mi.from_imports:
+        mod_dotted, orig = mi.from_imports[name]
+        target = table.module_by_dotted(mod_dotted)
+        if target is not None:
+            return target.classes.get(orig)
+    if "." in name:
+        base, leaf = name.rsplit(".", 1)
+        dotted = mi.module_aliases.get(base)
+        if dotted is not None:
+            target = table.module_by_dotted(dotted)
+            if target is not None:
+                return target.classes.get(leaf)
+    return None
+
+
+def attr_types(ci, table) -> Dict[str, object]:
+    """{attr: ClassInfo} for ``self.X = SomeAnalyzedClass(...)``
+    assignments anywhere in the class — the receiver-type map that
+    lets ``self.X.method()`` resolve cross-object. An attr assigned
+    two different analyzed classes keeps the first (sorted by method
+    name) — ambiguity is rare and either choice is a sound witness."""
+    mi = table.modules[ci.module]
+    out: Dict[str, object] = {}
+    for mname in sorted(ci.methods):
+        method = ci.methods[mname]
+        for node in ast.walk(method.node):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            target_cls = _resolve_class(
+                mi, table, dotted_name(node.value.func))
+            if target_cls is None:
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    out.setdefault(tgt.attr, target_cls)
+    return out
+
+
+def local_class_types(fn_node, mi, table) -> Dict[str, object]:
+    """{local-var: ClassInfo} for ``v = SomeAnalyzedClass(...)``
+    bindings in one function body (nested defs excluded)."""
+    from horovod_tpu.analysis.core import walk_scope
+    out: Dict[str, object] = {}
+    for node in walk_scope(fn_node):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        target_cls = _resolve_class(
+            mi, table, dotted_name(node.value.func))
+        if target_cls is None:
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out.setdefault(tgt.id, target_cls)
+    return out
+
+
+class ThreadWorld:
+    """The per-project concurrency model, built once and cached."""
+
+    def __init__(self, project):
+        self.project = project
+        table = project.symbols
+        # class qname ("path:Class") -> {lockattr: witness name}
+        self.locks_of: Dict[str, Dict[str, str]] = {}
+        # class qname -> {attr: ClassInfo}
+        self.types_of: Dict[str, Dict[str, object]] = {}
+        # module path -> {global: node-name}
+        self.module_locks: Dict[str, Dict[str, str]] = {}
+        for mi in table.modules.values():
+            if mi.path.endswith("analysis/lockcheck.py"):
+                # The runtime witness's own mutex guards the recorder,
+                # not product state — it must not become a graph node
+                # (the witness never records itself either).
+                continue
+            self.module_locks[mi.path] = module_lock_names(mi)
+            for ci in mi.classes.values():
+                q = f"{mi.path}:{ci.name}"
+                self.locks_of[q] = class_lock_attrs(ci)
+                self.types_of[q] = attr_types(ci, table)
+        self.entries = self._thread_entries()
+
+    # -- thread entry points ------------------------------------------
+
+    def _thread_entries(self) -> Dict[str, Tuple[object, str]]:
+        """{qname: (FunctionInfo, how)} — every function handed to
+        ``threading.Thread(target=...)`` plus every ``@thread_entry``
+        annotation (the escape hatch for targets resolution can't
+        see: callables built dynamically, callbacks invoked by
+        foreign threads)."""
+        table = self.project.symbols
+        out: Dict[str, Tuple[object, str]] = {}
+        for fi in table.all_functions():
+            for dec in fi.node.decorator_list:
+                if (dotted_name(dec) or "").split(".")[-1] == \
+                        "thread_entry":
+                    out[fi.qname] = (fi, "@thread_entry")
+        for mi in table.modules.values():
+            for ci in list(mi.classes.values()) + [None]:
+                methods = (ci.methods.values() if ci
+                           else mi.functions.values())
+                for method in methods:
+                    for node in ast.walk(method.node):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        if dotted_name(node.func) not in _THREAD_CTORS:
+                            continue
+                        tgt = self._thread_target(node, mi, ci)
+                        if tgt is not None:
+                            out.setdefault(
+                                tgt.qname,
+                                (tgt, f"Thread target at "
+                                      f"{mi.path}:{node.lineno}"))
+        return out
+
+    def _thread_target(self, call: ast.Call, mi, ci):
+        target = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        if target is None and call.args:
+            target = call.args[0]
+        if target is None:
+            return None
+        if (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self" and ci is not None):
+            return ci.methods.get(target.attr)
+        if isinstance(target, ast.Name):
+            f = mi.functions.get(target.id)
+            if f is not None:
+                return f
+            if target.id in mi.from_imports:
+                mod_dotted, orig = mi.from_imports[target.id]
+                tm = self.project.symbols.module_by_dotted(mod_dotted)
+                if tm is not None:
+                    return tm.functions.get(orig)
+        return None
+
+    # -- precise call resolution --------------------------------------
+
+    def resolve_precise(self, fi, call: ast.Call,
+                        local_types: Dict[str, object]) -> List:
+        """Callees of ``call`` inside ``fi`` — precise paths only (no
+        method-name union; see module docstring)."""
+        table = self.project.symbols
+        mi = table.modules[fi.module]
+        ci = mi.classes.get(fi.cls) if fi.cls else None
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            f = mi.functions.get(fn.id)
+            if f is not None:
+                return [f]
+            if fn.id in mi.from_imports:
+                mod_dotted, orig = mi.from_imports[fn.id]
+                target = table.module_by_dotted(mod_dotted)
+                if target is not None:
+                    f = target.functions.get(orig)
+                    if f is not None:
+                        return [f]
+                    c = target.classes.get(orig)
+                    if c is not None and "__init__" in c.methods:
+                        return [c.methods["__init__"]]
+            c = mi.classes.get(fn.id)
+            if c is not None and "__init__" in c.methods:
+                return [c.methods["__init__"]]
+            return []
+        if not isinstance(fn, ast.Attribute):
+            return []
+        base = fn.value
+        if isinstance(base, ast.Call):
+            # Call-result receiver: ``get().emit(...)`` — typed by the
+            # inner callee's return annotation (``def get() ->
+            # EventLog``). Without this the static graph misses edges
+            # the runtime witness observes through accessor functions.
+            out = []
+            for callee in self.resolve_precise(fi, base, local_types):
+                cls = self._return_class(callee)
+                if cls is not None:
+                    m = cls.methods.get(fn.attr)
+                    if m is not None:
+                        out.append(m)
+            return out
+        if isinstance(base, ast.Name):
+            if base.id == "self" and ci is not None:
+                m = ci.methods.get(fn.attr)
+                if m is not None:
+                    return [m]
+                # self.<attr-typed>.__call__ etc. fall through below
+            cls = local_types.get(base.id)
+            if cls is not None:
+                m = cls.methods.get(fn.attr)
+                return [m] if m is not None else []
+            dotted = mi.module_aliases.get(base.id)
+            if dotted is not None:
+                target = table.module_by_dotted(dotted)
+                if target is not None:
+                    f = target.functions.get(fn.attr)
+                    if f is not None:
+                        return [f]
+                    c = target.classes.get(fn.attr)
+                    if c is not None and "__init__" in c.methods:
+                        return [c.methods["__init__"]]
+            return []
+        # self.X.method() via the attr-type map
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and ci is not None):
+            cls = self.types_of.get(
+                f"{mi.path}:{ci.name}", {}).get(base.attr)
+            if cls is not None:
+                m = cls.methods.get(fn.attr)
+                return [m] if m is not None else []
+        return []
+
+    def _return_class(self, callee):
+        """The ClassInfo a function's return annotation names (plain
+        or string-quoted), resolved in the CALLEE's module."""
+        table = self.project.symbols
+        mi = table.modules[callee.module]
+        ann = getattr(callee.node, "returns", None)
+        if ann is None:
+            return None
+        if (isinstance(ann, ast.Constant)
+                and isinstance(ann.value, str)):
+            name = ann.value.strip("'\"")
+        else:
+            name = dotted_name(ann)
+        return _resolve_class(mi, table, name)
+
+    def protocol_callees(self, fi, node: ast.AST,
+                         local_types: Dict[str, object]) -> List:
+        """Dunder-protocol calls the runtime makes but no ast.Call
+        shows: ``len(self.X)`` -> ``X.__len__``, ``self.X[k]`` ->
+        ``__getitem__``/``__setitem__``, ``k in self.X`` ->
+        ``__contains__``, ``for _ in self.X`` -> ``__iter__`` — for
+        attr-typed receivers only. Without these the static lock
+        graph misses edges the runtime witness observes (e.g. an
+        engine holding its lock while ``len(self.queue)`` takes the
+        queue's)."""
+        table = self.project.symbols
+        mi = table.modules[fi.module]
+        ci = mi.classes.get(fi.cls) if fi.cls else None
+
+        def recv_cls(expr):
+            if (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self" and ci is not None):
+                return self.types_of.get(
+                    f"{mi.path}:{ci.name}", {}).get(expr.attr)
+            if isinstance(expr, ast.Name):
+                return local_types.get(expr.id)
+            return None
+
+        out = []
+
+        def add(cls, dunder):
+            if cls is not None:
+                m = cls.methods.get(dunder)
+                if m is not None:
+                    out.append(m)
+
+        if (isinstance(node, ast.Call) and isinstance(node.func,
+                                                      ast.Name)
+                and node.func.id == "len" and node.args):
+            add(recv_cls(node.args[0]), "__len__")
+        elif isinstance(node, ast.Subscript):
+            add(recv_cls(node.value),
+                "__setitem__" if isinstance(node.ctx, ast.Store)
+                else "__getitem__")
+        elif isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if isinstance(op, (ast.In, ast.NotIn)):
+                    add(recv_cls(comp), "__contains__")
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            add(recv_cls(node.iter), "__iter__")
+        return out
+
+    # -- lock-expression naming ---------------------------------------
+
+    def lock_node(self, expr: ast.AST, fi,
+                  local_aliases: Dict[str, str],
+                  local_types: Dict[str, object]) -> Optional[str]:
+        """The lock-graph node a ``with`` item (or receiver) names,
+        else None. Resolves ``self.<lock>``, cross-object
+        ``self.<obj>.<lock>`` / ``local.<lock>``, module-level locks,
+        and locals aliasing any of those."""
+        table = self.project.symbols
+        mi = table.modules[fi.module]
+        ci = mi.classes.get(fi.cls) if fi.cls else None
+        if isinstance(expr, ast.Name):
+            if expr.id in local_aliases:
+                return local_aliases[expr.id]
+            return self.module_locks.get(mi.path, {}).get(expr.id)
+        if not isinstance(expr, ast.Attribute):
+            return None
+        base = expr.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and ci is not None:
+                q = f"{mi.path}:{ci.name}"
+                if expr.attr in self.locks_of.get(q, {}):
+                    return f"{ci.name}.{expr.attr}"
+                return None
+            cls = local_types.get(base.id)
+            if cls is not None:
+                q = f"{cls.module}:{cls.name}"
+                if expr.attr in self.locks_of.get(q, {}):
+                    return f"{cls.name}.{expr.attr}"
+            return None
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and ci is not None):
+            cls = self.types_of.get(
+                f"{mi.path}:{ci.name}", {}).get(base.attr)
+            if cls is not None:
+                q = f"{cls.module}:{cls.name}"
+                if expr.attr in self.locks_of.get(q, {}):
+                    return f"{cls.name}.{expr.attr}"
+        return None
+
+    def lock_aliases(self, fi, local_types) -> Dict[str, str]:
+        """{local: node-name} for ``lock = self._lock``-style rebinds
+        in ``fi``'s own scope."""
+        from horovod_tpu.analysis.core import walk_scope
+        out: Dict[str, str] = {}
+        for node in walk_scope(fi.node):
+            if not isinstance(node, ast.Assign):
+                continue
+            node_name = self.lock_node(node.value, fi, out,
+                                       local_types)
+            if node_name is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node_name
+        return out
+
+
+def walk_with_locks(world, fi, aliases, local_types,
+                    on_acquire=None, on_node=None,
+                    initial_held=()):
+    """Drive a held-lock-tracking walk of ``fi``'s body — THE shared
+    execution-context model for HVD007/008/009, so the three rules
+    cannot disagree about what is held where.
+
+    ``on_acquire(lock, expr, held)`` fires when a ``with`` item names
+    a lock (``held`` = locks already held, in acquisition order);
+    ``on_node(node, held)`` fires pre-order for every other node.
+
+    Nested defs are NOT walked in place — a closure's body runs at
+    CALL time, not where it is written. Instead each body is walked at
+    every local call site with the call site's held set (this is what
+    lets a helper defined above a ``with self._lock:`` block and
+    invoked inside it count as guarded), and a closure never called
+    locally (an escaping callback: a gauge set_fn, a Thread target) is
+    walked once with nothing held. Lambdas are treated as escaping.
+
+    ``initial_held`` seeds the held set — callers doing
+    interprocedural propagation (HVD008 walking a ``_locked``-suffix
+    helper from its guarded call site) pass the caller's held locks.
+    """
+    closures = local_closures(fi.node)
+    called: Set[str] = set()
+    active: Set[str] = set()
+
+    def visit(node, held: Tuple[str, ...]):
+        if isinstance(node, ast.With):
+            inner = list(held)
+            for item in node.items:
+                ln = world.lock_node(item.context_expr, fi, aliases,
+                                     local_types)
+                if ln:
+                    if on_acquire is not None:
+                        on_acquire(ln, item.context_expr,
+                                   tuple(inner))
+                    inner.append(ln)
+                else:
+                    visit(item.context_expr, tuple(inner))
+            for child in node.body:
+                visit(child, tuple(inner))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return    # walked at call sites / escape epilogue below
+        if isinstance(node, ast.Lambda):
+            visit(node.body, ())
+            return
+        if on_node is not None:
+            on_node(node, held)
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in closures
+                and node.func.id not in active):
+            name = node.func.id
+            called.add(name)
+            active.add(name)
+            for child in closures[name].body:
+                visit(child, held)
+            active.discard(name)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fi.node.body:
+        visit(stmt, tuple(initial_held))
+    for name in sorted(closures):
+        if name not in called:
+            for child in closures[name].body:
+                visit(child, ())
+
+
+def thread_world(project) -> ThreadWorld:
+    """Build (or fetch the cached) `ThreadWorld` for ``project``."""
+    world = getattr(project, "_thread_world", None)
+    if world is None:
+        world = ThreadWorld(project)
+        project._thread_world = world
+    return world

@@ -1,0 +1,194 @@
+"""HVD009: blocking operations inside a held-lock scope.
+
+A lock on the serving or coordination path is a shared-state fence,
+not a place to wait: ``time.sleep`` under a lock turns every other
+acquirer into a sleeper too (a latency cliff); ``Thread.join`` /
+``Event.wait`` / blocking ``queue.get`` under a lock is a deadlock
+rung (the joined thread may need that very lock to finish); socket
+and subprocess waits under a lock stall the plane on a peer; and
+``block_until_ready`` / ``jax.device_get`` under a lock serializes
+device completion into every contender's critical section.
+
+Flagged lexically: a blocking call while at least one ``with <lock>``
+scope is open in the same function. ``Condition.wait`` on the very
+condition being held is the designed sleep-with-release pattern and
+is exempt; ``Event.wait`` / ``lock.acquire(timeout=...)`` on *other*
+objects is not.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from horovod_tpu.analysis.core import Finding, RuleMeta, dotted_name
+from horovod_tpu.analysis.rules._threads import (
+    local_class_types, thread_world, walk_with_locks,
+)
+
+RULE = RuleMeta(
+    id="HVD009",
+    name="blocking-under-lock",
+    severity="warning",
+    doc="A blocking operation (sleep, Thread.join, Event/Condition "
+        "wait on another object, blocking queue get/put, socket or "
+        "subprocess wait, block_until_ready/device_get) inside an "
+        "open `with <lock>` scope — a latency cliff or deadlock "
+        "rung for every other acquirer.")
+
+# Dotted-call names that block outright.
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep",
+    "jax.device_get": "jax.device_get",
+    "subprocess.run": "subprocess.run",
+    "subprocess.call": "subprocess.call",
+    "subprocess.check_call": "subprocess.check_call",
+    "subprocess.check_output": "subprocess.check_output",
+    "os.waitpid": "os.waitpid",
+    "os.wait": "os.wait",
+}
+
+# Method leaves that block when invoked on a thread/event/queue/
+# socket/process-shaped receiver.
+_BLOCKING_METHODS = {"join", "wait", "get", "put", "recv", "send",
+                     "sendall", "accept", "connect", "communicate",
+                     "block_until_ready", "result"}
+
+# Receiver kinds (from constructor tracking) that make those method
+# names blocking.
+_BLOCKING_CTORS = {
+    "threading.Thread": "Thread", "Thread": "Thread",
+    "threading.Event": "Event", "Event": "Event",
+    "queue.Queue": "Queue", "Queue": "Queue",
+    "queue.SimpleQueue": "Queue",
+    "socket.socket": "socket",
+    "subprocess.Popen": "Popen", "Popen": "Popen",
+}
+
+_KIND_METHODS = {
+    "Thread": {"join"},
+    "Event": {"wait"},
+    "Queue": {"get", "put", "join"},
+    "socket": {"recv", "send", "sendall", "accept", "connect"},
+    "Popen": {"wait", "communicate"},
+}
+
+
+def _blocking_attr_kinds(ci) -> Dict[str, str]:
+    """{attr: kind} for self attributes assigned a thread/event/queue/
+    socket/process anywhere in the class."""
+    out: Dict[str, str] = {}
+    for method in ci.methods.values():
+        for node in ast.walk(method.node):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            kind = _BLOCKING_CTORS.get(
+                dotted_name(node.value.func) or "")
+            if kind is None:
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    out.setdefault(tgt.attr, kind)
+    return out
+
+
+def _local_kinds(fn_node) -> Dict[str, str]:
+    from horovod_tpu.analysis.core import walk_scope
+    out: Dict[str, str] = {}
+    for node in walk_scope(fn_node):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            kind = _BLOCKING_CTORS.get(
+                dotted_name(node.value.func) or "")
+            if kind is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out.setdefault(tgt.id, kind)
+    return out
+
+
+def _is_nonblocking_call(call: ast.Call) -> bool:
+    """``q.get(block=False)`` / ``q.get_nowait()`` style calls do not
+    block; ``h.result(timeout=0)`` still does (it raises later but
+    waits first is version-dependent — keep it flagged unless
+    block=False)."""
+    for kw in call.keywords:
+        if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is False:
+            return True
+    return False
+
+
+def check(project):
+    world = thread_world(project)
+    for mi in project.symbols.modules.values():
+        classes = list(mi.classes.values())
+        for ci in classes + [None]:
+            methods = (ci.methods.values() if ci
+                       else mi.functions.values())
+            attr_kinds = _blocking_attr_kinds(ci) if ci else {}
+            for fi in methods:
+                yield from _scan_function(world, fi, attr_kinds)
+
+
+def _scan_function(world, fi, attr_kinds):
+    mi = world.project.symbols.modules[fi.module]
+    local_types = local_class_types(fi.node, mi,
+                                    world.project.symbols)
+    aliases = world.lock_aliases(fi, local_types)
+    local_kinds = _local_kinds(fi.node)
+    findings = []
+
+    def receiver_kind(expr) -> Optional[str]:
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            return attr_kinds.get(expr.attr)
+        if isinstance(expr, ast.Name):
+            return local_kinds.get(expr.id)
+        return None
+
+    def classify(call: ast.Call, held) -> Optional[str]:
+        name = dotted_name(call.func) or ""
+        if name in _BLOCKING_CALLS:
+            return _BLOCKING_CALLS[name]
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        leaf = call.func.attr
+        if leaf not in _BLOCKING_METHODS:
+            return None
+        if leaf == "block_until_ready":
+            return "block_until_ready"
+        recv = call.func.value
+        # Condition.wait on the HELD condition releases it while
+        # sleeping — the designed pattern, not a finding.
+        recv_lock = world.lock_node(recv, fi, aliases, local_types)
+        if recv_lock is not None and recv_lock in held:
+            return None
+        kind = receiver_kind(recv)
+        if kind is None:
+            return None
+        if leaf in _KIND_METHODS.get(kind, ()):
+            if leaf in ("get", "put") and _is_nonblocking_call(call):
+                return None
+            return f"{kind}.{leaf}"
+        return None
+
+    def on_node(node, held):
+        if held and isinstance(node, ast.Call):
+            what = classify(node, held)
+            if what is not None:
+                findings.append(Finding(
+                    RULE.id, RULE.severity, fi.src.path, node.lineno,
+                    node.col_offset,
+                    f"blocking {what} while holding "
+                    f"{', '.join(held)} in "
+                    f"{fi.qname.split(':')[-1]} — a latency cliff "
+                    f"(or deadlock rung) for every other acquirer"))
+
+    walk_with_locks(world, fi, aliases, local_types, on_node=on_node)
+    return findings

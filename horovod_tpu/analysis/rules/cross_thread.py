@@ -1,0 +1,166 @@
+"""HVD008: cross-thread shared state with no common lock.
+
+The static generalization of HVD004's single-class check: HVD004 asks
+"is this attribute's lock discipline *consistent*?"; this rule asks
+"do two *different threads* touch this attribute without a common
+lock?". Thread entry points are every ``threading.Thread(target=...)``
+target the resolver can see plus every ``@thread_entry``-annotated
+function (`horovod_tpu.annotations`). From each entry the rule walks
+the precisely-resolved call graph, recording every ``self.<attr>``
+access (reads, writes, mutating container calls) together with the
+set of locks lexically held at the access. An attribute WRITTEN on
+one thread's reachable paths and read or written on a different
+thread's, where some write/access pair shares **no** lock, is a data
+race candidate and is flagged at the unguarded write.
+
+``__init__`` is exempt (construction happens-before the thread
+start), lock attributes themselves are exempt, and only classes that
+own at least one lock are examined — a lock-free class is
+single-threaded by design and HVD004 already covers the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from horovod_tpu.analysis.core import Finding, RuleMeta
+from horovod_tpu.analysis.rules._threads import (
+    MUTATORS, local_class_types, sync_attrs, thread_world,
+    walk_with_locks,
+)
+
+RULE = RuleMeta(
+    id="HVD008",
+    name="cross-thread-race",
+    severity="warning",
+    doc="Attribute written on one thread entry point's reachable "
+        "paths and read/written on another's with no common lock "
+        "held at both sites — a cross-thread data race candidate.")
+
+
+def _self_attr(node: ast.AST):
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+# access: (entry qname, fn qname, path, line, kind, frozenset(locks))
+Access = Tuple[str, str, str, int, str, frozenset]
+
+
+def _entry_accesses(world, entry_q, entry) -> List[Tuple[str, str,
+                                                         Access]]:
+    """Every ``self.<attr>`` access on ``entry``'s reachable paths,
+    with the locks held at the access — held context PROPAGATES
+    through precisely-resolved calls (a ``_locked``-suffix helper
+    reached only from under the lock is guarded; the same helper
+    reached bare from another entry is not). Closures are walked at
+    their call sites via `walk_with_locks`; lock attrs and
+    internally-synchronized attrs (threading.Event & co — thread-safe
+    by their own contract) are exempt."""
+    out: List[Tuple[str, str, Access]] = []
+    seen: Set[Tuple[str, frozenset]] = set()
+
+    def walk_fn(fi, entry_held):
+        key = (fi.qname, frozenset(entry_held))
+        if key in seen:
+            return
+        seen.add(key)
+        mi = world.project.symbols.modules[fi.module]
+        local_types = local_class_types(fi.node, mi,
+                                        world.project.symbols)
+        aliases = world.lock_aliases(fi, local_types)
+        recording = fi.cls is not None and fi.name != "__init__"
+        if recording:
+            cls_q = f"{fi.module}:{fi.cls}"
+            lock_attrs = set(world.locks_of.get(cls_q, ()))
+            safe_attrs = sync_attrs(mi.classes[fi.cls])
+
+        def record(attr, kind, node, held):
+            if attr in lock_attrs or attr in safe_attrs:
+                return
+            out.append((cls_q, attr,
+                        (entry_q, fi.qname, fi.src.path, node.lineno,
+                         kind, frozenset(held))))
+
+        def on_node(node, held):
+            if recording:
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    tgts = (node.targets
+                            if isinstance(node, ast.Assign)
+                            else [node.target])
+                    for tgt in tgts:
+                        for el in (tgt.elts
+                                   if isinstance(tgt, ast.Tuple)
+                                   else [tgt]):
+                            attr = _self_attr(el)
+                            if attr is not None:
+                                record(attr, "write", el, held)
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if (isinstance(f, ast.Attribute)
+                            and f.attr in MUTATORS):
+                        attr = _self_attr(f.value)
+                        if attr is not None:
+                            record(attr, "write", node, held)
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Load)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    record(node.attr, "read", node, held)
+            callees = []
+            if isinstance(node, ast.Call):
+                callees += world.resolve_precise(fi, node,
+                                                 local_types)
+            callees += world.protocol_callees(fi, node, local_types)
+            for c in callees:
+                walk_fn(c, tuple(sorted(set(held))))
+
+        walk_with_locks(world, fi, aliases, local_types,
+                        on_node=on_node, initial_held=entry_held)
+
+    walk_fn(entry, ())
+    return out
+
+
+def check(project):
+    world = thread_world(project)
+    # (class qname, attr) -> [access]
+    table: Dict[Tuple[str, str], List[Access]] = {}
+    for entry_q in sorted(world.entries):
+        entry, _how = world.entries[entry_q]
+        for cls_q, attr, acc in _entry_accesses(world, entry_q,
+                                                entry):
+            if world.locks_of.get(cls_q):
+                table.setdefault((cls_q, attr), []).append(acc)
+
+    seen_sites = set()
+    for (cls_q, attr) in sorted(table):
+        accs = table[(cls_q, attr)]
+        writes = [a for a in accs if a[4] == "write"]
+        for w in sorted(writes, key=lambda a: (a[2], a[3])):
+            racy = [a for a in accs
+                    if a[0] != w[0] and not (a[5] & w[5])]
+            if not racy:
+                continue
+            other = min(racy, key=lambda a: (a[2], a[3]))
+            site = (w[2], w[3], attr)
+            if site in seen_sites:
+                continue
+            seen_sites.add(site)
+            cls_name = cls_q.split(":")[-1]
+            held = (f" (holding {', '.join(sorted(w[5]))})"
+                    if w[5] else " with no lock")
+            yield Finding(
+                RULE.id, RULE.severity, w[2], w[3], 0,
+                f"self.{attr} of {cls_name} written on thread "
+                f"{w[0].split(':')[-1]}{held} and "
+                f"{other[4]} on thread {other[0].split(':')[-1]} at "
+                f"{other[2]}:{other[3]} with no common lock — "
+                f"cross-thread race candidate")

@@ -1,0 +1,160 @@
+"""HVD011: event kinds drifting from the EVENT_CATALOG contract.
+
+`horovod_tpu.obs.events.EVENT_CATALOG` declares every event ``kind``
+the subsystems may emit, with the one-line description an operator
+reads in docs/observability.md's event table (regenerated from the
+catalog by ``python -m horovod_tpu.analysis --write-event-table``).
+Two drift directions break that contract:
+
+* an ``events.emit("kind", ...)`` call (through any alias of the
+  events module, including function-local imports) with a literal
+  kind not in the catalog emits an event no doc or dashboard knows
+  to grep for (flagged at the emit site);
+* a catalog entry whose kind is never emitted anywhere is a dead
+  promise — the runbook tells operators to watch for an event that
+  cannot occur (flagged at the catalog line).
+
+Dynamic kinds (a variable first argument) are out of scope for the
+literal scan; keep kinds literal at emit sites — that is what makes
+them greppable in the first place.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from horovod_tpu.analysis.core import Finding, RuleMeta, const_str
+
+RULE = RuleMeta(
+    id="HVD011",
+    name="event-catalog-drift",
+    severity="error",
+    doc="events.emit() with a literal kind not declared in "
+        "obs/events.py EVENT_CATALOG (undocumented event), or a "
+        "catalog entry whose kind is never emitted (dead promise).")
+
+_EVENTS_MODULE = "obs/events.py"
+_EVENTS_DOTTED = "horovod_tpu.obs.events"
+
+
+def _events_module(project):
+    for mi in project.symbols.modules.values():
+        if mi.path.endswith(_EVENTS_MODULE):
+            return mi
+    return None
+
+
+def _catalog_from_tree(tree) -> Dict[str, int]:
+    """{kind: lineno} from the ``EVENT_CATALOG = {...}`` literal."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            tgts = [t.id for t in node.targets
+                    if isinstance(t, ast.Name)]
+        elif (isinstance(node, ast.AnnAssign)
+              and isinstance(node.target, ast.Name)):
+            tgts = [node.target.id]
+        else:
+            continue
+        if "EVENT_CATALOG" not in tgts:
+            continue
+        if isinstance(node.value, ast.Dict):
+            for key in node.value.keys:
+                k = const_str(key) if key is not None else None
+                if k:
+                    out[k] = key.lineno
+    return out
+
+
+def _live_catalog() -> Dict[str, int]:
+    try:
+        from horovod_tpu.obs import events as _ev
+        return {k: 0 for k in getattr(_ev, "EVENT_CATALOG", {})}
+    except ImportError:    # analyzing a foreign tree — static only
+        return {}
+
+
+def _emit_aliases(mi) -> Tuple[Set[str], Set[str]]:
+    """(module aliases of obs.events, direct names bound to its
+    ``emit``) — scanned over the WHOLE tree, because subsystems import
+    the events module function-locally (`from horovod_tpu.obs import
+    events as _events` inside the method that emits)."""
+    mods: Set[str] = set()
+    fns: Set[str] = set()
+    for node in ast.walk(mi.src.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == _EVENTS_DOTTED and alias.asname:
+                    mods.add(alias.asname)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for alias in node.names:
+                local = alias.asname or alias.name
+                if (mod.endswith("obs") and alias.name == "events"):
+                    mods.add(local)
+                elif (mod.endswith("obs.events")
+                      and alias.name == "emit"):
+                    fns.add(local)
+    return mods, fns
+
+
+def emit_sites(project) -> List[Tuple[str, int, int, str]]:
+    """[(path, line, col, kind)] — every literal-kind emit through an
+    events-module alias, outside obs/events.py itself."""
+    out = []
+    for mi in project.symbols.modules.values():
+        if mi.path.endswith(_EVENTS_MODULE):
+            continue
+        mods, fns = _emit_aliases(mi)
+        if not mods and not fns:
+            continue
+        for node in ast.walk(mi.src.tree):
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            fn = node.func
+            hit = ((isinstance(fn, ast.Attribute)
+                    and fn.attr == "emit"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id in mods)
+                   or (isinstance(fn, ast.Name) and fn.id in fns))
+            if not hit:
+                continue
+            kind = const_str(node.args[0])
+            if kind:
+                out.append((mi.path, node.lineno, node.col_offset,
+                            kind))
+    return out
+
+
+def check(project):
+    ev_mi = _events_module(project)
+    if ev_mi is not None:
+        catalog = _catalog_from_tree(ev_mi.src.tree)
+    else:
+        catalog = _live_catalog()
+
+    sites = emit_sites(project)
+    for path, line, col, kind in sites:
+        if kind in catalog:
+            continue
+        yield Finding(
+            RULE.id, RULE.severity, path, line, col,
+            f"event kind {kind!r} emitted but not declared in "
+            f"EVENT_CATALOG (horovod_tpu/obs/events.py) — "
+            f"undocumented events never reach the "
+            f"docs/observability.md table operators grep from")
+
+    # Dead-promise direction only when the events module itself is in
+    # the analyzed set — a subtree run without the emitters would call
+    # every entry dead.
+    if ev_mi is None:
+        return
+    emitted = {kind for (_, _, _, kind) in sites}
+    for kind in sorted(catalog):
+        if kind not in emitted:
+            yield Finding(
+                RULE.id, RULE.severity, ev_mi.path, catalog[kind], 0,
+                f"EVENT_CATALOG entry {kind!r} is never emitted by "
+                f"any subsystem — dead promise in the operator docs; "
+                f"emit it or delete the entry")

@@ -1,0 +1,228 @@
+"""HVD007: lock-order cycles (potential deadlock).
+
+Builds the static lock-acquisition graph: an edge ``A -> B`` means
+some execution path acquires lock ``B`` while holding lock ``A`` —
+either a ``with`` nested lexically inside another ``with``, or a call
+made while holding ``A`` whose (transitively resolved) callee acquires
+``B``. Cross-object edges resolve through the attribute-type map
+(``self.queue = RequestQueue()`` makes ``len(self.queue)`` under the
+engine lock an ``Engine._lock -> RequestQueue._lock`` edge, dunder
+protocols included). A cycle in this graph is a potential deadlock:
+two threads walking the cycle from different nodes block each other
+forever. Each cycle is reported once, with the witness path — the
+acquisition sites that close it.
+
+The graph itself is exported (`lock_order_graph`) because the runtime
+lock witness (`horovod_tpu.analysis.lockcheck`, ``HVD_LOCK_CHECK=1``)
+records the *observed* acquisition graph during the test suite and a
+test asserts observed ⊆ static — the dynamic analysis validates the
+static one's completeness, the static one bounds the dynamic one's
+coverage.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from horovod_tpu.analysis.core import Finding, RuleMeta
+from horovod_tpu.analysis.rules._threads import (
+    local_class_types, thread_world, walk_with_locks,
+)
+
+RULE = RuleMeta(
+    id="HVD007",
+    name="lock-order-cycle",
+    severity="error",
+    doc="Cycle in the static lock-acquisition graph (lock B taken "
+        "while holding A on one path, A while holding B on another) "
+        "— a potential deadlock between the threads that walk the "
+        "two paths.")
+
+# witness: (holder, acquired) -> (path, line, via)
+Edges = Dict[Tuple[str, str], Tuple[str, int, str]]
+
+
+def _direct_acquires(world, fi, aliases, local_types) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fi.node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ln = world.lock_node(item.context_expr, fi, aliases,
+                                     local_types)
+                if ln:
+                    out.add(ln)
+    return out
+
+
+def _fn_ctx(world, fi):
+    mi = world.project.symbols.modules[fi.module]
+    local_types = local_class_types(fi.node, mi,
+                                    world.project.symbols)
+    aliases = world.lock_aliases(fi, local_types)
+    return local_types, aliases
+
+
+def _transitive_acquires(world, fi, memo, stack) -> Set[str]:
+    """Locks ``fi`` may acquire, directly or through resolved calls.
+    Recursion through a call cycle contributes what is known so far
+    (an under-approximation only inside the cycle — every function is
+    also analyzed as a root, so its own edges are never lost)."""
+    if fi.qname in memo:
+        return memo[fi.qname]
+    if fi.qname in stack:
+        return set()
+    stack.add(fi.qname)
+    local_types, aliases = _fn_ctx(world, fi)
+    out = _direct_acquires(world, fi, aliases, local_types)
+    for node in ast.walk(fi.node):
+        callees = []
+        if isinstance(node, ast.Call):
+            callees += world.resolve_precise(fi, node, local_types)
+        callees += world.protocol_callees(fi, node, local_types)
+        for c in callees:
+            out |= _transitive_acquires(world, c, memo, stack)
+    stack.discard(fi.qname)
+    memo[fi.qname] = out
+    return out
+
+
+def _collect_edges(project) -> Edges:
+    world = thread_world(project)
+    memo: Dict[str, Set[str]] = {}
+    edges: Edges = {}
+
+    def add_edge(holder, acquired, path, line, via):
+        if holder == acquired:
+            return    # reentrancy is HVD-not-this-rule's problem
+        edges.setdefault((holder, acquired), (path, line, via))
+
+    for fi in project.symbols.all_functions():
+        local_types, aliases = _fn_ctx(world, fi)
+
+        def on_acquire(ln, expr, held, fi=fi):
+            for h in held:
+                add_edge(h, ln, fi.src.path, expr.lineno,
+                         "nested with")
+
+        def on_node(node, held, fi=fi, local_types=local_types):
+            if not held:
+                return
+            callees = []
+            if isinstance(node, ast.Call):
+                callees += world.resolve_precise(fi, node,
+                                                 local_types)
+            callees += world.protocol_callees(fi, node, local_types)
+            for c in callees:
+                for acq in _transitive_acquires(world, c, memo,
+                                                set()):
+                    for h in held:
+                        add_edge(h, acq, fi.src.path, node.lineno,
+                                 f"call into {c.qname}")
+
+        walk_with_locks(world, fi, aliases, local_types,
+                        on_acquire=on_acquire, on_node=on_node)
+    return edges
+
+
+def lock_order_graph(project) -> Dict[str, List[str]]:
+    """{lock-node: sorted successor lock-nodes} — the static
+    acquisition graph the runtime witness is diffed against."""
+    out: Dict[str, List[str]] = {}
+    for (a, b) in _collect_edges(project):
+        out.setdefault(a, [])
+        if b not in out[a]:
+            out[a].append(b)
+    for succs in out.values():
+        succs.sort()
+    return out
+
+
+def _cycles(edges: Edges) -> List[List[str]]:
+    """Minimal cycles, one per strongly-connected component with >1
+    node (self-edges are filtered at collection). Deterministic: DFS
+    from the lexicographically smallest node over sorted successors."""
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    for succs in graph.values():
+        succs.sort()
+
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    stack: List[str] = []
+    on: Set[str] = set()
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in graph[v]:
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(sorted(comp))
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    out = []
+    for comp in sorted(sccs):
+        start = comp[0]
+        members = set(comp)
+        # Shortest path start -> ... -> start inside the SCC (BFS).
+        prev = {start: None}
+        todo = [(start, 0)]
+        cycle = None
+        while todo and cycle is None:
+            v, _ = todo.pop(0)
+            for w in graph[v]:
+                if w == start:
+                    path = [start]
+                    node = v
+                    while node is not None:
+                        path.append(node)
+                        node = prev[node]
+                    cycle = list(reversed(path[1:])) + [start] \
+                        if len(path) > 1 else [start, start]
+                    break
+                if w in members and w not in prev:
+                    prev[w] = v
+                    todo.append((w, 0))
+        if cycle:
+            out.append(cycle)
+    return out
+
+
+def check(project):
+    edges = _collect_edges(project)
+    for cycle in _cycles(edges):
+        # cycle = [a, b, ..., a]; witness each hop.
+        hops = []
+        for a, b in zip(cycle, cycle[1:]):
+            path, line, via = edges[(a, b)]
+            hops.append((a, b, path, line, via))
+        first = hops[0]
+        detail = "; ".join(
+            f"{b} taken holding {a} at {p}:{ln} ({via})"
+            for a, b, p, ln, via in hops)
+        yield Finding(
+            RULE.id, RULE.severity, first[2], first[3], 0,
+            f"lock-order cycle "
+            f"{' -> '.join(cycle)} — potential deadlock: {detail}")

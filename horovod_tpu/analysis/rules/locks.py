@@ -45,6 +45,7 @@ def _lock_attrs(ci) -> set:
     init = ci.methods.get("__init__")
     if init is None:
         return set()
+    from horovod_tpu.analysis.rules._threads import unwrap_lock_ctor
     out = set()
     for node in ast.walk(init.node):
         if not isinstance(node, ast.Assign):
@@ -52,7 +53,8 @@ def _lock_attrs(ci) -> set:
         if not isinstance(node.value, ast.Call):
             continue
         fn = dotted_name(node.value.func) or ""
-        if fn.split(".")[-1] not in _LOCK_TYPES:
+        if (fn.split(".")[-1] not in _LOCK_TYPES
+                and unwrap_lock_ctor(node.value) is None):
             continue
         for tgt in node.targets:
             if (isinstance(tgt, ast.Attribute)
@@ -100,7 +102,17 @@ def _mutations(method, locks) -> List[Tuple[str, bool, ast.AST, str]]:
                 visit(child, guarded or holds)
             return
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda, ast.ClassDef)):
+                             ast.Lambda)):
+            # A nested def/lambda (a gauge set_fn closure, a sort
+            # key) runs at CALL time: the enclosing `with` is NOT
+            # held then, so its mutations are analyzed as unguarded
+            # rather than skipped (the pre-fix blind spot).
+            body = (node.body if isinstance(node.body, list)
+                    else [node.body])
+            for child in body:
+                visit(child, False)
+            return
+        if isinstance(node, ast.ClassDef):
             return
         if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
             tgts = (node.targets if isinstance(node, ast.Assign)
@@ -121,10 +133,7 @@ def _mutations(method, locks) -> List[Tuple[str, bool, ast.AST, str]]:
                     out.append((attr, guarded, node,
                                 f".{fn.attr}() call"))
         for child in ast.iter_child_nodes(node):
-            if not isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef,
-                                      ast.Lambda, ast.ClassDef)):
-                visit(child, guarded)
+            visit(child, guarded)
 
     for stmt in method.node.body:
         visit(stmt, False)

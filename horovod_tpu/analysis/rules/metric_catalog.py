@@ -1,0 +1,147 @@
+"""HVD010: metric names drifting from the obs/catalog.py contract.
+
+`obs/catalog.py` is the single declaration site for every metric
+family (names, kinds, labels, docs) — the exporter pre-declares from
+it and docs/observability.md's table is generated prose of it. Two
+drift directions break that contract:
+
+* a subsystem calling ``reg.counter/gauge/histogram("name", ...)``
+  with a literal name **not** declared in the catalog creates a
+  family the docs/dashboards never heard of (flagged at the call);
+* a catalog entry whose dict key is never fetched anywhere
+  (``..._metrics()["key"]`` / a key subscript on a stored family
+  dict) is a dead declaration — scrapes show a family no code can
+  ever move (flagged at the declaration).
+
+Dynamic names (f-strings, derived names in the fleet aggregator) are
+invisible to a literal scan and are out of scope by design — the
+catalog contract is about the *static* vocabulary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set, Tuple
+
+from horovod_tpu.analysis.core import (
+    Finding, RuleMeta, const_str, dotted_name,
+)
+
+RULE = RuleMeta(
+    id="HVD010",
+    name="metric-catalog-drift",
+    severity="error",
+    doc="Metric constructed through the registry with a literal name "
+        "not declared in obs/catalog.py, or a catalog entry whose "
+        "key is never fetched by any subsystem (dead declaration).")
+
+_CATALOG = "obs/catalog.py"
+_REGISTRY = "obs/registry.py"
+_CTORS = {"counter", "gauge", "histogram"}
+
+
+def _catalog_module(project):
+    for mi in project.symbols.modules.values():
+        if mi.path.endswith(_CATALOG):
+            return mi
+    return None
+
+
+def _catalog_entries(tree) -> Dict[str, Tuple[str, int]]:
+    """{metric name: (dict key, lineno)} from the catalog's
+    ``"key": reg.counter("name", ...)`` declaration dicts."""
+    out: Dict[str, Tuple[str, int]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for key, value in zip(node.keys, node.values):
+            k = const_str(key) if key is not None else None
+            if k is None or not isinstance(value, ast.Call):
+                continue
+            fn = dotted_name(value.func) or ""
+            if fn.split(".")[-1] not in _CTORS or not value.args:
+                continue
+            name = const_str(value.args[0])
+            if name:
+                out[name] = (k, value.args[0].lineno)
+    return out
+
+
+def _used_keys(project) -> Set[str]:
+    """Every string literal outside the catalog — the conservative
+    'somebody fetches this entry' evidence. Catalog keys reach their
+    fetch sites through indirection this scan cannot chase
+    (``self._m[name].inc()`` behind ``self._count("retries")``, the
+    ``name in ("prefix_hits", ...)`` dispatch in serving/metrics.py),
+    so presence of the key string ANYWHERE else is the only
+    false-positive-free liveness signal; a key string that occurs
+    nowhere else is certainly dead."""
+    out: Set[str] = set()
+    for mi in project.symbols.modules.values():
+        if mi.path.endswith(_CATALOG):
+            continue
+        for node in ast.walk(mi.src.tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                out.add(node.value)
+    return out
+
+
+def _live_catalog_names() -> Set[str]:
+    """Declared names harvested from the INSTALLED catalog's source —
+    the subtree/fixture-run fallback (mirrors HVD005's live-registry
+    fallback) so real metric names don't produce phantom findings when
+    obs/catalog.py is not in the analyzed file set."""
+    try:
+        from horovod_tpu.obs import catalog as _cat
+        with open(_cat.__file__) as fh:
+            tree = ast.parse(fh.read())
+    except (ImportError, OSError, SyntaxError):
+        return set()    # analyzing a foreign tree — static only
+    return set(_catalog_entries(tree))
+
+
+def check(project):
+    cat_mi = _catalog_module(project)
+    if cat_mi is None:
+        entries = {}
+        declared = _live_catalog_names()
+    else:
+        entries = _catalog_entries(cat_mi.src.tree)
+        declared = set(entries)
+
+    for mi in project.symbols.modules.values():
+        if mi.path.endswith((_CATALOG, _REGISTRY)):
+            continue
+        for node in ast.walk(mi.src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in _CTORS and node.args):
+                continue
+            name = const_str(node.args[0])
+            if name is None or name in declared:
+                continue
+            yield Finding(
+                RULE.id, RULE.severity, mi.path, node.lineno,
+                node.col_offset,
+                f"metric {name!r} constructed via .{fn.attr}() but "
+                f"not declared in horovod_tpu/obs/catalog.py — "
+                f"undeclared families are invisible to the exporter "
+                f"pre-declaration and the generated docs table")
+
+    # Dead-entry direction only when the catalog itself is in the
+    # analyzed set — a subtree run without the consumers would call
+    # every entry dead.
+    if cat_mi is None:
+        return
+    used = _used_keys(project)
+    for name in sorted(entries):
+        key, line = entries[name]
+        if key not in used:
+            yield Finding(
+                RULE.id, RULE.severity, cat_mi.path, line, 0,
+                f"catalog entry {name!r} (key {key!r}) is never "
+                f"fetched by any subsystem — dead declaration; "
+                f"wire it up or delete it")

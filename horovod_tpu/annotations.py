@@ -8,7 +8,7 @@ function unchanged, so they are free to stack above `jax.jit` /
 
 from __future__ import annotations
 
-__all__ = ["hot_path"]
+__all__ = ["hot_path", "thread_entry"]
 
 
 def hot_path(fn):
@@ -25,6 +25,24 @@ def hot_path(fn):
     """
     try:
         fn.__hvd_hot_path__ = True
+    except (AttributeError, TypeError):
+        pass
+    return fn
+
+
+def thread_entry(fn):
+    """Mark ``fn`` as a thread entry point the analyzer cannot see.
+
+    `hvdlint`'s HVD008 (cross-thread-race) discovers thread roots from
+    ``threading.Thread(target=...)`` sites it can resolve statically;
+    a target passed through a callback table, a partial, or an
+    executor is invisible. Decorating the function declares "this body
+    runs on its own thread" so its reachable attribute accesses join
+    the cross-thread analysis. Matched syntactically, like
+    `hot_path`.
+    """
+    try:
+        fn.__hvd_thread_entry__ = True
     except (AttributeError, TypeError):
         pass
     return fn

@@ -35,8 +35,11 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from horovod_tpu.obs import catalog as _catalog
 from horovod_tpu.obs import straggler as _straggler
 from horovod_tpu.obs.registry import MetricRegistry, registry
+
+from horovod_tpu.analysis import lockcheck
 
 __all__ = ["rank_snapshot", "FleetAggregator", "FleetSnapshot",
            "install", "default_aggregator", "SNAPSHOT_SCHEMA"]
@@ -141,7 +144,8 @@ class FleetAggregator:
     locked)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "FleetAggregator._lock", threading.Lock())
         self._sources: List[Tuple[str, Callable[[], Dict]]] = []
 
     # -- sources ------------------------------------------------------
@@ -214,29 +218,19 @@ class FleetAggregator:
         fleet = MetricRegistry()
         notes: List[str] = []
         ranks = [int(s.get("rank", i)) for i, s in enumerate(snaps)]
-        fleet.gauge("hvd_fleet_ranks",
-                    "Ranks contributing to this fleet snapshot"
-                    ).set(len(snaps))
-        fleet.gauge("hvd_fleet_ranks_failed",
-                    "Ranks whose snapshot pull failed this collect"
-                    ).set(len(failed))
+        own = _catalog.fleet_metrics(fleet)
+        own["ranks"].set(len(snaps))
+        own["ranks_failed"].set(len(failed))
         self._merge_metrics(fleet, snaps, notes)
         report = _straggler.merge_windows(
             [s.get("collectives") or {} for s in snaps])
         if report is not None:
-            fleet.gauge(
-                "hvd_fleet_straggler_rank",
-                "Slowest rank by mean collective/fusion-cycle "
-                "dispatch time in the merged windows"
-            ).set(report["slowest_rank"])
             # NOT named hvd_fleet_collective_skew_seconds: that name
             # is taken by the MERGE of the per-rank
             # hvd_collective_skew_seconds histograms above.
-            fleet.gauge(
-                "hvd_fleet_straggler_skew_seconds",
-                "Cross-rank skew of mean collective dispatch time "
-                "in the merged windows (slowest - fastest)"
-            ).set(report["skew_s"])
+            strag = _catalog.fleet_straggler_metrics(fleet)
+            strag["straggler_rank"].set(report["slowest_rank"])
+            strag["straggler_skew"].set(report["skew_s"])
         return FleetSnapshot(registry=fleet, ranks=ranks,
                              failed=failed, straggler=report,
                              ts=time.time(), notes=notes)
@@ -365,7 +359,8 @@ class FleetAggregator:
 # ---------------------------------------------------------------------------
 
 _FLEET: Optional[FleetAggregator] = None
-_FLEET_LOCK = threading.Lock()
+_FLEET_LOCK = lockcheck.register(
+    "aggregate._FLEET_LOCK", threading.Lock())
 
 
 def install(agg: Optional[FleetAggregator]

@@ -539,6 +539,44 @@ def tenant_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
     }
 
 
+def fleet_metrics(reg: MetricRegistry) -> Dict:
+    """The fleet aggregator's own accounting (obs/aggregate.py).
+    Constructed on the aggregator's per-collect registry — `reg` is
+    REQUIRED (no global default): these families describe one merged
+    snapshot, never the process-local scrape, so landing them on the
+    global registry would be a bug. Not part of
+    `declare_standard_metrics` for the same reason. The merged
+    per-family `*_fleet`/`*_rank_skew` names are derived dynamically
+    from the rank families and are intentionally outside this
+    catalog."""
+    return {
+        "ranks": reg.gauge(
+            "hvd_fleet_ranks",
+            "Ranks contributing to this fleet snapshot"),
+        "ranks_failed": reg.gauge(
+            "hvd_fleet_ranks_failed",
+            "Ranks whose snapshot pull failed this collect"),
+    }
+
+
+def fleet_straggler_metrics(reg: MetricRegistry) -> Dict:
+    """Fleet-level straggler attribution from the merged collective
+    windows (obs/aggregate.py). Separate from `fleet_metrics` because
+    these gauges exist only when a straggler report merged — an
+    unconditional 0-valued hvd_fleet_straggler_rank would accuse
+    rank 0."""
+    return {
+        "straggler_rank": reg.gauge(
+            "hvd_fleet_straggler_rank",
+            "Slowest rank by mean collective/fusion-cycle dispatch "
+            "time in the merged windows"),
+        "straggler_skew": reg.gauge(
+            "hvd_fleet_straggler_skew_seconds",
+            "Cross-rank skew of mean collective dispatch time in "
+            "the merged windows (slowest - fastest)"),
+    }
+
+
 def declare_standard_metrics(
         reg: Optional[MetricRegistry] = None) -> Dict[str, Dict]:
     """Idempotently declare every standard family; the exporter calls

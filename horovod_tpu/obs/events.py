@@ -31,10 +31,151 @@ from typing import Dict, List, Optional
 
 from horovod_tpu.obs import catalog
 
-__all__ = ["EventLog", "emit", "tail", "get", "configure"]
+from horovod_tpu.analysis import lockcheck
+
+__all__ = ["EventLog", "EVENT_CATALOG", "emit", "tail", "get",
+           "configure", "event_table_md"]
 
 
 DEFAULT_RING = 2048
+
+# Every event ``kind`` the subsystems may emit, with the one-line
+# description an operator reads in docs/observability.md (the event
+# table there is generated from this dict by ``python -m
+# horovod_tpu.analysis --write-event-table``). hvdlint's HVD011 pins
+# both directions: an emit of an undeclared kind and a declared kind
+# nothing emits are findings. Keep kinds literal at emit sites —
+# that is what makes an incident greppable.
+EVENT_CATALOG: Dict[str, str] = {
+    "chaos.fire":
+        "A chaos-injection site fired (resilience/chaos.py)",
+    "collective.straggler":
+        "Straggler attribution: one rank's collective dispatch is "
+        "skewed beyond threshold (obs/straggler.py)",
+    "detector.dead":
+        "Phi-accrual detector declared a peer dead",
+    "detector.recovered":
+        "A suspect/dead peer's heartbeats resumed",
+    "detector.suspect":
+        "Phi-accrual detector marked a peer suspect",
+    "disagg.export_failed":
+        "KV-block export from the prefill pool failed; handoff "
+        "falls back to token-level recompute",
+    "disagg.handoff":
+        "Prefill->decode handoff completed (request resumed on a "
+        "decode replica)",
+    "disagg.prefill_dead":
+        "A prefill replica was declared dead by the disagg router",
+    "disagg.prefill_failed":
+        "Prefill execution failed; request fell back to the decode "
+        "pool's own prefill",
+    "disagg.prefill_replace":
+        "A dead prefill replica was replaced from the spawner",
+    "disagg.transfer_ingested":
+        "A KV-block transfer passed digest verify and was adopted "
+        "by the destination pool",
+    "disagg.transfer_rejected":
+        "A KV-block transfer failed digest/geometry verify on "
+        "ingest (falls back to recompute)",
+    "flightrec.dump":
+        "A flight-recorder post-mortem bundle was written",
+    "membership.rank_death":
+        "Membership sweep observed a member's lease expire",
+    "membership.rank_join":
+        "Membership sweep admitted a newly announced member",
+    "membership.resize":
+        "A membership generation change committed (world resize)",
+    "profile.start":
+        "jax.profiler trace collection started",
+    "profile.stop":
+        "jax.profiler trace collection stopped",
+    "router.drain":
+        "A replica was put into drain (no new placements)",
+    "router.drained":
+        "A draining replica finished its in-flight work",
+    "router.hedge":
+        "A hedge request was launched against a second replica",
+    "router.hedge_suppressed":
+        "A hedge was skipped (tenant brownout >= 1)",
+    "router.migrate":
+        "An in-flight request began KV migration to another replica",
+    "router.migrate_failed":
+        "A migration attempt failed (request continues or retries)",
+    "router.migrate_terminal":
+        "A migration failed terminally; the request errored",
+    "router.migrated_complete":
+        "A migrated request completed on its destination replica",
+    "router.replace":
+        "A dead replica was replaced from the spawner",
+    "router.replacement_budget_exhausted":
+        "A replica death could not be replaced: replacement budget "
+        "spent",
+    "router.replica_dead":
+        "The router declared a replica dead",
+    "router.retry":
+        "A failed request was retried on another replica",
+    "router.retry_budget_exhausted":
+        "A retry was denied: the retry budget is spent",
+    "serving.brownout":
+        "A tenant moved on the brownout ladder (escalate/recover)",
+    "serving.compile":
+        "First-time-shape XLA compile in the slot pool / pager",
+    "serving.contain":
+        "The engine contained a poisoned request after repeated "
+        "restart loops",
+    "serving.preempt":
+        "A decode stream was preempted (swap or recompute) to admit "
+        "higher-priority work",
+    "serving.queue_drop":
+        "An admitted request was dropped from the queue (deadline "
+        "or preemption policy)",
+    "serving.restart":
+        "The engine watchdog restarted the dispatch thread in place",
+    "serving.retire":
+        "A decode stream was retired by the overload controller",
+    "serving.shed":
+        "Admission shed a request (queue full / brownout / "
+        "watermark)",
+    "serving.submit":
+        "A request entered the engine queue",
+    "serving.swap_restore_failed":
+        "A preempted stream's shelved KV could not be restored; "
+        "resume fell back to recompute",
+    "slo.breach":
+        "A fleet SLO objective entered fast-burn breach",
+    "slo.clear":
+        "A breaching SLO objective recovered",
+    "slo.tenant_breach":
+        "A tenant-scoped SLO objective entered fast-burn breach",
+    "slo.tenant_clear":
+        "A breaching tenant-scoped objective recovered",
+    "stall":
+        "The stall watchdog saw a collective exceed its warning "
+        "time (utils/stall.py)",
+    "training.cursor_fallback":
+        "Resume could not honor the exact data cursor; fell back to "
+        "epoch start",
+    "training.emergency_save":
+        "A preemption signal triggered an emergency checkpoint",
+    "training.resize":
+        "Elastic training re-sharded onto a new world size",
+    "training.resume":
+        "Training resumed from a snapshot (exact or fallback "
+        "cursor)",
+    "training.rollback":
+        "A non-finite loss rolled training back to the last "
+        "snapshot",
+}
+
+
+def event_table_md() -> str:
+    """The docs/observability.md event table, generated from
+    `EVENT_CATALOG` (the drift-pinned twin of config.env_table_md)."""
+    lines = ["| kind | meaning |", "| --- | --- |"]
+    for kind in sorted(EVENT_CATALOG):
+        desc = " ".join(EVENT_CATALOG[kind].split())
+        lines.append(f"| `{kind}` | {desc} |")
+    return "\n".join(lines) + "\n"
 
 
 def _ring_capacity() -> int:
@@ -51,7 +192,8 @@ class EventLog:
                  max_bytes: int = 8 * 1024 * 1024):
         if maxlen is None:
             maxlen = _ring_capacity()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "EventLog._lock", threading.Lock())
         self._ring: collections.deque = collections.deque(maxlen=maxlen)
         self._seq = 0
         self._path = path or None
@@ -131,7 +273,8 @@ class EventLog:
 
 
 _LOG: Optional[EventLog] = None
-_LOG_LOCK = threading.Lock()
+_LOG_LOCK = lockcheck.register(
+    "events._LOG_LOCK", threading.Lock())
 
 
 def get() -> EventLog:

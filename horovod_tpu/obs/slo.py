@@ -37,6 +37,8 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from horovod_tpu.analysis import lockcheck
+
 __all__ = ["Objective", "SLOMonitor", "DEFAULT_FAST_BURN",
            "DEFAULT_FAST_WINDOW_S", "DEFAULT_SLOW_WINDOW_S"]
 
@@ -126,7 +128,8 @@ class SLOMonitor:
                     f"rate is {1.0 / o.budget:g}, so a breach (and "
                     f"the /healthz 503) can never fire; tighten "
                     f"target= or lower burn=\n")
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "SLOMonitor._lock", threading.Lock())
         # name -> deque of [second_ts, n, bad] BUCKETS (newest right):
         # bounding by 1-second time buckets instead of raw events
         # keeps the slow window intact at ANY request rate (a raw

@@ -36,6 +36,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from horovod_tpu.analysis import lockcheck
+
 __all__ = ["StragglerTracker", "tracker", "merge_windows",
            "install_exchange", "last_report", "STRAGGLER_FACTOR"]
 
@@ -164,7 +166,8 @@ class StragglerTracker:
         # None = local-only (the single-process default — the fleet
         # aggregator then merges windows it pulled itself).
         self.exchange_fn = exchange_fn
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "StragglerTracker._lock", threading.Lock())
         self._ops: Dict[str, List[float]] = {}  # op -> [n, total, max]
         self._n = 0
         self._t0 = time.time()
@@ -292,7 +295,8 @@ _EXCHANGE_ERRORS = (RuntimeError, ValueError, TypeError, OSError,
 
 
 _TRACKER: Optional[StragglerTracker] = None
-_TRACKER_LOCK = threading.Lock()
+_TRACKER_LOCK = lockcheck.register(
+    "straggler._TRACKER_LOCK", threading.Lock())
 
 
 def tracker() -> StragglerTracker:

@@ -58,6 +58,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from horovod_tpu.runtime.config import env_float, env_int
 
+from horovod_tpu.analysis import lockcheck
+
 __all__ = ["FailureDetector", "PeerView", "shared_detector",
            "install_detector", "ALIVE", "SUSPECT", "DEAD"]
 
@@ -153,7 +155,8 @@ class FailureDetector:
         if sweep_s is None:
             sweep_s = env_float("HVD_DETECTOR_SWEEP_S", 0.05)
         self.sweep_s = max(_MIN_SWEEP_S, float(sweep_s))
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "FailureDetector._lock", threading.Lock())
         self._peers: Dict[str, _Peer] = {}
         self._stop = threading.Event()
         self._wake = threading.Event()
@@ -509,7 +512,8 @@ class FailureDetector:
 # ---------------------------------------------------------------------------
 
 _SHARED: Optional[FailureDetector] = None
-_SHARED_LOCK = threading.Lock()
+_SHARED_LOCK = lockcheck.register(
+    "detector._SHARED_LOCK", threading.Lock())
 
 
 def shared_detector() -> FailureDetector:

@@ -57,6 +57,8 @@ from horovod_tpu.resilience import detector as _detector
 from horovod_tpu.resilience.retry import RetryError, RetryPolicy
 from horovod_tpu.runtime.config import env_float, env_int
 
+from horovod_tpu.analysis import lockcheck
+
 
 class MembershipError(RuntimeError):
     """This member cannot continue in the world — typically it was
@@ -143,7 +145,8 @@ class InProcessKV:
     writers must not mutate after put)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "InProcessKV._lock", threading.Lock())
         self._d: Dict[str, Any] = {}
 
     def put(self, key: str, value) -> None:
@@ -216,7 +219,8 @@ class BootstrapKV:
                 "custom transport via membership.install_kv")
         self._native = native
         self._policy = policy if policy is not None else _kv_policy()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "BootstrapKV._lock", threading.Lock())
         self._last_ok_t = float("-inf")
         self.reconnects = 0
 
@@ -356,7 +360,8 @@ class ChaosKV:
 # multi-controller launch installs an adapter over its rendezvous
 # service once, before monitors are built.
 _KV: Optional[Any] = None
-_KV_LOCK = threading.Lock()
+_KV_LOCK = lockcheck.register(
+    "membership._KV_LOCK", threading.Lock())
 
 
 def install_kv(kv: Optional[Any]) -> Optional[Any]:
@@ -457,7 +462,8 @@ class WorldMonitor:
         self.heartbeat_s = float(heartbeat_s)
         self.clock = clock
         self.on_change = on_change
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "WorldMonitor._lock", threading.Lock())
         self._members: List[str] = (
             list(initial_members) if initial_members is not None
             else (_default_members(world) if world is not None else []))
@@ -642,12 +648,17 @@ class WorldMonitor:
         adopts the commit and raises `MembershipError` if it excludes
         this member (never split-brain at the old generation)."""
         dead, joiners = self.dead_members(), self.joiners()
-        newer = self.kv.get(f"commit/{self.generation + 1}")
+        # Snapshot under the lock `_adopt` writes it under (hvdlint
+        # HVD008): this runs on the watcher thread while a caller
+        # thread may be mid-resize.
+        with self._lock:
+            gen = self.generation
+        newer = self.kv.get(f"commit/{gen + 1}")
         if not dead and not joiners and newer is None:
             return None
         out: Dict[str, Any] = {"dead": dead, "joiners": joiners}
         if newer is not None:
-            out["commit"] = self.generation + 1
+            out["commit"] = gen + 1
         return out
 
     # -- the watcher thread --------------------------------------------
@@ -660,6 +671,7 @@ class WorldMonitor:
             self.kv.put_if_absent("commit/0", {
                 "generation": 0, "members": list(members),
                 "died": [], "joined": []})
+        # hvd: disable=HVD008(written before Thread.start() below — start() publishes it to the watcher thread, happens-before, not a race)
         self._start_t = self.clock()
         self.heartbeat()
         self._sync_detector_peers()
@@ -1096,7 +1108,8 @@ class SimulatedWorld:
         self.kv = kv if kv is not None else InProcessKV()
         self.members0 = _default_members(world)
         self.barrier = ElasticBarrier(self.members0)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "SimulatedWorld._lock", threading.Lock())
         self._ctl: Dict[str, Any] = {
             "victim": None, "stop": False, "joins_spawned": 0,
             "contrib": {}, "death_t": {}, "logs": {}, "resizes": [],

@@ -313,6 +313,16 @@ register_knob(
     "tail window and the flight-recorder bundle's run-up depth), "
     "docs/observability.md")
 register_knob(
+    "HVD_LOCK_CHECK", "int", "0", "analysis/lockcheck.py",
+    "1 = wrap every lockcheck.register()-ed lock in the runtime "
+    "order witness (records acquisition edges, flags inversions); "
+    "0 = hand back the raw lock, zero overhead (docs/analysis.md)")
+register_knob(
+    "HVD_LOCK_CHECK_OUT", "str", "(unset)", "analysis/lockcheck.py",
+    "With HVD_LOCK_CHECK=1: write the observed lock-order graph and "
+    "any inversions as JSON to this path at process exit (the CI "
+    "zero-inversion gate's evidence)")
+register_knob(
     "HVD_FLIGHT_DIR", "str", "(unset)", "obs/flightrec.py",
     "Crash flight recorder: dump a post-mortem bundle (event ring + "
     "metric snapshot + in-flight trace_ids + config) here on watchdog "

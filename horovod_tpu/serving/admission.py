@@ -39,6 +39,8 @@ from typing import Any, List, Optional
 
 import numpy as np
 
+from horovod_tpu.analysis import lockcheck
+
 
 class ServingError(RuntimeError):
     """Base class for serving-engine errors."""
@@ -221,7 +223,8 @@ class AdmissionQueue:
         # (an idle tenant must not bank unbounded credit).
         self._vtime: dict = {}
         self._vclock = 0.0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "AdmissionQueue._lock", threading.Lock())
         self._event = threading.Event()
         self._closed = False
         # Metrics/tracing hook for drops resolved OUTSIDE a dispatcher

@@ -64,6 +64,8 @@ from horovod_tpu.serving.scheduler import (
 from horovod_tpu.serving.slots import SlotPool
 from horovod_tpu.utils.stall import StallMonitor
 
+from horovod_tpu.analysis import lockcheck
+
 __all__ = ["ServingEngine", "RequestHandle", "CompletedRequest",
            "SamplingParams", "QueueFullError", "EngineClosedError"]
 
@@ -488,7 +490,8 @@ class ServingEngine:
             pipeline_depth=self.pipeline_depth, grafts=self._grafts,
             overload=self._overload)
         self._ids = itertools.count()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "ServingEngine._lock", threading.Lock())
         self._closing = False
         self._drain = True
         # Restart machinery: `_epoch` names the CURRENT dispatch
@@ -889,6 +892,10 @@ class ServingEngine:
                 if self._closing:
                     return
                 thread = self._thread
+                # Snapshot under the same lock the dispatch thread
+                # writes it under (hvdlint HVD008) — the bare read
+                # raced the writer it was timing.
+                heartbeat = self._heartbeat
             dead = not thread.is_alive()
             # Stuck = stale heartbeat with work pending, EXCEPT while
             # the pool may be inside a first-time-shape XLA compile
@@ -900,7 +907,7 @@ class ServingEngine:
                      and not self.pool.maybe_compiling
                      and (self.scheduler.has_active()
                           or len(self.queue) > 0)
-                     and (time.time() - self._heartbeat
+                     and (time.time() - heartbeat
                           > self.tick_deadline_s))
             if not (dead or stuck):
                 continue
@@ -916,10 +923,10 @@ class ServingEngine:
         """Restart the engine in place: abandon the old dispatch
         generation, re-queue its recoverable requests, stand up a
         fresh slot pool + scheduler + dispatch thread."""
-        t_fault = self._heartbeat   # last sign of life
         with self._lock:
             if self._closing:
                 return
+            t_fault = self._heartbeat   # last sign of life
             self._epoch += 1
             epoch = self._epoch
             self._restart_count += 1

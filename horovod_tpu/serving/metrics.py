@@ -35,6 +35,8 @@ from typing import Dict, Optional
 
 from horovod_tpu.obs import catalog as _obs_catalog
 
+from horovod_tpu.analysis import lockcheck
+
 
 class Series:
     """Bounded sample reservoir with percentile readout."""
@@ -95,7 +97,8 @@ class EngineMetrics:
     """
 
     def __init__(self, engine_label: str = "0", slo=None):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "EngineMetrics._lock", threading.Lock())
         self._t0 = time.time()
         # Optional obs.slo.SLOMonitor: this class is the single point
         # every finished request and every shed decision already flows

@@ -34,6 +34,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from horovod_tpu.analysis import lockcheck
+
 __all__ = ["parse_tenant_weights", "SwapStore", "PreemptionPolicy",
            "BrownoutController", "OverloadControl",
            "BROWNOUT_MAX_LEVEL"]
@@ -93,7 +95,8 @@ class SwapStore:
             raise ValueError(
                 f"swap budget must be >= 1 byte, got {max_bytes}")
         self.max_bytes = int(max_bytes)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "SwapStore._lock", threading.Lock())
         self._entries: Dict[int, object] = {}
         self._bytes = 0
 
@@ -200,7 +203,8 @@ class BrownoutController:
         self.hold_s = float(hold_s)
         self.cooldown_s = float(cooldown_s)
         self.interval_s = float(interval_s)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "BrownoutController._lock", threading.Lock())
         self._levels: Dict[str, int] = {}
         self._changed: Dict[str, float] = {}
         self._tenants: Dict[str, bool] = {}   # insertion-ordered set

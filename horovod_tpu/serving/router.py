@@ -78,6 +78,8 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as _np
 
+from horovod_tpu.analysis import lockcheck
+
 from horovod_tpu.obs import catalog as _obs_catalog
 from horovod_tpu.obs import events as _events
 from horovod_tpu.obs import flightrec as _flightrec
@@ -121,7 +123,8 @@ class RetryBudget:
                       if refill_window_s > 0 else 0.0)
         self._tokens = float(self.capacity)
         self._last = time.time()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "RetryBudget._lock", threading.Lock())
 
     def _refill(self, now: float):
         # hvd: disable=HVD004(private helper only ever called with self._lock held by try_spend and tokens)
@@ -330,7 +333,8 @@ class ServingRouter:
         # hvd_router_* families are process-global — a second router in
         # the process must not pollute this one's snapshot).
         self._counts: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "ServingRouter._lock", threading.Lock())
         self._rep_ids = itertools.count()
         self._req_ids = itertools.count()
         self._replicas: Dict[int, "_Replica"] = {}

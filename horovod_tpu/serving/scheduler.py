@@ -63,6 +63,8 @@ from horovod_tpu.serving.admission import (
 from horovod_tpu.serving.metrics import EngineMetrics
 from horovod_tpu.serving.slots import SlotPool
 
+from horovod_tpu.analysis import lockcheck
+
 
 @dataclass(frozen=True)
 class CompletedRequest:
@@ -209,7 +211,8 @@ class ContinuousBatchingScheduler:
         # request can never fall between the successor's snapshot and
         # the old thread's bookkeeping (a stranded future).
         self.abandoned = False
-        self._handoff = threading.Lock()
+        self._handoff = lockcheck.register(
+            "ContinuousBatchingScheduler._handoff", threading.Lock())
         self._gen = next(_SCHED_GEN)
 
     def abandon(self) -> List[Request]:

@@ -17,6 +17,8 @@ import sys
 import threading
 import time
 
+from horovod_tpu.analysis import lockcheck
+
 
 class StallMonitor:
     def __init__(self, warning_time_s: float = 60.0,
@@ -28,7 +30,8 @@ class StallMonitor:
         self._thread = None
         self._stop = threading.Event()
         self._stopped = False
-        self._lock = threading.Lock()
+        self._lock = lockcheck.register(
+            "StallMonitor._lock", threading.Lock())
         # Delegate to the C++ detector (control_plane.cc) when loaded;
         # it runs its own sweep thread.
         self._native = None

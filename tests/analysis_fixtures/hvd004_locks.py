@@ -33,6 +33,32 @@ class SuppressedDiscipline:
         self.state = 2
 
 
+class ClosureMutation:
+    """The pre-fix blind spot: a gauge set_fn closure (or sort-key
+    lambda) mutating a guarded attribute runs at SCRAPE time, without
+    the lock the enclosing method held."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.samples = []
+        self.window = []
+
+    def guarded(self):
+        with self._lock:
+            self.samples.append(1)
+            self.window.append(2)
+
+    def register_gauge(self, reg):
+        def scrape():
+            self.samples.pop()                         # EXPECT
+            return len(self.samples)
+        reg.gauge("fixture_samples", set_fn=scrape)
+
+    def register_lambda(self, reg):
+        reg.gauge("fixture_window",
+                  set_fn=lambda: self.window.pop())    # EXPECT
+
+
 class ConsistentDiscipline:
     """Clean negative: every mutation holds the lock."""
 

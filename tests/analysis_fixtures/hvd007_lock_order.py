@@ -1,0 +1,105 @@
+"""HVD007 fixture: lock-order cycles (potential deadlock)."""
+
+import threading
+
+
+class Deadlock:
+    """Positive: the classic AB/BA inversion between two methods."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def forward(self):
+        with self._a:
+            with self._b:                              # EXPECT
+                pass
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                pass
+
+
+class CallCycle:
+    """Positive: one leg of the cycle hides behind a method call made
+    while holding the first lock."""
+
+    def __init__(self):
+        self._x = threading.Lock()
+        self._y = threading.Lock()
+
+    def xy(self):
+        with self._x:
+            self._take_y()                             # EXPECT
+
+    def _take_y(self):
+        with self._y:
+            pass
+
+    def yx(self):
+        with self._y:
+            with self._x:
+                pass
+
+
+class SuppressedDeadlock:
+    """Suppressed positive: a known inversion carrying its reason."""
+
+    def __init__(self):
+        self._c = threading.Lock()
+        self._d = threading.Lock()
+
+    def forward(self):
+        with self._c:
+            # hvd: disable=HVD007(drain path only; both callers serialize on the module init lock first - SUPPRESSED)
+            with self._d:
+                pass
+
+    def backward(self):
+        with self._d:
+            with self._c:
+                pass
+
+
+class ConsistentOrder:
+    """Clean negative: both paths acquire in the same order."""
+
+    def __init__(self):
+        self._first = threading.Lock()
+        self._second = threading.Lock()
+
+    def one(self):
+        with self._first:
+            with self._second:
+                pass
+
+    def two(self):
+        with self._first:
+            self._nested()
+
+    def _nested(self):
+        with self._second:
+            pass
+
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+
+class Outer:
+    """Clean negative: a cross-object edge (Outer._lock ->
+    Inner._lock) with no reverse path is a DAG, not a cycle."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.inner = Inner()
+
+    def call_under_lock(self):
+        with self._lock:
+            self.inner.poke()

@@ -1,0 +1,136 @@
+"""HVD008 fixture: cross-thread shared state with no common lock."""
+
+import threading
+
+from horovod_tpu.annotations import thread_entry
+
+
+class MixedWorld:
+    """Positives: the writer thread publishes under the lock (or
+    bare) while the reader thread reads with no lock at all."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.beat = 0.0
+        self.count = 0
+
+    def start(self):
+        threading.Thread(target=self._writer).start()
+        threading.Thread(target=self._reader).start()
+
+    def _writer(self):
+        with self._lock:
+            self.beat = 1.0                            # EXPECT
+        self.count += 1                                # EXPECT
+
+    def _reader(self):
+        if self.beat > 0.0:
+            print(self.count)
+
+
+class CallbackWorld:
+    """Positive through @thread_entry: a callback a foreign thread
+    invokes writes bare while the drain thread reads under the
+    lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.last = None
+
+    @thread_entry
+    def on_remote_event(self, payload):
+        self.last = payload                            # EXPECT
+
+    def start(self):
+        threading.Thread(target=self._drain).start()
+
+    def _drain(self):
+        with self._lock:
+            if self.last is not None:
+                pass
+
+
+class PublishBeforeStart:
+    """Suppressed positive: written before Thread.start() publishes
+    it — a real happens-before the lexical analysis cannot see."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.config = None
+
+    def respawn(self):
+        # hvd: disable=HVD008(written before Thread.start() below publishes it - happens-before - SUPPRESSED)
+        self.config = {"generation": 1}
+        threading.Thread(target=self._run).start()
+        threading.Thread(target=self._respawner).start()
+
+    def _respawner(self):
+        self.respawn()
+
+    def _run(self):
+        if self.config:
+            return
+
+
+class EventSignals:
+    """Clean negative: threading.Event is internally synchronized —
+    .clear()/.set() are not shared-state writes."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def start(self):
+        threading.Thread(target=self._loop).start()
+        threading.Thread(target=self._resetter).start()
+
+    def _loop(self):
+        while not self._stop.wait(0.01):
+            pass
+
+    def _resetter(self):
+        self._stop.clear()
+
+
+class GuardedWorld:
+    """Clean negative: both threads hold the same lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def start(self):
+        threading.Thread(target=self._bump).start()
+        threading.Thread(target=self._read).start()
+
+    def _bump(self):
+        with self._lock:
+            self.n += 1
+
+    def _read(self):
+        with self._lock:
+            return self.n
+
+
+class ClosureUnderLock:
+    """Clean negative: the helper closure is invoked INSIDE the with
+    block — call-site modeling must see its accesses as guarded."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.table = {}
+
+    def start(self):
+        threading.Thread(target=self._mutate).start()
+        threading.Thread(target=self._sweep).start()
+
+    def _mutate(self):
+        def drop(key):
+            self.table.pop(key, None)
+
+        with self._lock:
+            drop("stale")
+
+    def _sweep(self):
+        with self._lock:
+            self.table.clear()

@@ -1,0 +1,64 @@
+"""HVD009 fixture: blocking operations inside a held-lock scope."""
+
+import queue
+import threading
+import time
+
+
+class SleepyCritical:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+        self._t = threading.Thread(target=self._loop)
+
+    def _loop(self):
+        pass
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(0.1)                            # EXPECT
+
+    def bad_join(self):
+        with self._lock:
+            self._t.join()                             # EXPECT
+
+    def bad_get(self):
+        with self._lock:
+            return self._q.get()                       # EXPECT
+
+    def bad_device_sync(self, arr):
+        with self._lock:
+            arr.block_until_ready()                    # EXPECT
+
+    def suppressed_backoff(self):
+        with self._lock:
+            # hvd: disable=HVD009(bounded 1ms backoff measured under contention; see the bench - SUPPRESSED)
+            time.sleep(0.001)
+
+    def ok_nonblocking_get(self):
+        with self._lock:
+            return self._q.get(block=False)
+
+    def ok_outside(self):
+        time.sleep(0.1)
+        with self._lock:
+            pass
+
+    def ok_closure_escapes(self):
+        # The callback runs at scrape time, after the with exits.
+        with self._lock:
+            def cb():
+                time.sleep(0.1)
+            return cb
+
+
+class CondOk:
+    """Clean negative: Condition.wait on the HELD condition is the
+    designed sleep-with-release pattern."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def waiter(self):
+        with self._cv:
+            self._cv.wait(0.1)

@@ -1,0 +1,30 @@
+"""HVD010 fixture: metric names drifting from obs/catalog.py.
+
+Run against this file alone the rule falls back to the INSTALLED
+catalog for the declared-name set (the dead-entry direction needs the
+catalog module in the analyzed set and stays off here).
+"""
+
+
+def declare(reg):
+    reg.counter("hvd_fixture_undeclared_total",        # EXPECT
+                "constructed behind the catalog's back")
+    reg.gauge("hvd_fixture_rogue_depth",               # EXPECT
+              "also not in the catalog")
+    # hvd: disable=HVD010(migration shim: dual-publishes under the old name for one release - SUPPRESSED)
+    reg.counter("hvd_fixture_legacy_total", "old name kept warm")
+
+
+def declared_ok(reg):
+    # Clean negatives: real names from horovod_tpu/obs/catalog.py.
+    reg.gauge("hvd_serving_queue_depth",
+              "Requests waiting in the admission queue", ("engine",))
+    reg.counter(
+        "hvd_serving_events_total",
+        "Serving request/tick lifecycle events by kind", ("event",))
+
+
+def dynamic_ok(reg, name):
+    # Non-literal first arg: out of scope for the literal scan.
+    reg.counter(name, "derived name")
+    reg.counter(f"hvd_{name}_total", "f-string name")

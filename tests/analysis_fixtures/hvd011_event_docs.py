@@ -1,0 +1,33 @@
+"""HVD011 fixture: event kinds drifting from EVENT_CATALOG.
+
+Run against this file alone the rule falls back to the INSTALLED
+`horovod_tpu.obs.events.EVENT_CATALOG` for the declared-kind set (the
+dead-promise direction needs the events module in the analyzed set
+and stays off here).
+"""
+
+from horovod_tpu.obs import events
+
+
+def undocumented():
+    events.emit("fixture.unknown_kind", x=1)           # EXPECT
+
+
+def undocumented_local_import():
+    from horovod_tpu.obs import events as _events
+    _events.emit("fixture.other_unknown", y=2)         # EXPECT
+
+
+def suppressed_prototype():
+    # hvd: disable=HVD011(prototype event behind a flag; catalogued before the flag flips on - SUPPRESSED)
+    events.emit("fixture.experimental", z=3)
+
+
+def documented_ok():
+    # Clean negative: a kind the real catalog declares.
+    events.emit("serving.restart", engine=0, reason="fixture")
+
+
+def dynamic_ok(kind):
+    # Non-literal kind: out of scope for the literal scan.
+    events.emit(kind, x=1)

@@ -1,0 +1,42 @@
+"""Deliberately lock-order-inverted two-lock program — the runtime
+witness fixture.
+
+Run armed (``HVD_LOCK_CHECK=1``) the witness must report exactly one
+ORDER INVERSION on stderr and in the ``HVD_LOCK_CHECK_OUT`` dump;
+unarmed it runs silently (`register` hands back the raw locks).
+
+It never actually deadlocks: the two acquisition orders run on two
+threads executed SEQUENTIALLY — which is precisely the case the
+witness exists for (the schedule that didn't interleave badly this
+time still proves the hazard).
+"""
+
+import threading
+
+from horovod_tpu.analysis import lockcheck
+
+LOCK_A = lockcheck.register("invfix.LOCK_A", threading.Lock())
+LOCK_B = lockcheck.register("invfix.LOCK_B", threading.Lock())
+
+
+def forward():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+
+
+def backward():
+    with LOCK_B:
+        with LOCK_A:
+            pass
+
+
+def main():
+    for fn in (forward, backward):
+        t = threading.Thread(target=fn, name=fn.__name__)
+        t.start()
+        t.join()
+
+
+if __name__ == "__main__":
+    main()

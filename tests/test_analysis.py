@@ -34,6 +34,11 @@ FIXTURE_CASES = [
     ("hvd004_locks.py", "HVD004"),
     ("hvd005_env_registry.py", "HVD005"),
     ("hvd006_broad_except.py", "HVD006"),
+    ("hvd007_lock_order.py", "HVD007"),
+    ("hvd008_cross_thread.py", "HVD008"),
+    ("hvd009_blocking_lock.py", "HVD009"),
+    ("hvd010_metric_catalog.py", "HVD010"),
+    ("hvd011_event_docs.py", "HVD011"),
 ]
 
 
@@ -72,10 +77,11 @@ class TestRuleFixtures:
     def test_rule_catalog(self):
         ids = [mod.RULE.id for mod in ALL_RULES]
         assert ids == ["HVD001", "HVD002", "HVD003", "HVD004",
-                       "HVD005", "HVD006"]
+                       "HVD005", "HVD006", "HVD007", "HVD008",
+                       "HVD009", "HVD010", "HVD011"]
         assert all(mod.RULE.severity in ("error", "warning")
                    for mod in ALL_RULES)
-        assert len({mod.RULE.name for mod in ALL_RULES}) == 6
+        assert len({mod.RULE.name for mod in ALL_RULES}) == 11
 
 
 class TestRepoIsClean:
@@ -354,3 +360,178 @@ class TestEnvKnobTable:
         from horovod_tpu.resilience import chaos
         monkeypatch.setenv("HVD_CHAOS_SEED", "41")
         assert chaos._env_seed() == 41
+
+
+class TestEventTable:
+    def test_doc_table_matches_catalog(self):
+        """The observability event table is GENERATED from
+        EVENT_CATALOG (python -m horovod_tpu.analysis
+        --write-event-table) — pinned here so doc and catalog cannot
+        drift."""
+        from horovod_tpu.obs.events import event_table_md
+        doc = os.path.join(REPO, "docs", "observability.md")
+        with open(doc) as fh:
+            text = fh.read()
+        m = re.search(
+            r"<!-- hvdlint:event-table:begin -->\n(.*?)"
+            r"<!-- hvdlint:event-table:end -->", text, re.S)
+        assert m, "observability.md lost its event-table markers"
+        assert m.group(1) == event_table_md(), (
+            "docs/observability.md event table is stale — regenerate "
+            "with: python -m horovod_tpu.analysis --write-event-table")
+
+    def test_catalog_covers_known_kinds(self):
+        from horovod_tpu.obs.events import EVENT_CATALOG
+        for kind in ("serving.restart", "serving.submit", "stall",
+                     "chaos.fire", "membership.resize", "slo.breach",
+                     "collective.straggler", "flightrec.dump"):
+            assert kind in EVENT_CATALOG, kind
+
+
+class TestDriftSelfProof:
+    """The acceptance bar for the contract-drift rules: injecting an
+    undeclared metric (or an undocumented event kind) in a temp file
+    flips the CLI to exit 1."""
+
+    def _cli(self, path, rules):
+        return subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.analysis",
+             "--baseline",
+             os.path.join(REPO, ".hvdlint-baseline.json"),
+             "--rules", rules, "--json", str(path)],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+    def test_undeclared_metric_fails_gate(self, tmp_path):
+        bad = tmp_path / "injected_metric.py"
+        bad.write_text(textwrap.dedent("""\
+            def declare(reg):
+                return reg.counter("hvd_totally_new_total", "rogue")
+            """))
+        proc = self._cli(bad, "HVD010")
+        assert proc.returncode == 1, proc.stderr
+        out = json.loads(proc.stdout)
+        assert [f["rule"] for f in out["findings"]] == ["HVD010"]
+        assert "hvd_totally_new_total" in out["findings"][0]["message"]
+
+    def test_undocumented_event_fails_gate(self, tmp_path):
+        bad = tmp_path / "injected_event.py"
+        bad.write_text(textwrap.dedent("""\
+            from horovod_tpu.obs import events
+
+
+            def fire():
+                events.emit("injected.unknown_kind", x=1)
+            """))
+        proc = self._cli(bad, "HVD011")
+        assert proc.returncode == 1, proc.stderr
+        out = json.loads(proc.stdout)
+        assert [f["rule"] for f in out["findings"]] == ["HVD011"]
+        assert "injected.unknown_kind" in out["findings"][0]["message"]
+
+    def test_json_by_rule_counts(self, tmp_path):
+        proc = self._cli(
+            os.path.join(FIXTURES, "hvd009_blocking_lock.py"),
+            "HVD009")
+        assert proc.returncode == 1, proc.stderr
+        out = json.loads(proc.stdout)
+        assert out["by_rule"] == {
+            "HVD009": {"findings": 4, "suppressed": 1}}
+
+
+class TestDeadEntryDirections:
+    """The reverse drift directions run only when the declaring module
+    itself is in the analyzed set — proven on a mini-tree."""
+
+    def test_dead_catalog_entry(self, tmp_path):
+        obs = tmp_path / "obs"
+        obs.mkdir()
+        (obs / "catalog.py").write_text(textwrap.dedent("""\
+            def my_metrics(reg):
+                return {
+                    "used": reg.counter("hvd_mini_used_total", "d"),
+                    "dead": reg.counter("hvd_mini_dead_total", "d"),
+                }
+            """))
+        (tmp_path / "consumer.py").write_text(textwrap.dedent("""\
+            def touch(m):
+                m["used"].inc()
+                reg = None
+            """))
+        files = collect_files([str(tmp_path)], str(tmp_path))
+        active, _ = run_rules(Project(files), [BY_ID["HVD010"]])
+        assert [f.rule for f in active] == ["HVD010"]
+        assert "hvd_mini_dead_total" in active[0].message
+        assert active[0].path.endswith("obs/catalog.py")
+
+    def test_dead_event_promise(self, tmp_path):
+        obs = tmp_path / "obs"
+        obs.mkdir()
+        (obs / "events.py").write_text(textwrap.dedent("""\
+            EVENT_CATALOG = {
+                "mini.emitted": "happens",
+                "mini.never": "a dead promise",
+            }
+            """))
+        (tmp_path / "consumer.py").write_text(textwrap.dedent("""\
+            from horovod_tpu.obs import events
+
+
+            def fire():
+                events.emit("mini.emitted", ok=1)
+            """))
+        files = collect_files([str(tmp_path)], str(tmp_path))
+        active, _ = run_rules(Project(files), [BY_ID["HVD011"]])
+        assert [f.rule for f in active] == ["HVD011"]
+        assert "mini.never" in active[0].message
+        assert active[0].path.endswith("obs/events.py")
+
+
+class TestChangedOnly:
+    """--changed-only reporting scope: changed files plus their
+    one-level importers; full-parse semantics stay (the CLI flag only
+    filters findings)."""
+
+    def _project(self):
+        files = collect_files(
+            [os.path.join(REPO, "horovod_tpu")], REPO)
+        return Project(files)
+
+    def test_scope_is_changed_plus_importers(self, monkeypatch):
+        from horovod_tpu.analysis import cli
+        monkeypatch.setattr(
+            cli, "_git_changed_files",
+            lambda root: {"horovod_tpu/serving/metrics.py"})
+        scope = cli.changed_scope(self._project(), REPO)
+        assert "horovod_tpu/serving/metrics.py" in scope
+        # engine.py does `from horovod_tpu.serving.metrics import
+        # EngineMetrics` — its contracts ride on the changed module.
+        assert "horovod_tpu/serving/engine.py" in scope
+        # Unrelated modules stay out of scope.
+        assert "horovod_tpu/obs/catalog.py" not in scope
+
+    def test_requires_git(self, monkeypatch):
+        from horovod_tpu.analysis import cli
+        monkeypatch.setattr(cli, "_git_changed_files",
+                            lambda root: None)
+        with pytest.raises(SystemExit, match="git"):
+            cli.changed_scope(self._project(), REPO)
+
+    def test_cli_flag_filters_findings(self, tmp_path, monkeypatch):
+        """End to end: a tree with one dirty file reports only that
+        file's findings under --changed-only."""
+        from horovod_tpu.analysis import cli
+        (tmp_path / "clean.py").write_text("x = 1\n")
+        (tmp_path / "dirty.py").write_text(textwrap.dedent("""\
+            def f():
+                try:
+                    return 1
+                except Exception:
+                    pass
+            """))
+        monkeypatch.setattr(cli, "_git_changed_files",
+                            lambda root: {"dirty.py"})
+        (active, muted), _ = cli.analyze(
+            [str(tmp_path)], [BY_ID["HVD006"]], root=str(tmp_path),
+            changed_only=True)
+        assert {f.path for f in active} == {"dirty.py"}

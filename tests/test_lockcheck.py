@@ -1,0 +1,212 @@
+"""Runtime lock witness (`horovod_tpu.analysis.lockcheck`) — the
+dynamic half of HVD007.
+
+Covers the recorder unit behavior (edges, one-shot inversion pairs,
+reentrancy), the proxy facade, env-gated `register` arming, the
+deliberately-inverted fixture tripping the witness end to end, and the
+consistency contract between the two halves: every lock-order edge a
+real armed run OBSERVES must be present in the static
+`lock_order_graph` — a runtime edge the static analysis missed is a
+resolver gap.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+from horovod_tpu.analysis import lockcheck
+from horovod_tpu.analysis.core import Project, collect_files
+from horovod_tpu.analysis.rules.lock_order import lock_order_graph
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "horovod_tpu")
+INVERSION_FIXTURE = os.path.join(
+    os.path.dirname(__file__), "analysis_fixtures",
+    "runtime_inversion.py")
+
+
+def _run(script_path, tmp_path, armed):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    env.pop("HVD_LOCK_CHECK", None)
+    env.pop("HVD_LOCK_CHECK_OUT", None)
+    out = tmp_path / "order.json"
+    if armed:
+        env["HVD_LOCK_CHECK"] = "1"
+        env["HVD_LOCK_CHECK_OUT"] = str(out)
+    proc = subprocess.run([sys.executable, str(script_path)],
+                          capture_output=True, text=True, cwd=REPO,
+                          env=env, timeout=120)
+    return proc, out
+
+
+class TestLockWitnessUnit:
+    def test_edges_and_one_shot_inversion(self):
+        w = lockcheck.LockWitness()
+        w.acquired("A")
+        w.acquired("B")
+        w.released("B")
+        w.released("A")
+        w.acquired("B")
+        w.acquired("A")
+        assert ("A", "B") in w.edges and ("B", "A") in w.edges
+        assert len(w.inversions) == 1
+        inv = w.inversions[0]
+        assert inv["pair"] == ["A", "B"]
+        assert inv["first"]["order"] == ["A", "B"]
+        assert inv["second"]["order"] == ["B", "A"]
+        w.released("A")
+        w.released("B")
+        # The same hazardous pair is recorded ONCE however often the
+        # run re-walks it — CI output stays readable.
+        w.acquired("B")
+        w.acquired("A")
+        assert len(w.inversions) == 1
+
+    def test_clean_run_graph(self):
+        w = lockcheck.LockWitness()
+        for _ in range(3):
+            w.acquired("A")
+            w.acquired("B")
+            w.released("B")
+            w.released("A")
+        assert w.graph() == {"A": ["B"]}
+        assert w.inversions == []
+
+    def test_reentrant_reacquire_adds_no_edge(self):
+        w = lockcheck.LockWitness()
+        w.acquired("R")
+        w.acquired("R")
+        w.released("R")
+        w.released("R")
+        assert w.graph() == {}
+
+    def test_edges_fan_out_from_all_held(self):
+        w = lockcheck.LockWitness()
+        w.acquired("A")
+        w.acquired("B")
+        w.acquired("C")
+        assert set(w.edges) == {("A", "B"), ("A", "C"), ("B", "C")}
+
+    def test_snapshot_shape(self):
+        w = lockcheck.LockWitness()
+        w.acquired("A")
+        w.acquired("B")
+        snap = w.snapshot()
+        assert snap["edges"] == {"A": ["B"]}
+        assert list(snap["witnesses"]) == ["A -> B"]
+        assert snap["inversions"] == []
+
+
+class TestLockProxy:
+    def test_records_and_passes_through(self):
+        w = lockcheck.LockWitness()
+        outer = w.wrap("Outer._lock", threading.Lock())
+        inner = w.wrap("Inner._lock", threading.Lock())
+        with outer:
+            assert outer.locked()
+            with inner:
+                pass
+        assert not outer.locked()
+        assert w.graph() == {"Outer._lock": ["Inner._lock"]}
+        assert outer.acquire(blocking=False)
+        outer.release()
+        assert "Outer._lock" in repr(outer)
+
+    def test_cross_thread_inversion_trips(self):
+        w = lockcheck.LockWitness()
+        a = w.wrap("A", threading.Lock())
+        b = w.wrap("B", threading.Lock())
+
+        def forward():
+            with a:
+                with b:
+                    pass
+
+        def backward():
+            with b:
+                with a:
+                    pass
+
+        # Sequential threads: never deadlocks, still witnesses the
+        # hazard — exactly the schedule-didn't-bite-this-time case.
+        for fn in (forward, backward):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        assert len(w.inversions) == 1
+        assert w.inversions[0]["pair"] == ["A", "B"]
+
+
+class TestRegister:
+    def test_unarmed_hands_back_raw_lock(self, monkeypatch):
+        monkeypatch.delenv("HVD_LOCK_CHECK", raising=False)
+        raw = threading.Lock()
+        assert lockcheck.register("X._lock", raw) is raw
+
+    def test_armed_wraps_in_proxy(self, monkeypatch):
+        monkeypatch.setenv("HVD_LOCK_CHECK", "1")
+        raw = threading.Lock()
+        got = lockcheck.register("X._lock", raw)
+        assert isinstance(got, lockcheck._LockProxy)
+        assert got._lock is raw
+
+
+class TestInversionFixture:
+    def test_armed_run_trips_witness_and_dumps(self, tmp_path):
+        proc, out = _run(INVERSION_FIXTURE, tmp_path, armed=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "ORDER INVERSION" in proc.stderr
+        snap = json.loads(out.read_text())
+        assert len(snap["inversions"]) == 1
+        assert snap["inversions"][0]["pair"] == [
+            "invfix.LOCK_A", "invfix.LOCK_B"]
+        # Both orders observed, each with a thread @ file:line witness.
+        assert set(snap["edges"]) == {"invfix.LOCK_A",
+                                      "invfix.LOCK_B"}
+        for w in snap["witnesses"].values():
+            assert "runtime_inversion.py:" in w
+
+    def test_unarmed_run_is_silent(self, tmp_path):
+        proc, out = _run(INVERSION_FIXTURE, tmp_path, armed=False)
+        assert proc.returncode == 0, proc.stderr
+        assert "ORDER INVERSION" not in proc.stderr
+        assert not out.exists()
+
+
+class TestRuntimeSubsetOfStatic:
+    def test_observed_edges_are_in_static_graph(self, tmp_path):
+        """Drive real product paths armed and diff: runtime ⊆ static,
+        key for key (the shared ClassName.attr / modstem.NAME node
+        convention is what makes the graphs comparable)."""
+        driver = tmp_path / "driver.py"
+        driver.write_text(textwrap.dedent("""\
+            from horovod_tpu.obs import aggregate, events
+
+            # default_aggregator() registers the local registry while
+            # holding the module install lock: the nested acquisition
+            # aggregate._FLEET_LOCK -> FleetAggregator._lock.
+            agg = aggregate.default_aggregator()
+            agg.collect()
+            events.emit("serving.restart", engine=0,
+                        reason="lockcheck-driver")
+            """))
+        proc, out = _run(driver, tmp_path, armed=True)
+        assert proc.returncode == 0, proc.stderr
+        snap = json.loads(out.read_text())
+        assert snap["inversions"] == []
+        observed = [(a, b) for a, succs in snap["edges"].items()
+                    for b in succs]
+        assert observed, "driver exercised no nested acquisition"
+        assert ("aggregate._FLEET_LOCK",
+                "FleetAggregator._lock") in observed
+        static = lock_order_graph(
+            Project(collect_files([PKG], REPO)))
+        for a, b in observed:
+            assert b in static.get(a, []), (
+                f"runtime edge {a} -> {b} missing from the static "
+                f"lock_order_graph — HVD007 resolver gap")
