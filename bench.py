@@ -1144,6 +1144,105 @@ def _prefix_ttft_check(model, params, args, paged_cfg, log,
             "tokens_skipped_per_hit": skipped // max(1, rounds)}
 
 
+def _serve_replay(model, params, args, path, log):
+    """--serving --replay: re-serve a recorded request log open-loop.
+
+    Arrivals fire at the RECORDED offsets divided by --replay-speed;
+    prompts are synthesized from the log's prefix-chain digests
+    (obs/reqlog.py), so the prefix-cache hit pattern the record run
+    saw is the hit pattern this run exercises; per-request token
+    budgets, tenant lanes and priorities are the recorded ones. The
+    round-trip acceptance bits land in the record: request count ==
+    the log's arrival count, per-request produced tokens == the
+    recorded budgets (no-EOS serving: budget IS the output length),
+    and the re-chained synthesized prompts reproduce the recorded
+    prefix-group structure exactly."""
+    import numpy as np
+
+    from horovod_tpu.obs import reqlog as _reqlog
+    from horovod_tpu.serving import ServingEngine
+
+    header, records = _reqlog.load(path)
+    if not records:
+        raise ValueError(f"--replay {path!r} has no arrivals")
+    speed = max(1e-6, args.replay_speed)
+    block = int(header.get("block", _reqlog.DEFAULT_BLOCK))
+    prompts = [_reqlog.synthesize_prompt(r, model.vocab_size, block)
+               for r in records]
+    # The engine enforces P + max_new - 1 <= max_len: a log recorded
+    # on a longer-context engine still replays, with oversized
+    # prompts tail-clamped and the clamp COUNTED in the artifact
+    # (silent truncation would fake the round-trip bits below).
+    clamped = 0
+    for i, (r, p) in enumerate(zip(records, prompts)):
+        limit = args.seq - int(r["max_new"]) + 1
+        if len(p) > limit:
+            prompts[i] = p[:max(1, limit)]
+            clamped += 1
+    if clamped:
+        log(f"replay: {clamped}/{len(records)} prompts clamped to "
+            f"--seq {args.seq} minus the recorded budget")
+    # Replay legs are synthetic re-serves, not client arrivals: mute
+    # any configured request log for the duration so replaying a log
+    # never appends to (or re-records) one.
+    prev_log = _reqlog.install(None)
+    eng = ServingEngine(model, params, num_slots=args.serving_slots,
+                        max_queue=2 * len(records) + 2, warmup=True,
+                        pipeline_depth=args.serving_pipeline_depth,
+                        prefill_chunk_budget=args.prefill_chunk_budget)
+    try:
+        t0 = time.time()
+        handles = []
+        for r, p in zip(records, prompts):
+            delay = t0 + float(r["t"]) / speed - time.time()
+            if delay > 0:
+                time.sleep(delay)
+            handles.append(eng.submit(
+                p, int(r["max_new"]), tenant=r.get("tenant", ""),
+                priority=int(r.get("priority", 0))))
+        results = [h.result() for h in handles]
+        dt = time.time() - t0
+        eng.shutdown()
+    finally:
+        _reqlog.install(prev_log)
+    snap = eng.metrics_snapshot()
+    tokens = [len(res.tokens) for res in results]
+    resynth = [{"prefix": _reqlog.prefix_chain(p, block)}
+               for p in prompts]
+    rec = {
+        "source": path,
+        "speed": speed,
+        "recorded_requests": len(records),
+        "requests": len(results),
+        "tokens_total": sum(tokens),
+        "tokens_per_request": tokens,
+        "prompts_clamped": clamped,
+        # The round-trip bits (tests/test_spans.py pins the library
+        # halves; these pin the bench path end to end).
+        "token_counts_match": tokens == [int(r["max_new"])
+                                         for r in records],
+        "prefix_pattern_preserved": (
+            _reqlog.prefix_pattern(resynth)
+            == _reqlog.prefix_pattern(records)),
+        "tok_s": round(sum(tokens) / dt, 2),
+        "ttft_ms_p50": snap["ttft_ms"]["p50"],
+        "ttft_ms_p95": snap["ttft_ms"]["p95"],
+        "tpot_ms_p50": snap["tpot_ms"]["p50"],
+        "tpot_ms_p95": snap["tpot_ms"]["p95"],
+        "queue_wait_ms_p95": snap["queue_wait_ms"]["p95"],
+        "completed": snap["completed"],
+        "compiles": snap["compiles"],
+        "num_slots": args.serving_slots,
+    }
+    log(f"serving replay of {path} at x{speed}: "
+        f"{rec['requests']}/{rec['recorded_requests']} requests, "
+        f"{rec['tokens_total']} tokens "
+        f"(counts match: {rec['token_counts_match']}, prefix groups "
+        f"preserved: {rec['prefix_pattern_preserved']}), "
+        f"{rec['tok_s']} tok/s")
+    return rec
+
+
 def run_serving(args, devices, n_chips, log):
     """Serving-engine throughput/latency under open-loop load: Poisson
     arrivals against `horovod_tpu.serving.ServingEngine` at each
@@ -1253,6 +1352,29 @@ def run_serving(args, devices, n_chips, log):
     depth = args.serving_pipeline_depth
     budget = args.prefill_chunk_budget
     slo_spec = getattr(args, "serving_slo", "") or None
+    reqlog_path = getattr(args, "record_reqlog", None)
+    replay_path = getattr(args, "replay", None)
+    if replay_path == "self" and not reqlog_path:
+        raise ValueError("--replay self needs --record-reqlog PATH "
+                         "(the log the sweep records is what gets "
+                         "replayed)")
+    if reqlog_path:
+        from horovod_tpu.obs import reqlog as _reqlog
+        _reqlog.configure(reqlog_path)
+        log(f"serving: recording client arrivals to {reqlog_path}")
+    if replay_path and replay_path != "self":
+        # Replay-only mode: the recorded workload replaces the
+        # Poisson sweep; the artifact keeps the serving schema with
+        # the replay leg as its single rate point.
+        rep = _serve_replay(model, params, args, replay_path, log)
+        return {"tok_s_chip": rep["tok_s"], "n_params": n_params,
+                "num_slots": rep["num_slots"], "max_new_tokens": steps,
+                "requests_per_rate": rep["requests"],
+                "chaos": False, "pipeline_depth": depth,
+                "prefill_chunk_budget": budget,
+                "rates": {"replay": rep}, "replay": rep,
+                "trace_check": _serving_trace_check(
+                    model, params, args, prompts, log)}
     per_rate = {}
     best_tok_s = 0.0
     for rate in rates:
@@ -1485,6 +1607,20 @@ def run_serving(args, devices, n_chips, log):
                 f"{args.seq} for the overload A/B's paged pools")
         out["overload_ab"] = _overload_ab(model, params, args,
                                           prompts, max(rates), log)
+    if reqlog_path:
+        from horovod_tpu.obs import reqlog as _reqlog
+        rl = _reqlog.get()
+        n_rec = rl.count if rl is not None else 0
+        _reqlog.configure(None)   # flushes by closing below
+        if rl is not None:
+            rl.close()
+        out["reqlog"] = {"path": reqlog_path, "requests": n_rec}
+        log(f"serving: request log closed with {n_rec} arrival(s)")
+        if replay_path == "self":
+            # The in-artifact record -> replay round-trip: re-serve
+            # the log this very run recorded.
+            out["replay"] = _serve_replay(model, params, args,
+                                          reqlog_path, log)
     return out
 
 
@@ -1954,6 +2090,29 @@ def main():
     ap.add_argument("--arrival-rates", default="2,6,12",
                     metavar="R0,R1,...",
                     help="serving: open-loop arrival rates (req/s)")
+    ap.add_argument("--record-reqlog", default=None, metavar="PATH",
+                    help="serving: record every client arrival to a "
+                         "request log at PATH (obs/reqlog.py JSONL; "
+                         "programmatic twin of HVD_REQLOG) for later "
+                         "--replay")
+    ap.add_argument("--replay", default=None, metavar="LOG",
+                    help="serving: re-serve a recorded request log "
+                         "open-loop at the RECORDED arrival offsets "
+                         "instead of the Poisson sweep — prompts are "
+                         "synthesized from the log's prefix-chain "
+                         "digests, so the recorded prefix-sharing "
+                         "structure (and cache hit pattern) is "
+                         "reproduced; token budgets, tenants and "
+                         "priorities are the recorded ones. The "
+                         "special value 'self' runs the normal sweep "
+                         "with --record-reqlog, then replays the log "
+                         "it just recorded (the in-artifact "
+                         "round-trip)")
+    ap.add_argument("--replay-speed", type=float, default=1.0,
+                    metavar="X",
+                    help="serving: replay time compression — "
+                         "recorded arrival offsets are divided by "
+                         "this (2.0 = twice as fast)")
     ap.add_argument("--chaos", action="store_true",
                     help="serving: self-healing cost mode — inject "
                          "one dispatch-thread crash per rate point "
@@ -2511,6 +2670,15 @@ def _bench_body(args, devices, n_chips, metric, unit,
             # equal undersized pool — paid-tenant TTFT, preemption
             # counts, the starvation-free and bitwise bits.
             result["overload_ab"] = r["overload_ab"]
+        if "reqlog" in r:
+            # Where --record-reqlog wrote the request log, and how
+            # many client arrivals it captured.
+            result["reqlog"] = r["reqlog"]
+        if "replay" in r:
+            # The record/replay leg (docs/observability.md
+            # "Record/replay"): round-trip bits + perf of re-serving
+            # the recorded workload shape.
+            result["replay"] = r["replay"]
         _set_best(result)
         emit(_BEST_RESULT)
         write_out(args)

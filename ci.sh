@@ -122,6 +122,21 @@ JAX_PLATFORMS=cpu python -m horovod_tpu.obs.flightrec \
     "$(ls /tmp/hvd_fleet_smoke/flight_*.json | tail -1)" \
     | grep -q "trace_id="
 
+# Request-tracing smoke (PR 20, docs/observability.md "Request
+# tracing" / "Record/replay"): under a scoped SpanRecorder one
+# request's causal span tree must decompose into the FULL serving
+# anatomy — the printed waterfall shows the queue_wait/admission/
+# prefill/decode phase tags and the phase anatomy sums to within 5%
+# of the client-observed latency (no unattributed wall-clock).
+# Then 8 client arrivals are recorded to an obs.reqlog JSONL,
+# prompt-synthesized back from their digests, and re-served on a
+# fresh engine: request count and every per-request token count must
+# round-trip exactly — the record->replay guarantee bench.py's
+# --record-reqlog/--replay flags build on. Knobs: HVD_TRACE_LOG,
+# HVD_TRACE_SAMPLE, HVD_REQLOG (runtime/config.py registry).
+JAX_PLATFORMS=cpu python examples/transformer_serving.py --requests 2 \
+    --trace-check
+
 # Serving-fleet failover smoke (docs/serving.md "Fleet failover"):
 # three in-process ServingEngine replicas behind a ServingRouter; the
 # router.replica_kill chaos site hard-kills the busiest replica while

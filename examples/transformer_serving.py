@@ -28,6 +28,13 @@ snapshot. Two extra CI legs exercise the PR-3 hot-path guarantees:
   engine serves requests, and one HTTP scrape of ``/metrics`` must
   expose the serving/resilience/training families while ``/healthz``
   shows the engine's dispatch generation.
+* ``--trace-check`` is the request-tracing smoke
+  (docs/observability.md "Request tracing" / "Record/replay"): one
+  request's span waterfall must show every serving phase
+  (queue_wait/admission/prefill/decode) with the phase anatomy
+  summing to within 5% of the client-observed latency, and an
+  8-request record->replay through ``obs.reqlog`` must round-trip
+  with identical per-request token counts.
 * ``--prefix-check`` is the paged-KV smoke (docs/serving.md "Paged KV
   cache"): two requests sharing a long system prompt go through a
   PAGED engine; the second must report prefill-tokens-skipped > 0
@@ -206,6 +213,91 @@ def obs_check(model, params, n_requests=3):
               f"generation visible at /healthz")
     finally:
         obs.stop_exporter()
+
+
+def trace_check(model, params, n_requests=8):
+    """The request-tracing + record/replay smoke (docs/observability.md
+    "Request tracing" / "Record/replay"). Two halves:
+
+    (a) causal spans — under a scoped SpanRecorder one request's span
+    tree must decompose into the full serving anatomy: the printed
+    waterfall shows the queue_wait/admission/prefill/decode phase
+    tags and the phase anatomy sums to within 5% of the
+    client-observed latency (the acceptance bound — every wall-clock
+    second a client waits is attributed to a named phase);
+
+    (b) record -> replay — ``n_requests`` client arrivals recorded to
+    a request log, then loaded, prompt-synthesized from the digests
+    and re-served on a FRESH engine: the request count and every
+    per-request token count must round-trip exactly.
+    """
+    import tempfile
+    import time
+
+    from horovod_tpu.obs import reqlog, spans
+
+    # --- (a) one request's span waterfall + phase anatomy ---------
+    srec = spans.SpanRecorder()
+    prev = spans.install(srec)
+    try:
+        with ServingEngine(model, params, num_slots=2,
+                           warmup=True) as eng:
+            t0 = time.time()
+            h = eng.submit(np.array([3, 5, 7, 11]), 16)
+            h.result(timeout=600)
+            e2e = time.time() - t0
+            tid = h.trace_id
+    finally:
+        spans.install(prev)
+    tree = srec.trace(tid)
+    assert tree, "no spans recorded for the request's trace"
+    text = spans.waterfall(tree)
+    print(text, end="")
+    for ph in ("queue_wait", "admission", "prefill", "decode"):
+        assert f"[{ph}]" in text, f"waterfall missing phase [{ph}]"
+    anat = spans.phase_anatomy(tree)
+    total = sum(anat.values())
+    assert abs(total - e2e) / e2e < 0.05, (
+        f"phase anatomy sums to {total:.4f}s but the client waited "
+        f"{e2e:.4f}s (> 5% unattributed)", anat)
+
+    # --- (b) record the arrivals, replay them token-exactly -------
+    path = os.path.join(tempfile.mkdtemp(prefix="hvd_trace_check_"),
+                        "requests.jsonl")
+    rs = np.random.RandomState(11)
+    prompts = [rs.randint(0, 128, (int(rs.randint(2, 12)),))
+               for _ in range(n_requests)]
+    rlog = reqlog.RequestLog(path)
+    prev_log = reqlog.install(rlog)
+    try:
+        with ServingEngine(model, params, num_slots=2,
+                           max_queue=2 * n_requests,
+                           warmup=True) as eng:
+            hs = [eng.submit(p, 4 + i % 3)
+                  for i, p in enumerate(prompts)]
+            rec_tokens = [len(h.result(timeout=600).tokens)
+                          for h in hs]
+        rlog.close()
+    finally:
+        reqlog.install(prev_log)
+    header, records = reqlog.load(path)
+    assert len(records) == n_requests, (
+        f"recorded {len(records)} arrivals, served {n_requests}")
+    block = int(header.get("block", reqlog.DEFAULT_BLOCK))
+    with ServingEngine(model, params, num_slots=2,
+                       max_queue=2 * n_requests, warmup=True) as eng:
+        hs = [eng.submit(
+                  reqlog.synthesize_prompt(r, model.vocab_size, block),
+                  int(r["max_new"]))
+              for r in records]
+        rep_tokens = [len(h.result(timeout=600).tokens) for h in hs]
+    assert rep_tokens == rec_tokens, (
+        "replay token counts diverged from the recorded run",
+        rec_tokens, rep_tokens)
+    print(f"trace check OK: waterfall shows all 4 serving phases, "
+          f"anatomy {total:.3f}s vs client {e2e:.3f}s (within 5%), "
+          f"record->replay round-tripped {n_requests} requests "
+          f"token-exact")
 
 
 def prefix_check(model, params, repeats=3):
@@ -731,6 +823,15 @@ def main():
                          "port and assert serving/resilience/training "
                          "families are scrapeable (docs/"
                          "observability.md)")
+    ap.add_argument("--trace-check", action="store_true",
+                    help="request-tracing smoke: one request's span "
+                         "waterfall must show the queue_wait/"
+                         "admission/prefill/decode phases with the "
+                         "anatomy summing to within 5% of client "
+                         "latency, and an 8-request record->replay "
+                         "must round-trip token-exact (docs/"
+                         "observability.md 'Request tracing' / "
+                         "'Record/replay')")
     ap.add_argument("--prefix-check", action="store_true",
                     help="paged-KV smoke: a second request sharing a "
                          "system prompt must skip its prefix's "
@@ -832,6 +933,8 @@ def main():
         interleave_check(model, params, args.prefill_chunk_budget)
     if args.obs_check:
         obs_check(model, params)
+    if args.trace_check:
+        trace_check(model, params)
     if args.prefix_check:
         prefix_check(model, params)
     if args.preempt_check:

@@ -9,6 +9,7 @@
         [--write-env-table [docs/troubleshooting.md]]
         [--write-chaos-table [docs/resilience.md]]
         [--write-event-table [docs/observability.md]]
+        [--write-span-table [docs/observability.md]]
 
 Exit codes: 0 clean (all findings baselined), 1 findings, 2 usage or
 analysis error. Default target: the installed ``horovod_tpu`` package
@@ -44,6 +45,8 @@ _CHAOS_TABLE_BEGIN = "<!-- hvdlint:chaos-table:begin -->"
 _CHAOS_TABLE_END = "<!-- hvdlint:chaos-table:end -->"
 _EVENT_TABLE_BEGIN = "<!-- hvdlint:event-table:begin -->"
 _EVENT_TABLE_END = "<!-- hvdlint:event-table:end -->"
+_SPAN_TABLE_BEGIN = "<!-- hvdlint:span-table:begin -->"
+_SPAN_TABLE_END = "<!-- hvdlint:span-table:end -->"
 
 
 def _package_root() -> str:
@@ -187,6 +190,17 @@ def write_event_table(doc_path: str) -> bool:
                                _EVENT_TABLE_END, event_table_md())
 
 
+def write_span_table(doc_path: str) -> bool:
+    """Regenerate the request-tracing span table between the hvdlint
+    markers in ``doc_path`` from `obs.spans.SPAN_CATALOG` — the same
+    catalog HVD012 pins against the record sites, so the doc can
+    neither name a span nothing records nor miss one that ships.
+    Returns True when the file changed."""
+    from horovod_tpu.obs.spans import span_table_md
+    return _write_marked_table(doc_path, _SPAN_TABLE_BEGIN,
+                               _SPAN_TABLE_END, span_table_md())
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m horovod_tpu.analysis",
@@ -229,6 +243,12 @@ def main(argv=None) -> int:
                     help="regenerate the structured-event table in "
                          "DOC from obs.events.EVENT_CATALOG, then "
                          "exit")
+    ap.add_argument("--write-span-table", nargs="?", metavar="DOC",
+                    const=os.path.join(_repo_root(), "docs",
+                                       "observability.md"),
+                    help="regenerate the request-tracing span table "
+                         "in DOC from obs.spans.SPAN_CATALOG, then "
+                         "exit")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -256,6 +276,13 @@ def main(argv=None) -> int:
         print(f"hvdlint: event table "
               f"{'updated' if changed else 'already current'} in "
               f"{args.write_event_table}")
+        return 0
+
+    if args.write_span_table:
+        changed = write_span_table(args.write_span_table)
+        print(f"hvdlint: span table "
+              f"{'updated' if changed else 'already current'} in "
+              f"{args.write_span_table}")
         return 0
 
     rules = ALL_RULES

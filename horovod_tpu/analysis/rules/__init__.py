@@ -18,10 +18,11 @@ from horovod_tpu.analysis.rules import (
     blocking_lock,
     metric_catalog,
     event_docs,
+    span_catalog,
 )
 
 ALL_RULES = [host_sync, trace_safety, recompile, locks, env_registry,
              broad_except, lock_order, cross_thread, blocking_lock,
-             metric_catalog, event_docs]
+             metric_catalog, event_docs, span_catalog]
 
 BY_ID = {mod.RULE.id: mod for mod in ALL_RULES}

@@ -539,6 +539,26 @@ def tenant_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
     }
 
 
+def phase_metrics(reg: Optional[MetricRegistry] = None) -> Dict:
+    """The critical-path anatomy plane (obs/spans.py): per-request
+    phase durations from the span-tree decomposition — queue_wait,
+    admission, prefill, transfer_export/verify/ingest, decode,
+    preempt_paused, migration_gap. Fleet-mergeable like every fixed-
+    bucket histogram; exemplars carry the trace_id whose waterfall
+    explains the observation."""
+    reg = reg or registry()
+    return {
+        "phase": reg.histogram(
+            "hvd_request_phase_seconds",
+            "Per-request critical-path phase durations decomposed "
+            "from the causal span tree (phase = queue_wait, "
+            "admission, prefill, transfer_export, transfer_verify, "
+            "transfer_ingest, decode, preempt_paused, "
+            "migration_gap); the phases of one completed request sum "
+            "to its client-observed latency", ("phase",)),
+    }
+
+
 def fleet_metrics(reg: MetricRegistry) -> Dict:
     """The fleet aggregator's own accounting (obs/aggregate.py).
     Constructed on the aggregator's per-collect registry — `reg` is
@@ -596,4 +616,5 @@ def declare_standard_metrics(
         "slo": slo_metrics(reg),
         "flightrec": flight_metrics(reg),
         "events": event_metrics(reg),
+        "phases": phase_metrics(reg),
     }

@@ -228,6 +228,22 @@ class MetricsServer:
                                    json.dumps(snap.to_json(),
                                               default=repr).encode(),
                                    "application/json")
+                elif path.startswith("/trace/"):
+                    from horovod_tpu.obs import spans as _spans
+                    tid = path[len("/trace/"):]
+                    tree = _spans.trace(tid)
+                    if tree is None:
+                        # Unknown OR evicted from the bounded ring —
+                        # the recorder cannot tell the two apart.
+                        self._send(404, json.dumps(
+                            {"error": "unknown or evicted trace",
+                             "trace_id": tid}).encode(),
+                            "application/json")
+                    else:
+                        self._send(200, json.dumps(
+                            {"trace_id": tid, "spans": tree},
+                            default=repr).encode(),
+                            "application/json")
                 elif path in ("/healthz", "/health"):
                     health = server_ref.registry.health()
                     body = json.dumps(health, default=repr).encode()

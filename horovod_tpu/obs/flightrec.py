@@ -101,6 +101,19 @@ def _inflight_states() -> Dict[str, object]:
     return out
 
 
+def _spans_section() -> Dict:
+    """The causal span ring + the slowest completed request's
+    waterfall (obs/spans.py `flight_section`) — the SLO-breach
+    bundle's 'what was the time spent on' page. Errors degrade to a
+    marker, never cost the bundle."""
+    try:
+        from horovod_tpu.obs import spans as _spans
+        return _spans.flight_section()
+    # hvd: disable=HVD006(a broken span recorder must cost the spans section, never the bundle the restart depends on)
+    except Exception as e:  # noqa: BLE001
+        return {"error": repr(e)}
+
+
 def _config_snapshot() -> Dict:
     import dataclasses
 
@@ -143,6 +156,7 @@ def dump(reason: str, /, *, dirpath: Optional[str] = None,
         "metrics": _registry().to_json(),
         "inflight": _inflight_states(),
         "config": _config_snapshot(),
+        "spans": _spans_section(),
     }
     slug = "".join(c if c.isalnum() else "-" for c in reason)[:48]
     name = (f"flight_{time.strftime('%Y%m%dT%H%M%S', time.gmtime(now))}"
@@ -284,6 +298,22 @@ def describe(bundle: Dict, *, events_shown: int = 30) -> str:
             f"  [{_fmt_ts(rec.get('ts'))}] #{rec.get('seq')} "
             f"{rec.get('kind')} "
             + json.dumps(extras, default=repr))
+    spans_sec = bundle.get("spans") or {}
+    ring = spans_sec.get("ring") or []
+    if ring or spans_sec.get("slowest_trace_id"):
+        lines.append("")
+        lines.append(f"causal spans ({len(ring)} newest in bundle):")
+        slow = spans_sec.get("slowest_trace_id")
+        if slow:
+            anat = spans_sec.get("slowest_anatomy") or {}
+            phases = " ".join(
+                f"{k}={v * 1e3:.1f}ms" for k, v in anat.items()
+                if v)
+            lines.append(f"  slowest completed request: "
+                         f"trace_id={slow}  {phases}")
+            wf = spans_sec.get("slowest_waterfall")
+            if wf:
+                lines.extend("  " + ln for ln in wf.splitlines())
     lines.append("")
     lines.append("metric headlines:")
     lines.extend(_metric_headlines(bundle.get("metrics") or {})

@@ -1,39 +1,19 @@
-"""Request tracing identifiers.
+"""Request tracing identifiers — compat shim over `obs.spans`.
 
-One ``trace_id`` is minted per serving request at ``submit()`` and
-carried everywhere that request's life leaves a mark: the admission
-queue and slot scheduler (the `Request` dataclass), the Chrome-trace
-Timeline (span ``args``), the structured event log, watchdog-restart
-requeues (the SAME id survives replay — continuity across recovery is
-tested), and the latency histograms' exemplars. Follow one id and you
-can reconstruct a request's path across queue, interleaved prefill
-chunks, pipelined ticks and auto-restart requeues.
-
-Span ids name one segment of a trace (a QUEUE/PREFILL/DECODE phase, a
-profile bracket); they are cheap and local, never coordinated.
+Trace identity moved into the causal span module (obs/spans.py) when
+flat trace_id stamping grew into span trees; this module keeps the
+PR 5 import surface alive so no call site breaks. One ``trace_id`` is
+still minted per serving request at ``submit()`` and carried
+everywhere that request's life leaves a mark — the span tree, the
+admission queue, the Timeline args, the event log, watchdog-restart
+requeues, and the histogram exemplars.
 """
 
 from __future__ import annotations
 
-import os
-import binascii
+from horovod_tpu.obs.spans import (   # noqa: F401 — re-exports
+    mint_trace_id, new_span_id, new_trace_id, span_args,
+)
 
-__all__ = ["new_trace_id", "new_span_id", "span_args"]
-
-
-def new_trace_id() -> str:
-    """16 hex chars of OS randomness (64 bits — W3C traceparent's
-    low half; enough that a pod's worth of requests cannot collide)."""
-    return binascii.hexlify(os.urandom(8)).decode()
-
-
-def new_span_id() -> str:
-    """8 hex chars; unique within one trace."""
-    return binascii.hexlify(os.urandom(4)).decode()
-
-
-def span_args(trace_id: str, **extra) -> dict:
-    """The Timeline span ``args`` payload for a traced request."""
-    out = {"trace_id": trace_id}
-    out.update(extra)
-    return out
+__all__ = ["mint_trace_id", "new_trace_id", "new_span_id",
+           "span_args"]

@@ -303,6 +303,23 @@ register_knob(
     "sheds, chaos fires, stalls, compiles) to this path "
     "(size-rotated), docs/observability.md")
 register_knob(
+    "HVD_TRACE_LOG", "str", "(unset)", "obs/spans.py",
+    "Mirror every completed causal request span to this JSONL path "
+    "(size-rotated); render waterfalls / Chrome traces with "
+    "python -m horovod_tpu.obs.spans, docs/observability.md "
+    "'Request tracing'")
+register_knob(
+    "HVD_TRACE_SAMPLE", "float", "1.0", "obs/spans.py",
+    "Head-sampling rate for causal span recording (0..1, "
+    "deterministic on the trace id so every replica keeps or drops "
+    "the SAME traces; 1.0 records everything)")
+register_knob(
+    "HVD_REQLOG", "str", "(unset)", "obs/reqlog.py",
+    "Record every client-entry submit (arrival time, prompt/output "
+    "budgets, tenant/priority, prefix-group chain digests) to this "
+    "JSONL request log; re-serve it with bench.py --serving "
+    "--replay, docs/observability.md 'Record/replay'")
+register_knob(
     "HVD_PROFILE_DIR", "str", "(unset)", "obs/profiling.py",
     "Opt-in jax.profiler trace session directory "
     "(obs.profiling.profiler_session); analyze captures with "

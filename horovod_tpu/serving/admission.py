@@ -35,7 +35,7 @@ import threading
 import time
 from concurrent.futures import CancelledError, InvalidStateError
 from dataclasses import dataclass, field
-from typing import Any, List, Optional
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -142,6 +142,17 @@ class Request:
     # callers see plain FIFO.
     priority: int = 0
     tenant: str = ""
+    # Causal span plumbing (obs/spans.py): ``parent_span`` is the
+    # caller's span this engine leg hangs under (a router attempt, a
+    # disagg root; "" = this engine minted the trace and owns the
+    # root). ``span_ids`` maps the leg's OPEN span slots ("root",
+    # "queued", "prefill", "decode", "paused") to span ids; a shared
+    # MUTABLE dict on purpose — dataclasses.replace (preemption
+    # resume, restart requeue) copies the reference, so the resumed
+    # leg closes the spans its predecessor opened.
+    parent_span: str = ""
+    span_ids: Dict = field(default_factory=dict, repr=False,
+                           compare=False)
     _cancel: threading.Event = field(default_factory=threading.Event)
     # Set by AdmissionQueue.offer/requeue: lets cancel() release the
     # queue slot IMMEDIATELY instead of at the next dispatcher sweep

@@ -58,6 +58,8 @@ import numpy as np
 
 from horovod_tpu.obs import catalog as _obs_catalog
 from horovod_tpu.obs import events as _events
+from horovod_tpu.obs import reqlog as _reqlog
+from horovod_tpu.obs import spans as _spans
 from horovod_tpu.obs import tracing as _tracing
 from horovod_tpu.resilience import detector as _detector
 from horovod_tpu.serving.admission import (
@@ -297,6 +299,16 @@ class DisaggRouter(ServingRouter):
             priority=priority, tenant=tenant)
         rr._disagg = True
         rr._transfer = None
+        rr._handoff_span = ""
+        # The disagg client entry mints its own causal root — the
+        # prefill leg, the handoff and every decode attempt hang
+        # under it. The reqlog arrival is recorded only once the
+        # prefill leg actually placed (the fallback paths delegate to
+        # the base submit, which records under ITS fresh trace).
+        rr.root_span = _spans.begin_span(
+            "router.request", trace_id=rr.trace_id,
+            max_new_tokens=max_new_tokens, disagg=True,
+            tenant=rr.tenant, priority=rr.priority)
         with self._lock:
             self._requests[rr.id] = rr
         t_eng = time.time()
@@ -304,7 +316,7 @@ class DisaggRouter(ServingRouter):
             handle = rep.engine.submit(
                 rr.prompt, 1, temperature=temperature, top_p=top_p,
                 seed=seed, timeout_s=timeout_s,
-                trace_id=rr.trace_id,
+                trace_id=rr.trace_id, parent_span=rr.root_span,
                 priority=priority, tenant=tenant)
         except (QueueFullError, EngineClosedError):
             # The prefill tier shed — degrade to the shared-program
@@ -312,6 +324,7 @@ class DisaggRouter(ServingRouter):
             # could still absorb.
             with self._lock:
                 self._requests.pop(rr.id, None)
+            _spans.end_span(rr.root_span, status="fallback")
             self._dm["fallbacks"].inc(reason="no_prefill_capacity")
             self._dcount("disagg_fallbacks")
             return super().submit(
@@ -321,9 +334,12 @@ class DisaggRouter(ServingRouter):
         except ValueError:
             with self._lock:
                 self._requests.pop(rr.id, None)
+            _spans.end_span(rr.root_span, status="invalid")
             raise
         with self._lock:
             rep.live += 1
+        _reqlog.record(prompt, max_new_tokens, tenant=rr.tenant,
+                       priority=rr.priority, trace_id=rr.trace_id)
         handle.future.add_done_callback(
             lambda fut, rr=rr, rep=rep, t0=t_eng:
             self._prefill_done(rr, rep, t0, fut))
@@ -377,11 +393,19 @@ class DisaggRouter(ServingRouter):
         if eos is not None and first == eos:
             self._finish_prefill_terminal(rr, res, now)
             return
+        # The handoff span brackets prefill-done to decode-ingest —
+        # export is its child here, verify/ingest its children on the
+        # decode replica (the BlockTransfer carries its id), so both
+        # halves of the handoff sit under ONE node of the trace tree.
+        rr._handoff_span = _spans.begin_span(
+            "disagg.handoff", trace_id=rr.trace_id,
+            parent_id=rr.root_span, prefill_replica=rep.id)
         transfer = None
         try:
             transfer = export_blocks(
                 rep.engine.pool, rr.prompt, (first,),
-                mode=self._transfer_mode, trace_id=rr.trace_id)
+                mode=self._transfer_mode, trace_id=rr.trace_id,
+                parent_span=rr._handoff_span)
         except TransferError as e:
             self._dm["transfers"].inc(outcome="export_failed")
             self._dm["fallbacks"].inc(reason="export_failed")
@@ -396,6 +420,12 @@ class DisaggRouter(ServingRouter):
                          trace_id=rr.trace_id, error=repr(e))
         if transfer is not None:
             self._dm["transfers"].inc(outcome="exported")
+        else:
+            # Nothing to ship (export failed / nothing resident):
+            # the ingest side never sees this handoff, so close its
+            # span here — decode recomputes from the forced token.
+            _spans.end_span(rr._handoff_span, status="no_transfer")
+            rr._handoff_span = ""
         rr._transfer = transfer
         self._handoff_place(rr, forced=(first,), t0=now)
 
@@ -444,6 +474,10 @@ class DisaggRouter(ServingRouter):
             self._requests.pop(rr.id, None)
         out = dataclasses.replace(res, ttft_s=ttft,
                                   e2e_s=now - rr.t_submit)
+        _spans.end_span(rr.root_span, status="completed",
+                        tokens=len(res.tokens))
+        if rr.root_span:
+            _spans.observe_request(rr.trace_id)
         self._count("requests", outcome="completed")
         self._m["ttft"].observe(ttft,
                                 exemplar={"trace_id": rr.trace_id})
@@ -455,6 +489,10 @@ class DisaggRouter(ServingRouter):
                 return
             rr.done = True
             self._requests.pop(rr.id, None)
+        _spans.end_span(getattr(rr, "_handoff_span", ""),
+                        status=outcome)
+        _spans.end_span(rr.gap_span, status=outcome)
+        _spans.end_span(rr.root_span, status=outcome)
         self._count("requests", outcome=outcome)
         self._resolve_future(rr.future, exc=exc)
 
@@ -480,6 +518,12 @@ class DisaggRouter(ServingRouter):
             rep.engine.offer_transfer(tr)
         except (ServingError, RuntimeError, AttributeError):
             pass   # the submit itself still recomputes correctly
+        # First offer delivered: the handoff span closes (SpanRecorder
+        # end is idempotent, so migration re-offers are no-ops). The
+        # transfer.verify/ingest spans the decode scheduler emits
+        # still parent onto it through the manifest's parent_span.
+        _spans.end_span(getattr(rr, "_handoff_span", ""),
+                        decode_replica=rep.id)
 
     # -- the monitor ---------------------------------------------------
 
@@ -600,6 +644,11 @@ class DisaggRouter(ServingRouter):
             del self._ttft_samples[:-512]
         out = dataclasses.replace(res, ttft_s=ttft,
                                   e2e_s=now - rr.t_submit)
+        _spans.end_span(rr.gap_span, status="completed")
+        _spans.end_span(rr.root_span, status="completed",
+                        tokens=len(res.tokens))
+        if rr.root_span:
+            _spans.observe_request(rr.trace_id)
         self._count("requests", outcome="completed")
         self._m["ttft"].observe(ttft,
                                 exemplar={"trace_id": rr.trace_id})
@@ -661,6 +710,9 @@ class DisaggRouter(ServingRouter):
             self._pending_handoffs = []
         for rr in stranded:
             if not rr.future.done():
+                _spans.end_span(getattr(rr, "_handoff_span", ""),
+                                status="failed")
+                _spans.end_span(rr.root_span, status="failed")
                 self._count("requests", outcome="failed")
                 self._resolve_future(rr.future, exc=EngineClosedError(
                     f"router shut down while request {rr.id} awaited "

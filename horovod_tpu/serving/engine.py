@@ -49,6 +49,8 @@ import numpy as np
 from horovod_tpu.obs import catalog as _obs_catalog
 from horovod_tpu.obs import events as _events
 from horovod_tpu.obs import flightrec as _flightrec
+from horovod_tpu.obs import reqlog as _reqlog
+from horovod_tpu.obs import spans as _spans
 from horovod_tpu.obs import tracing as _tracing
 from horovod_tpu.obs.registry import registry as _obs_registry
 from horovod_tpu.resilience import chaos
@@ -622,6 +624,7 @@ class ServingEngine:
                timeout_s: Optional[float] = None,
                forced_prefix=None,
                trace_id: Optional[str] = None,
+               parent_span: str = "",
                priority: int = 0,
                tenant: str = "") -> RequestHandle:
         """Enqueue one generation request; returns immediately.
@@ -648,7 +651,11 @@ class ServingEngine:
         stream is bitwise what an uninterrupted run would have
         produced. ``trace_id`` overrides the minted observability id
         so a migrated/hedged request keeps its original identity
-        across engines.
+        across engines; ``parent_span`` hangs this engine leg's spans
+        under the caller's span (a router attempt, a disagg handoff).
+        With both unset this is a CLIENT entry: the engine mints the
+        trace, opens the ``serving.request`` root span, and records
+        the arrival in the ``HVD_REQLOG`` request log.
         """
         prompt = np.asarray(prompt)
         if prompt.ndim != 1 or prompt.shape[0] == 0:
@@ -727,6 +734,7 @@ class ServingEngine:
         timeout_s = (self.default_timeout_s if timeout_s is None
                      else timeout_s)
         now = time.time()
+        minted = trace_id is None
         req = Request(
             id=next(self._ids), prompt=prompt,
             max_new_tokens=max_new_tokens, sampling=sampling,
@@ -734,7 +742,21 @@ class ServingEngine:
             future=Future(),
             trace_id=trace_id or _tracing.new_trace_id(),
             t_submit=now, forced=forced, tokens=list(forced),
+            parent_span=parent_span,
             priority=int(priority), tenant=str(tenant))
+        if minted:
+            # Client entry: this engine owns the trace ROOT (closed in
+            # the scheduler's finalize, where the anatomy is observed)
+            # and the arrival belongs in the HVD_REQLOG request log.
+            # Routed/internal legs (trace_id given) do neither — the
+            # router owns their root and already recorded them.
+            req.span_ids["root"] = _spans.begin_span(
+                "serving.request", trace_id=req.trace_id,
+                prompt_tokens=P, max_new_tokens=max_new_tokens,
+                tenant=req.tenant, priority=req.priority)
+            _reqlog.record(prompt, max_new_tokens, tenant=req.tenant,
+                           priority=req.priority,
+                           trace_id=req.trace_id)
         self.metrics.count("submitted")
         if req.tenant:
             if self.brownout is not None:
@@ -742,6 +764,10 @@ class ServingEngine:
             self._obs_tenant["requests"].inc(tenant=req.tenant,
                                              outcome="submitted")
         _span("begin_span", req.id, "QUEUE", trace_id=req.trace_id)
+        req.span_ids["queued"] = _spans.begin_span(
+            "serving.queued", trace_id=req.trace_id,
+            parent_id=req.parent_span or req.span_ids.get("root", ""),
+            tenant=req.tenant, priority=req.priority)
         try:
             self.queue.offer(req)
         except QueueFullError:
@@ -751,12 +777,20 @@ class ServingEngine:
                 self._obs_tenant["requests"].inc(tenant=req.tenant,
                                                  outcome="shed")
             _span("end_span", req.id, "QUEUE")
+            _spans.end_span(req.span_ids.pop("queued", ""),
+                            status="shed")
+            _spans.end_span(req.span_ids.pop("root", ""),
+                            status="shed")
             _events.emit("serving.shed", request_id=req.id,
                          trace_id=req.trace_id, tenant=req.tenant,
                          queue_depth=len(self.queue))
             raise
         except EngineClosedError:
             _span("end_span", req.id, "QUEUE")
+            _spans.end_span(req.span_ids.pop("queued", ""),
+                            status="closed")
+            _spans.end_span(req.span_ids.pop("root", ""),
+                            status="closed")
             raise
         self.metrics.observe_admission(True, tenant=req.tenant)
         _events.emit("serving.submit", request_id=req.id,
@@ -960,9 +994,29 @@ class ServingEngine:
                 # continuation re-seeds its tokens with the forced
                 # span — those were generated by an earlier engine
                 # and are part of the stream contract, not replayed.
-                requeued.append(dataclasses.replace(
+                resumed = dataclasses.replace(
                     req, tokens=list(req.forced), t_prefill=0.0,
-                    t_first=0.0, prefix_cached=0))
+                    t_first=0.0, prefix_cached=0)
+                # Span continuity across the restart: the abandoned
+                # generation's open leg spans close here (span_ids is
+                # the SHARED dict dataclasses.replace carried over),
+                # an instant serving.restart_requeue marker records
+                # the seam, and the replay re-enters the queue under
+                # a fresh serving.queued span — one tree, one trace.
+                parent = (resumed.parent_span
+                          or resumed.span_ids.get("root", ""))
+                for slot in ("queued", "prefill", "decode", "paused"):
+                    _spans.end_span(resumed.span_ids.pop(slot, ""),
+                                    status="restart_abandoned")
+                _spans.record_span(
+                    "serving.restart_requeue",
+                    trace_id=resumed.trace_id, parent_id=parent,
+                    generation=epoch, tokens=len(resumed.tokens))
+                resumed.span_ids["queued"] = _spans.begin_span(
+                    "serving.queued", trace_id=resumed.trace_id,
+                    parent_id=parent, requeued=True,
+                    tenant=resumed.tenant, priority=resumed.priority)
+                requeued.append(resumed)
         n = self.queue.requeue(requeued)
         self.metrics.count("restarts")
         if n:

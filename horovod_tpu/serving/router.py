@@ -83,6 +83,8 @@ from horovod_tpu.analysis import lockcheck
 from horovod_tpu.obs import catalog as _obs_catalog
 from horovod_tpu.obs import events as _events
 from horovod_tpu.obs import flightrec as _flightrec
+from horovod_tpu.obs import reqlog as _reqlog
+from horovod_tpu.obs import spans as _spans
 from horovod_tpu.obs import tracing as _tracing
 from horovod_tpu.resilience import chaos
 from horovod_tpu.resilience import detector as _detector
@@ -157,6 +159,7 @@ class _Attempt:
     forced: tuple                 # forced prefix this attempt carries
     t_submit: float               # engine-submit time (router clock)
     hedge: bool = False
+    span_id: str = ""             # causal router.attempt/hedge span
 
 
 class _RouterRequest:
@@ -191,6 +194,12 @@ class _RouterRequest:
         # prefix a migration resubmits, and the floor tokens_so_far()
         # reports while a migration is in flight.
         self.last_tokens: List[int] = []
+        # Causal spans (obs/spans.py): the request's root span — every
+        # attempt/hedge/migration span is its child, so one tree spans
+        # replicas — and the currently-open migration_gap span (""
+        # outside a death-to-replacement window).
+        self.root_span = ""
+        self.gap_span = ""
 
 
 class RouterHandle:
@@ -468,6 +477,16 @@ class ServingRouter:
             deadline=None if timeout_s is None else now + timeout_s,
             trace_id=_tracing.new_trace_id(), t_submit=now,
             priority=priority, tenant=tenant)
+        # The trace was minted HERE, so this is the client entry: mint
+        # the causal root span (attempts, hedges and migration gaps
+        # all hang under it) and record the arrival in the request log
+        # (engine submits carry our trace_id, so they do neither).
+        rr.root_span = _spans.begin_span(
+            "router.request", trace_id=rr.trace_id,
+            max_new_tokens=max_new_tokens,
+            tenant=rr.tenant, priority=rr.priority)
+        _reqlog.record(prompt, max_new_tokens, tenant=rr.tenant,
+                       priority=rr.priority, trace_id=rr.trace_id)
         # Registered BEFORE placement: a fast attempt can resolve (and
         # its callback pop this entry) before _place returns —
         # registering after would leak a done request in the table
@@ -479,6 +498,10 @@ class ServingRouter:
         if err is not None:
             with self._lock:
                 self._requests.pop(rr.id, None)
+            _spans.end_span(rr.root_span, status=(
+                "timed_out" if isinstance(err, DeadlineExceededError)
+                else "invalid" if isinstance(err, ValueError)
+                else "shed"))
             # Count the failure by what the caller actually gets: a
             # deadline that expired during placement is timed_out, an
             # engine-side ValueError is a caller bug (not counted —
@@ -614,15 +637,29 @@ class ServingRouter:
             # offer — is drained by the scheduler before this
             # request's admission peek.
             self._pre_place(rr, rep)
+            # The placement's causal span: engine-side spans (queued /
+            # prefill / decode) parent onto it via ``parent_span``, so
+            # the tree shows WHICH replica ran which leg.
+            if hedge:
+                aspan = _spans.begin_span(
+                    "router.hedge",
+                    trace_id=rr.trace_id, parent_id=rr.root_span,
+                    replica=rep.id, forced_tokens=len(forced))
+            else:
+                aspan = _spans.begin_span(
+                    "router.attempt",
+                    trace_id=rr.trace_id, parent_id=rr.root_span,
+                    replica=rep.id, forced_tokens=len(forced))
             try:
                 handle = rep.engine.submit(
                     rr.prompt, rr.max_new_tokens,
                     temperature=rr.temperature, top_p=rr.top_p,
                     seed=rr.seed, timeout_s=timeout_s,
                     forced_prefix=list(forced) or None,
-                    trace_id=rr.trace_id,
+                    trace_id=rr.trace_id, parent_span=aspan,
                     priority=rr.priority, tenant=rr.tenant)
             except (QueueFullError, EngineClosedError) as e:
+                _spans.end_span(aspan, status="shed")
                 last_err = e
                 tried.add(rep.id)
                 continue
@@ -630,10 +667,12 @@ class ServingRouter:
                 # Validation failures are deterministic — another
                 # replica would reject the same request identically,
                 # so retrying only burns budget. Surface immediately.
+                _spans.end_span(aspan, status="invalid")
                 return e
             attempt = _Attempt(handle=handle, replica_id=rep.id,
                                forced=tuple(forced),
-                               t_submit=time.time(), hedge=hedge)
+                               t_submit=time.time(), hedge=hedge,
+                               span_id=aspan)
             stillborn = False
             with self._lock:
                 if rr.done or rr.cancel_requested:
@@ -642,6 +681,7 @@ class ServingRouter:
                     rr.attempts.append(attempt)
                     rep.live += 1
             if stillborn:
+                _spans.end_span(aspan, status="stillborn")
                 handle.cancel()
                 return None
             handle.future.add_done_callback(
@@ -674,7 +714,14 @@ class ServingRouter:
         monitor."""
         exc = fut.exception()
         now = time.time()
+        # Every attempt's callback fires exactly once, so the attempt
+        # span closes here whatever the outcome (winner, hedge loser,
+        # replica death).
+        _spans.end_span(attempt.span_id,
+                        status=("completed" if exc is None
+                                else type(exc).__name__))
         losers: List[_Attempt] = []
+        need_gap = False
         resolve: Optional[tuple] = None   # (kind, payload)
 
         def _clear_attempts():
@@ -727,6 +774,7 @@ class ServingRouter:
                         self._pending_migrations.append(
                             (rr, list(rr.last_tokens),
                              attempt.replica_id, now, exc))
+                        need_gap = True
             else:
                 # Replica death (EngineClosedError / a contained
                 # fault): keep the longest observed stream and, if no
@@ -739,6 +787,17 @@ class ServingRouter:
                     self._pending_migrations.append(
                         (rr, list(rr.last_tokens),
                          attempt.replica_id, now, exc))
+                    need_gap = True
+            if need_gap and not rr.gap_span:
+                # The stream is now homeless: the gap span stays open
+                # until a migration re-places it (or the request
+                # dies), so the anatomy charges the outage window to
+                # ``migration_gap``, not to decode.
+                rr.gap_span = _spans.begin_span(
+                    "router.migration_gap", trace_id=rr.trace_id,
+                    parent_id=rr.root_span,
+                    from_replica=attempt.replica_id,
+                    tokens_so_far=len(rr.last_tokens))
         if resolve is not None:
             kind, payload = resolve
             for loser in losers:
@@ -747,6 +806,8 @@ class ServingRouter:
                 win, res = payload
                 self._finish_completed(rr, win, res, now)
             else:
+                _spans.end_span(rr.gap_span, status=kind)
+                _spans.end_span(rr.root_span, status=kind)
                 self._count("requests", outcome=kind)
                 self._resolve_future(rr.future, exc=payload)
             with self._lock:
@@ -781,6 +842,11 @@ class ServingRouter:
             del self._ttft_samples[:-512]
         out = dataclasses.replace(res, ttft_s=ttft,
                                   e2e_s=now - rr.t_submit)
+        _spans.end_span(rr.gap_span, status="completed")
+        _spans.end_span(rr.root_span, status="completed",
+                        tokens=len(res.tokens))
+        if rr.root_span:
+            _spans.observe_request(rr.trace_id)
         self._count("requests", outcome="completed")
         self._m["ttft"].observe(
             ttft, exemplar={"trace_id": rr.trace_id})
@@ -819,6 +885,8 @@ class ServingRouter:
         for a in attempts:
             a.handle.cancel()
         if orphan:
+            _spans.end_span(rr.gap_span, status="cancelled")
+            _spans.end_span(rr.root_span, status="cancelled")
             self._count("requests", outcome="cancelled")
             self._resolve_future(rr.future, exc=CancelledError())
 
@@ -989,6 +1057,9 @@ class ServingRouter:
         if placed is None:
             with self._lock:
                 rr.migrations += 1
+                gap, rr.gap_span = rr.gap_span, ""
+            _spans.end_span(gap, status="migrated",
+                            forced_tokens=len(toks))
             self._count("migrations")
             if toks:
                 self._count("migrated_tokens", len(toks))
@@ -1022,9 +1093,12 @@ class ServingRouter:
         with self._lock:
             rr.done = True
             self._requests.pop(rr.id, None)
-        self._count("requests", outcome=(
-            "timed_out" if isinstance(final, DeadlineExceededError)
-            else "failed"))
+        outcome = ("timed_out"
+                   if isinstance(final, DeadlineExceededError)
+                   else "failed")
+        _spans.end_span(rr.gap_span, status=outcome)
+        _spans.end_span(rr.root_span, status=outcome)
+        self._count("requests", outcome=outcome)
         _events.emit("router.migrate_failed", request_id=rr.id,
                      trace_id=rr.trace_id, error=repr(final))
         self._resolve_future(rr.future, exc=final)
@@ -1061,6 +1135,10 @@ class ServingRouter:
             ttft_s=ttft,
             tpot_s=((now - first) / (n - 1) if n > 1 else None),
             e2e_s=now - rr.t_submit, trace_id=rr.trace_id)
+        _spans.end_span(rr.gap_span, status="terminal")
+        _spans.end_span(rr.root_span, status="completed", tokens=n)
+        if rr.root_span:
+            _spans.observe_request(rr.trace_id)
         self._count("requests", outcome="completed")
         self._m["ttft"].observe(ttft,
                                 exemplar={"trace_id": rr.trace_id})

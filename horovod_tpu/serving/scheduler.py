@@ -56,6 +56,7 @@ import numpy as np
 
 from horovod_tpu.annotations import hot_path
 from horovod_tpu.obs import events as _events
+from horovod_tpu.obs import spans as _spans
 from horovod_tpu.resilience import chaos
 from horovod_tpu.serving.admission import (
     AdmissionQueue, DeadlineExceededError, EngineClosedError, Request,
@@ -252,6 +253,10 @@ class ContinuousBatchingScheduler:
             self._prefill_order.clear()
             self._pending = None
         for req in doomed:
+            for slot in ("queued", "prefill", "decode", "paused",
+                         "root"):
+                _spans.end_span(req.span_ids.pop(slot, ""),
+                                status="failed")
             self._resolve(req.future, exc=make_exc(req))
         return len(doomed)
 
@@ -355,6 +360,7 @@ class ContinuousBatchingScheduler:
         round retired."""
         tick_name = (f"serving_spec_{self._gen}."
                      f"{self.metrics.ticks}")
+        t_round0 = time.time()
         if self.stall is not None:
             self.stall.begin(tick_name)
         try:
@@ -370,6 +376,7 @@ class ContinuousBatchingScheduler:
         finally:
             if self.stall is not None:
                 self.stall.end(tick_name)
+        round_dur = time.time() - t_round0
         self.metrics.count("ticks")
         self.metrics.count("spec_rounds")
         self.metrics.count("host_syncs")
@@ -382,6 +389,12 @@ class ContinuousBatchingScheduler:
             if int(proposed[slot]) > 0:
                 prop += int(proposed[slot])
                 accepted += max(0, n - 1)
+                _spans.record_span(
+                    "serving.spec_round", trace_id=req.trace_id,
+                    parent_id=req.span_ids.get("decode", ""),
+                    t0=t_round0, duration=round_dur,
+                    proposed=int(proposed[slot]),
+                    accepted=max(0, n - 1))
             multi = multi or n >= 2
             t_tick = time.time()
             for j in range(n):
@@ -485,6 +498,18 @@ class ContinuousBatchingScheduler:
                 req = self.queue.pop_ready(now, on_drop=self._queue_drop)
                 if req is None:
                     break
+                # Causal spans: the queue wait (and any preemption
+                # pause) ends the moment the head is popped for
+                # admission; the admit/pin/reserve work is its own
+                # phase span.
+                _spans.end_span(req.span_ids.pop("queued", ""),
+                                status="admitted")
+                _spans.end_span(req.span_ids.pop("paused", ""),
+                                status="resumed")
+                adm_sid = _spans.begin_span(
+                    "serving.admission", trace_id=req.trace_id,
+                    parent_id=req.parent_span
+                    or req.span_ids.get("root", ""))
                 # Registration is the handoff-critical line: between
                 # pop_ready above and the prefilling registration the
                 # request is in neither the queue nor a scheduler dict,
@@ -520,6 +545,13 @@ class ContinuousBatchingScheduler:
                             self.prefilling[slot] = job
                             self._prefill_order.append(slot)
                 if blocked is not None:
+                    _spans.end_span(adm_sid, status="blocked")
+                    blocked.span_ids["queued"] = _spans.begin_span(
+                        "serving.queued",
+                        trace_id=blocked.trace_id,
+                        parent_id=blocked.parent_span
+                        or blocked.span_ids.get("root", ""),
+                        requeued=True)
                     self.queue.requeue([blocked])
                     break
                 req.prefix_cached = adm.skipped
@@ -550,6 +582,13 @@ class ContinuousBatchingScheduler:
                 self.metrics.observe_peak(len(self.active)
                                           + len(self.prefilling))
                 req.t_prefill = time.time()
+                _spans.end_span(adm_sid, prefix_cached=adm.skipped)
+                req.span_ids["prefill"] = _spans.begin_span(
+                    "serving.prefill", trace_id=req.trace_id,
+                    parent_id=req.parent_span
+                    or req.span_ids.get("root", ""),
+                    prompt_tokens=int(full.shape[0]),
+                    prefix_cached=adm.skipped)
                 _span("end_span", req.id, "QUEUE")
                 _span("begin_span", req.id, "PREFILL",
                       trace_id=req.trace_id)
@@ -569,9 +608,15 @@ class ContinuousBatchingScheduler:
             while job.chunks and (left is None
                                   or job.chunks[0] <= left):
                 c = job.chunks.pop(0)
+                csid = _spans.begin_span(
+                    "serving.prefill_chunk",
+                    trace_id=job.req.trace_id,
+                    parent_id=job.req.span_ids.get("prefill", ""),
+                    tokens=c, off=job.off)
                 job.logits = self.pool.prefill_chunk(
                     slot, job.prompt[job.off:job.off + c])
                 job.off += c
+                _spans.end_span(csid)
                 self.metrics.count("prefill_chunks")
                 self.metrics.count("prefill_tokens", c)
                 if left is not None:
@@ -767,6 +812,19 @@ class ContinuousBatchingScheduler:
         # hvd: disable=HVD004(active is dispatch-thread-owned; the handoff lock only orders the container handoff, and abandon() snapshots wholesale)
         self.active.pop(slot, None)
         _span("end_span", req.id, "DECODE")
+        _spans.end_span(req.span_ids.pop("decode", ""),
+                        status="preempted", mode=mode)
+        # The pause span stays OPEN across the requeue — the resume's
+        # admission pop closes it, so the anatomy charges the whole
+        # evicted-to-readmitted gap to ``preempt_paused``. The
+        # span_ids dict is SHARED with the `dataclasses.replace` copy
+        # below, so the successor sees (and closes) this span.
+        req.span_ids["paused"] = _spans.begin_span(
+            "serving.preempt_paused", trace_id=req.trace_id,
+            parent_id=req.parent_span
+            or req.span_ids.get("root", ""),
+            mode=mode, reason=reason,
+            tokens_emitted=len(req.tokens))
         # The resume: everything emitted becomes forced prefix (teacher
         # forced in prefill, rng_skip re-aligns the sampled stream) and
         # stays in `tokens` so a cancel/expiry mid-queue still returns
@@ -865,6 +923,11 @@ class ContinuousBatchingScheduler:
         _span("end_span", req.id, "PREFILL")
         _span("begin_span", req.id, "DECODE",
               trace_id=req.trace_id)
+        _spans.end_span(req.span_ids.pop("prefill", ""))
+        req.span_ids["decode"] = _spans.begin_span(
+            "serving.decode", trace_id=req.trace_id,
+            parent_id=req.parent_span
+            or req.span_ids.get("root", ""))
         self._maybe_retire(slot, req, first, req.t_first)
 
     def _queue_drop(self, req: Request, kind: str):
@@ -875,6 +938,11 @@ class ContinuousBatchingScheduler:
         self.metrics.count("cancelled" if kind == "cancelled"
                            else "timed_out")
         _span("end_span", req.id, "QUEUE")
+        _spans.end_span(req.span_ids.pop("queued", ""),
+                        status=kind)
+        _spans.end_span(req.span_ids.pop("paused", ""),
+                        status=kind)
+        _spans.end_span(req.span_ids.pop("root", ""), status=kind)
         tl = _timeline()
         if tl is not None:
             tl.mark(f"request:{req.id}", kind.upper())
@@ -917,6 +985,8 @@ class ContinuousBatchingScheduler:
         # hvd: disable=HVD004(dispatch-thread-owned retire; abandon() clearing concurrently makes this a benign no-op, tolerated by _resolve)
         self.active.pop(slot, None)
         _span("end_span", req.id, "DECODE")
+        _spans.end_span(req.span_ids.pop("decode", ""),
+                        status=reason)
         self._finalize(req, reason, now)
 
     def _retire_prefill(self, slot: int, job: _PrefillJob,
@@ -935,6 +1005,8 @@ class ContinuousBatchingScheduler:
             self._prefill_order.remove(slot)
         self.pool.free(slot)
         _span("end_span", job.req.id, "PREFILL")
+        _spans.end_span(job.req.span_ids.pop("prefill", ""),
+                        status=reason)
         self._finalize(job.req, reason, time.time())
 
     def _finalize(self, req: Request, reason: str, now: float):
@@ -950,6 +1022,15 @@ class ContinuousBatchingScheduler:
         _events.emit("serving.retire", request_id=req.id,
                      trace_id=req.trace_id, reason=reason,
                      tokens=len(req.tokens))
+        # Close the causal root span — present only on engine-minted
+        # client entries (router/disagg legs close their own roots) —
+        # and, on a clean completion, decompose the finished span tree
+        # into the per-phase anatomy histograms.
+        root_sid = req.span_ids.pop("root", "")
+        _spans.end_span(root_sid, status=reason,
+                        tokens=len(req.tokens))
+        if root_sid and reason in ("eos", "length"):
+            _spans.observe_request(req.trace_id)
         if reason in ("eos", "length"):
             n = len(req.tokens)
             self.metrics.count("completed")

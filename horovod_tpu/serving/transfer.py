@@ -50,6 +50,7 @@ import numpy as np
 import jax
 
 from horovod_tpu.models.transformer import gather_block_rows
+from horovod_tpu.obs import spans as _spans
 from horovod_tpu.parallel.mesh import put_like
 from horovod_tpu.resilience import chaos
 from horovod_tpu.serving.admission import ServingError
@@ -98,6 +99,10 @@ class BlockTransfer:
     kv_dtypes: Tuple[str, ...]
     mode: str = "host"
     trace_id: str = ""
+    # Causal-span parent for the transfer.* spans the ingest side
+    # emits: the exporter's handoff span id, so both halves of the
+    # handoff hang under ONE node of the request's trace tree.
+    parent_span: str = ""
     t_export: float = 0.0
 
     @property
@@ -121,7 +126,8 @@ def _byte_digest(leaf_rows: List[np.ndarray], chain: bytes) -> bytes:
 
 
 def export_blocks(pool, prompt, emitted=(), *, mode: str = "host",
-                  trace_id: str = "") -> Optional[BlockTransfer]:
+                  trace_id: str = "",
+                  parent_span: str = "") -> Optional[BlockTransfer]:
     """Extract ``prompt``'s full resident prefix blocks from a
     `PagedSlotPool` as a `BlockTransfer`, or None when there is
     nothing worth shipping (non-paged pool, prefix cache off, prompt
@@ -147,6 +153,8 @@ def export_blocks(pool, prompt, emitted=(), *, mode: str = "host",
     n = len(prompt) // bs
     if n == 0:
         return None
+    sid = _spans.begin_span("transfer.export", trace_id=trace_id,
+                            parent_id=parent_span, mode=mode)
     chain = blocks._chain(prompt, n)
     for _ in range(_EXPORT_RETRIES):
         epoch = blocks._epoch
@@ -157,6 +165,7 @@ def export_blocks(pool, prompt, emitted=(), *, mode: str = "host",
                 break
             bids.append(bid)
         if not bids:
+            _spans.end_span(sid, status="not_resident")
             return None
         with pool._ctx():
             dev_rows = gather_block_rows(pool._pools, bids)
@@ -177,13 +186,18 @@ def export_blocks(pool, prompt, emitted=(), *, mode: str = "host",
         byte_digests = tuple(
             _byte_digest([hr[i] for hr in host_rows], chain[i])
             for i in range(m))
-        return BlockTransfer(
+        tr = BlockTransfer(
             prompt=prompt, emitted=tuple(int(t) for t in emitted),
             block_size=bs, chain_digests=tuple(chain[:m]),
             byte_digests=byte_digests, rows=rows,
             kv_shapes=tuple(tuple(r.shape[1:]) for r in rows),
             kv_dtypes=tuple(str(np.dtype(r.dtype)) for r in rows),
-            mode=mode, trace_id=trace_id, t_export=time.time())
+            mode=mode, trace_id=trace_id, parent_span=parent_span,
+            t_export=time.time())
+        _spans.end_span(sid, status="ok", blocks=m,
+                        bytes=tr.nbytes)
+        return tr
+    _spans.end_span(sid, status="raced")
     raise TransferExportError(
         f"block export raced the allocator {_EXPORT_RETRIES} times "
         f"(pool under eviction pressure)")
@@ -227,36 +241,48 @@ def ingest_blocks(pool, tr: BlockTransfer) -> int:
     blocks = getattr(pool, "blocks", None)
     if blocks is None or not getattr(blocks, "prefix_cache", False):
         return 0
-    _check_compat(pool, tr)
     m = tr.num_blocks
-    if not (len(tr.byte_digests) == m
-            and all(len(r) == m for r in tr.rows)):
-        raise TransferVerifyError(
-            f"manifest arity mismatch: {m} chain digests, "
-            f"{len(tr.byte_digests)} byte digests, rows "
-            f"{[len(r) for r in tr.rows]}")
-    # Host copies for verification (and for the corrupt drill —
-    # flipping the copy models a wire fault without touching the
-    # caller's buffers).
-    # hvd: disable=HVD001(verify wants host bytes; once per handoff, off the tick ring)
-    rows_h = [np.array(r, copy=True) for r in tr.rows]
-    if chaos.fires("disagg.block_corrupt"):
-        rows_h[0].view(np.uint8).reshape(-1)[0] ^= 0xFF
-    # Layer 1: the chain digests must be the prompt's own chain —
-    # block i's identity commits to tokens[0 : (i+1)*block_size].
-    expect = blocks._chain(tr.prompt, m)
-    if tuple(expect) != tuple(tr.chain_digests):
-        raise TransferVerifyError(
-            "chain digest mismatch: manifest digests are not the "
-            "prompt's prefix chain")
-    # Layer 2: the row bytes must be the bytes the exporter hashed.
-    for i in range(m):
-        got = _byte_digest([r[i] for r in rows_h],
-                           tr.chain_digests[i])
-        if got != tr.byte_digests[i]:
+    vsid = _spans.begin_span("transfer.verify",
+                             trace_id=tr.trace_id,
+                             parent_id=tr.parent_span, blocks=m)
+    try:
+        _check_compat(pool, tr)
+        if not (len(tr.byte_digests) == m
+                and all(len(r) == m for r in tr.rows)):
             raise TransferVerifyError(
-                f"block {i} byte digest mismatch (transfer "
-                f"corrupted in flight)")
+                f"manifest arity mismatch: {m} chain digests, "
+                f"{len(tr.byte_digests)} byte digests, rows "
+                f"{[len(r) for r in tr.rows]}")
+        # Host copies for verification (and for the corrupt drill —
+        # flipping the copy models a wire fault without touching the
+        # caller's buffers).
+        # hvd: disable=HVD001(verify wants host bytes; once per handoff, off the tick ring)
+        rows_h = [np.array(r, copy=True) for r in tr.rows]
+        if chaos.fires("disagg.block_corrupt"):
+            rows_h[0].view(np.uint8).reshape(-1)[0] ^= 0xFF
+        # Layer 1: the chain digests must be the prompt's own chain —
+        # block i's identity commits to tokens[0 : (i+1)*block_size].
+        expect = blocks._chain(tr.prompt, m)
+        if tuple(expect) != tuple(tr.chain_digests):
+            raise TransferVerifyError(
+                "chain digest mismatch: manifest digests are not "
+                "the prompt's prefix chain")
+        # Layer 2: row bytes must be the bytes the exporter hashed.
+        for i in range(m):
+            got = _byte_digest([r[i] for r in rows_h],
+                               tr.chain_digests[i])
+            if got != tr.byte_digests[i]:
+                raise TransferVerifyError(
+                    f"block {i} byte digest mismatch (transfer "
+                    f"corrupted in flight)")
+    except TransferError as e:
+        _spans.end_span(vsid, status="failed",
+                        error=type(e).__name__)
+        raise
+    _spans.end_span(vsid, status="ok")
+    isid = _spans.begin_span("transfer.ingest",
+                             trace_id=tr.trace_id,
+                             parent_id=tr.parent_span, blocks=m)
     # Re-commit the row stacks under the destination's layouts ONCE:
     # the stacked [m, 1, bs, ...] arrays are rank-aligned with the
     # pool leaves ([num_blocks, 1, bs, ...]), so `put_like` lands the
@@ -292,4 +318,5 @@ def ingest_blocks(pool, tr: BlockTransfer) -> int:
         from horovod_tpu.models.transformer import shard_paged_pools
         with pool._ctx():
             pool._pools = shard_paged_pools(pool._pools, pool.mesh)
+    _spans.end_span(isid, adopted=adopted)
     return adopted

@@ -39,6 +39,7 @@ FIXTURE_CASES = [
     ("hvd009_blocking_lock.py", "HVD009"),
     ("hvd010_metric_catalog.py", "HVD010"),
     ("hvd011_event_docs.py", "HVD011"),
+    ("hvd012_span_catalog.py", "HVD012"),
 ]
 
 
@@ -78,10 +79,10 @@ class TestRuleFixtures:
         ids = [mod.RULE.id for mod in ALL_RULES]
         assert ids == ["HVD001", "HVD002", "HVD003", "HVD004",
                        "HVD005", "HVD006", "HVD007", "HVD008",
-                       "HVD009", "HVD010", "HVD011"]
+                       "HVD009", "HVD010", "HVD011", "HVD012"]
         assert all(mod.RULE.severity in ("error", "warning")
                    for mod in ALL_RULES)
-        assert len({mod.RULE.name for mod in ALL_RULES}) == 11
+        assert len({mod.RULE.name for mod in ALL_RULES}) == 12
 
 
 class TestRepoIsClean:
@@ -388,6 +389,34 @@ class TestEventTable:
             assert kind in EVENT_CATALOG, kind
 
 
+class TestSpanTable:
+    def test_doc_table_matches_catalog(self):
+        """The request-tracing span table is GENERATED from
+        SPAN_CATALOG (python -m horovod_tpu.analysis
+        --write-span-table) — pinned here so doc and catalog cannot
+        drift (the doc twin of HVD012's record-site pin)."""
+        from horovod_tpu.obs.spans import span_table_md
+        doc = os.path.join(REPO, "docs", "observability.md")
+        with open(doc) as fh:
+            text = fh.read()
+        m = re.search(
+            r"<!-- hvdlint:span-table:begin -->\n(.*?)"
+            r"<!-- hvdlint:span-table:end -->", text, re.S)
+        assert m, "observability.md lost its span-table markers"
+        assert m.group(1) == span_table_md(), (
+            "docs/observability.md span table is stale — regenerate "
+            "with: python -m horovod_tpu.analysis --write-span-table")
+
+    def test_catalog_covers_known_spans(self):
+        from horovod_tpu.obs.spans import SPAN_CATALOG, SPAN_PHASE
+        for name in ("serving.request", "serving.queued",
+                     "serving.prefill", "serving.decode",
+                     "router.request", "router.migration_gap",
+                     "disagg.handoff", "transfer.export"):
+            assert name in SPAN_CATALOG, name
+        assert set(SPAN_PHASE) <= set(SPAN_CATALOG)
+
+
 class TestDriftSelfProof:
     """The acceptance bar for the contract-drift rules: injecting an
     undeclared metric (or an undocumented event kind) in a temp file
@@ -428,6 +457,23 @@ class TestDriftSelfProof:
         out = json.loads(proc.stdout)
         assert [f["rule"] for f in out["findings"]] == ["HVD011"]
         assert "injected.unknown_kind" in out["findings"][0]["message"]
+
+    def test_undeclared_span_fails_gate(self, tmp_path):
+        bad = tmp_path / "injected_span.py"
+        bad.write_text(textwrap.dedent("""\
+            from horovod_tpu.obs import spans
+
+
+            def trace():
+                sid = spans.begin_span("injected.unknown_span",
+                                       trace_id="t")
+                spans.end_span(sid)
+            """))
+        proc = self._cli(bad, "HVD012")
+        assert proc.returncode == 1, proc.stderr
+        out = json.loads(proc.stdout)
+        assert [f["rule"] for f in out["findings"]] == ["HVD012"]
+        assert "injected.unknown_span" in out["findings"][0]["message"]
 
     def test_json_by_rule_counts(self, tmp_path):
         proc = self._cli(
@@ -485,6 +531,28 @@ class TestDeadEntryDirections:
         assert [f.rule for f in active] == ["HVD011"]
         assert "mini.never" in active[0].message
         assert active[0].path.endswith("obs/events.py")
+
+    def test_dead_span_promise(self, tmp_path):
+        obs = tmp_path / "obs"
+        obs.mkdir()
+        (obs / "spans.py").write_text(textwrap.dedent("""\
+            SPAN_CATALOG = {
+                "mini.recorded": "happens",
+                "mini.never": "a dead promise",
+            }
+            """))
+        (tmp_path / "consumer.py").write_text(textwrap.dedent("""\
+            from horovod_tpu.obs import spans
+
+
+            def trace():
+                spans.begin_span("mini.recorded", trace_id="t")
+            """))
+        files = collect_files([str(tmp_path)], str(tmp_path))
+        active, _ = run_rules(Project(files), [BY_ID["HVD012"]])
+        assert [f.rule for f in active] == ["HVD012"]
+        assert "mini.never" in active[0].message
+        assert active[0].path.endswith("obs/spans.py")
 
 
 class TestChangedOnly:

@@ -371,7 +371,7 @@ class FakeEngine:
 
     def submit(self, prompt, max_new_tokens, *, temperature=0.0,
                top_p=None, seed=0, timeout_s=None, forced_prefix=None,
-               trace_id=None, priority=0, tenant=""):
+               trace_id=None, parent_span="", priority=0, tenant=""):
         with self._lock:
             if self._stop.is_set():
                 raise EngineClosedError("fake closed")

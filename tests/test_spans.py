@@ -1,0 +1,528 @@
+"""Causal span-tree + record/replay tests (`obs/spans.py`,
+`obs/reqlog.py`, docs/observability.md "Request tracing").
+
+Four layers of proof:
+
+* **Recorder units** — begin/end tree structure, idempotent end,
+  deterministic head sampling (every process agrees per trace_id),
+  ring eviction (an evicted trace 404s), the JSONL mirror's
+  round-trip and warn-and-disable fault contract.
+* **Anatomy math** — the interval sweep on synthetic span sets with
+  hand-computable answers: nesting (latest start wins), seam gaps
+  (forward-fill), open spans (clip at trace end).
+* **Live pipeline** — a real engine request's phase anatomy sums to
+  the client-observed latency within the 5% acceptance bound; a
+  migrated request and a disagg handoff each leave ONE connected
+  span tree under one trace_id; the Chrome export is valid
+  Perfetto trace-event JSON; `/trace/<id>` serves it (404 unknown).
+* **Record/replay** — a request log round-trips: counts, per-request
+  token budgets, tenant/priority lanes, and the prefix-sharing
+  structure survive record -> synthesize -> re-chain exactly.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu.models.transformer import TransformerLM
+from horovod_tpu.obs import reqlog, spans
+from horovod_tpu.obs.exporter import MetricsServer
+from horovod_tpu.obs.spans import (
+    PHASES, SPAN_CATALOG, SPAN_PHASE, SpanRecorder, chrome_trace,
+    load_jsonl, phase_anatomy, sampled, span_table_md, waterfall,
+)
+from horovod_tpu.parallel.tensor import unbox
+from horovod_tpu.serving import ServingEngine, ServingRouter
+
+VOCAB = 64
+MAX_LEN = 64
+BS = 8
+
+
+@pytest.fixture(scope="module")
+def lm(hvd):
+    model = TransformerLM(vocab_size=VOCAB, num_layers=2, num_heads=4,
+                          head_dim=8, max_len=MAX_LEN,
+                          dtype=jnp.float32)
+    params = unbox(model.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 16), jnp.int32))["params"])
+    return model, params
+
+
+@pytest.fixture
+def rec():
+    """Scoped global recorder: tests swap in a fresh ring and restore
+    the previous recorder after (a user-configured HVD_TRACE_LOG must
+    survive the suite)."""
+    r = SpanRecorder()
+    prev = spans.install(r)
+    yield r
+    restored = spans.install(prev)
+    assert restored is r
+
+
+def _prompts(n, seed=0, lo=2, hi=8):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, VOCAB, (int(rs.randint(lo, hi)),))
+            for _ in range(n)]
+
+
+def _wait(cond, timeout=120.0, dt=0.005):
+    t0 = time.time()
+    while not cond():
+        if time.time() - t0 > timeout:
+            raise AssertionError("condition not reached in time")
+        time.sleep(dt)
+
+
+def _factory(model, params, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("max_queue", 16)
+    return lambda: ServingEngine(model, params, **kw)
+
+
+def _assert_connected(tree, trace_id):
+    """One root, every parent resolvable in-tree, one trace_id."""
+    ids = {s["span_id"] for s in tree}
+    roots = [s for s in tree if not s["parent_id"]]
+    assert len(roots) == 1, (
+        f"expected ONE root, got {[(s['name'], s['span_id']) for s in roots]}")
+    for s in tree:
+        assert s["trace_id"] == trace_id
+        if s["parent_id"]:
+            assert s["parent_id"] in ids, (
+                f"{s['name']} parent {s['parent_id']} not in tree")
+    return roots[0]
+
+
+# ---------------------------------------------------------------------------
+# Recorder units
+# ---------------------------------------------------------------------------
+
+class TestRecorder:
+    def test_begin_end_tree(self):
+        r = SpanRecorder()
+        tid = spans.mint_trace_id()
+        root = r.begin("serving.request", trace_id=tid, n=1)
+        child = r.begin("serving.prefill", trace_id=tid,
+                        parent_id=root)
+        r.end(child, tokens=7)
+        r.end(root, status="eos")
+        tree = r.trace(tid)
+        assert [s["name"] for s in tree] == ["serving.request",
+                                             "serving.prefill"]
+        got_root = _assert_connected(tree, tid)
+        assert got_root["name"] == "serving.request"
+        assert got_root["attrs"] == {"n": 1, "status": "eos"}
+        kid = tree[1]
+        assert kid["parent_id"] == root
+        assert kid["attrs"]["tokens"] == 7
+        assert kid["t1"] >= kid["t0"] > 0
+
+    def test_end_idempotent_and_empty_noop(self):
+        r = SpanRecorder()
+        tid = spans.mint_trace_id()
+        sid = r.begin("serving.decode", trace_id=tid)
+        r.end(sid)
+        t1 = r.trace(tid)[0]["t1"]
+        r.end(sid, status="again")       # already ended: no-op
+        r.end("")                        # sampled-out id: no-op
+        r.end("ffffffff")                # unknown id: no-op
+        after = r.trace(tid)[0]
+        assert after["t1"] == t1
+        assert "status" not in after["attrs"]
+
+    def test_sampling_deterministic_and_complete(self):
+        # The keep/drop decision is a pure function of trace_id: the
+        # same id gets the same verdict from ANY recorder at the same
+        # rate, and a kept trace keeps every span.
+        ids = [spans.mint_trace_id() for _ in range(64)]
+        kept = [t for t in ids if sampled(t, 0.5)]
+        assert 0 < len(kept) < len(ids)   # 64 ids: both sides occupied
+        r1, r2 = SpanRecorder(sample=0.5), SpanRecorder(sample=0.5)
+        for t in ids:
+            s1 = r1.begin("serving.request", trace_id=t)
+            s2 = r2.begin("serving.queued", trace_id=t)
+            assert bool(s1) == bool(s2) == sampled(t, 0.5)
+        assert sampled("anything", 1.0) and not sampled("anything", 0.0)
+
+    def test_ring_eviction_evicts_whole_trace(self):
+        r = SpanRecorder(maxlen=4)
+        tids = [spans.mint_trace_id() for _ in range(3)]
+        for t in tids:
+            r.end(r.begin("serving.queued", trace_id=t))
+            r.end(r.begin("serving.decode", trace_id=t))
+        assert r.trace(tids[0]) is None       # aged out entirely
+        assert r.trace(tids[2]) is not None
+        assert len(r) == 4
+
+    def test_jsonl_mirror_roundtrip(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        r = SpanRecorder(path)
+        tid = spans.mint_trace_id()
+        root = r.begin("serving.request", trace_id=tid)
+        r.end(r.begin("serving.prefill", trace_id=tid, parent_id=root,
+                      chunks=2))
+        r.record("serving.spec_round", trace_id=tid, parent_id=root,
+                 t0=time.time(), duration=0.25, proposed=4, accepted=3)
+        r.end(root, status="eos")
+        r.close()
+        got = load_jsonl(path)
+        # Only COMPLETED spans hit the mirror; order is completion
+        # order (prefill before its root).
+        assert [s["name"] for s in got] == [
+            "serving.prefill", "serving.spec_round", "serving.request"]
+        assert all(s["trace_id"] == tid for s in got)
+        spec = got[1]
+        assert spec["attrs"] == {"proposed": 4, "accepted": 3}
+        assert spec["t1"] - spec["t0"] == pytest.approx(0.25, abs=1e-5)
+        _assert_connected(got, tid)
+
+    def test_write_fault_warns_and_disables(self, tmp_path, capsys):
+        path = str(tmp_path / "no_such_dir" / "trace.jsonl")
+        r = SpanRecorder(path)
+        tid = spans.mint_trace_id()
+        r.end(r.begin("serving.request", trace_id=tid))
+        r.end(r.begin("serving.request", trace_id=tid))
+        # Recording survives the fault: the ring is intact, the file
+        # is abandoned, ONE warning on stderr.
+        assert len(r.trace(tid)) == 2
+        err = capsys.readouterr().err
+        assert err.count("WARNING") == 1 and "disabling" in err
+
+    def test_annotate_open_span(self):
+        r = SpanRecorder()
+        tid = spans.mint_trace_id()
+        sid = r.begin("serving.decode", trace_id=tid)
+        r.annotate(sid, lane=3)
+        r.annotate("", lane=9)           # sampled-out: no-op
+        r.end(sid)
+        assert r.trace(tid)[0]["attrs"] == {"lane": 3}
+
+    def test_slowest_tracks_completed_roots(self):
+        r = SpanRecorder()
+        fast, slow = spans.mint_trace_id(), spans.mint_trace_id()
+        s1 = r.begin("serving.request", trace_id=fast)
+        r.end(s1)
+        s2 = r.begin("router.request", trace_id=slow)
+        time.sleep(0.02)
+        r.end(s2)
+        assert r.slowest() == slow
+
+    def test_catalog_and_phase_map_agree(self):
+        assert set(SPAN_PHASE) <= set(SPAN_CATALOG)
+        assert set(SPAN_PHASE.values()) <= set(PHASES)
+        md = span_table_md()
+        for name in SPAN_CATALOG:
+            assert f"`{name}`" in md
+
+
+# ---------------------------------------------------------------------------
+# Anatomy math (synthetic spans, hand-computable)
+# ---------------------------------------------------------------------------
+
+def _span(name, t0, t1, parent="", tid="feedfacefeedface"):
+    return {"trace_id": tid, "span_id": spans.new_span_id(),
+            "parent_id": parent, "name": name, "t0": float(t0),
+            "t1": float(t1), "pid": 1, "attrs": {}}
+
+
+class TestAnatomy:
+    def test_disjoint_phases_sum_exact(self):
+        tree = [_span("serving.request", 0, 6),
+                _span("serving.queued", 0, 1),
+                _span("serving.prefill", 1, 3),
+                _span("serving.decode", 3, 6)]
+        anat = phase_anatomy(tree)
+        assert anat == {"queue_wait": pytest.approx(1.0),
+                        "prefill": pytest.approx(2.0),
+                        "decode": pytest.approx(3.0)}
+
+    def test_nested_latest_start_wins(self):
+        # transfer.ingest INSIDE the destination prefill owns its
+        # slice — most-specific attribution.
+        tree = [_span("serving.prefill", 0, 4),
+                _span("transfer.ingest", 1, 2)]
+        anat = phase_anatomy(tree)
+        assert anat == {"prefill": pytest.approx(3.0),
+                        "transfer_ingest": pytest.approx(1.0)}
+
+    def test_seam_gap_forward_fills(self):
+        # An uncovered sliver between admission and prefill belongs
+        # to the phase before it, so the sum still covers the trace.
+        tree = [_span("serving.admission", 0, 1),
+                _span("serving.prefill", 1.5, 3)]
+        anat = phase_anatomy(tree)
+        assert anat == {"admission": pytest.approx(1.5),
+                        "prefill": pytest.approx(1.5)}
+        assert sum(anat.values()) == pytest.approx(3.0)
+
+    def test_open_span_clips_at_trace_end(self):
+        tree = [_span("serving.decode", 0, 0.0),     # open (t1 == 0)
+                _span("serving.queued", 0, 1),
+                _span("serving.prefill", 1, 5)]
+        anat = phase_anatomy(tree)
+        assert sum(anat.values()) == pytest.approx(5.0)
+        assert anat["prefill"] == pytest.approx(4.0)
+
+    def test_empty_and_unphased(self):
+        assert phase_anatomy([]) == {}
+        assert phase_anatomy([_span("router.attempt", 0, 2)]) == {}
+
+    def test_waterfall_renders_tree(self):
+        root = _span("serving.request", 0, 3)
+        kid = _span("serving.prefill", 0.5, 2, parent=root["span_id"])
+        text = waterfall([root, kid])
+        assert "serving.request" in text and "serving.prefill" in text
+        assert "[prefill]" in text
+        assert text.index("serving.request") < text.index(
+            "serving.prefill")
+
+    def test_chrome_trace_shape(self):
+        tree = [_span("serving.request", 0, 3),
+                _span("serving.prefill", 1, 2)]
+        doc = json.loads(json.dumps(chrome_trace(tree)))
+        evs = doc["traceEvents"]
+        assert len(evs) == 2
+        for ev in evs:
+            assert ev["ph"] == "X"
+            assert isinstance(ev["ts"], (int, float))
+            assert ev["dur"] >= 0
+            assert isinstance(ev["pid"], int)
+            assert isinstance(ev["tid"], int)
+            assert ev["args"]["trace_id"] == "feedfacefeedface"
+        assert evs[0]["ts"] <= evs[1]["ts"]
+
+
+# ---------------------------------------------------------------------------
+# Live pipeline: engine, migration, disagg, export, endpoint
+# ---------------------------------------------------------------------------
+
+class TestPipelineSpans:
+    def test_engine_anatomy_sums_to_client_latency(self, lm, rec):
+        """The acceptance bound: per-phase anatomy sums within 5% of
+        what the CLIENT measured around submit -> result."""
+        model, params = lm
+        prompt = _prompts(1, seed=5)[0]
+        with ServingEngine(model, params, num_slots=2,
+                           max_queue=4) as eng:
+            t0 = time.time()
+            h = eng.submit(prompt, 16, temperature=0.0)
+            res = h.result(timeout=300)
+            e2e = time.time() - t0
+        tree = rec.trace(h.trace_id)
+        root = _assert_connected(tree, h.trace_id)
+        assert root["name"] == "serving.request"
+        names = {s["name"] for s in tree}
+        assert {"serving.queued", "serving.admission",
+                "serving.prefill", "serving.decode"} <= names
+        anat = phase_anatomy(tree)
+        assert set(anat) <= set(PHASES)
+        total = sum(anat.values())
+        assert abs(total - e2e) / e2e < 0.05, (anat, e2e)
+        assert len(res.tokens) == 16
+
+    def test_migration_one_connected_trace(self, lm, rec):
+        """Kill a replica mid-decode: the migrated request's spans —
+        both placement legs, the migration gap, both engines' leg
+        spans — form ONE connected tree under ONE trace_id."""
+        model, params = lm
+        prompts = _prompts(4, seed=3)
+        steps = 30
+        with ServingRouter(_factory(model, params), num_replicas=2,
+                           health_poll_s=0.01) as router:
+            hs = [router.submit(p, steps, temperature=0.7, seed=s)
+                  for s, p in enumerate(prompts)]
+            _wait(lambda: any(len(h.tokens_so_far()) >= 3
+                              for h in hs))
+            victim = max(
+                router.replicas(),
+                key=lambda rid: router.engine_of(rid).pool.busy_slots)
+            router.kill_replica(victim)
+            for h in hs:
+                h.result(timeout=300)
+            migrated = [h for h in hs if h.migrations() > 0]
+            assert migrated, "the kill caught no stream mid-flight"
+            h = migrated[0]
+            tree = rec.trace(h.trace_id)
+        root = _assert_connected(tree, h.trace_id)
+        assert root["name"] == "router.request"
+        names = [s["name"] for s in tree]
+        assert names.count("router.attempt") >= 2   # both legs
+        assert "router.migration_gap" in names
+        # Engine-side legs hang under the attempts, not floating.
+        attempts = {s["span_id"] for s in tree
+                    if s["name"] == "router.attempt"}
+        engine_legs = [s for s in tree if s["name"] == "serving.queued"]
+        assert engine_legs
+        assert all(s["parent_id"] in attempts for s in engine_legs)
+        # Every span in the tree is ended (the tree is complete).
+        gap = next(s for s in tree
+                   if s["name"] == "router.migration_gap")
+        assert gap["t1"] > 0 and gap["attrs"]["status"] == "migrated"
+
+    def test_disagg_handoff_one_connected_trace(self, lm, rec):
+        """Prefill-pool -> decode-pool handoff: export, verify and
+        ingest spans of BOTH replicas land in one connected tree."""
+        model, params = lm
+        rs = np.random.RandomState(21)
+        prompt = rs.randint(0, VOCAB, (2 * BS + 2,))
+        router = ServingRouter(
+            _factory(model, params, paged=True, kv_block_size=BS),
+            disagg={"prefill": 1, "decode": 1})
+        try:
+            h = router.submit(prompt, 6)
+            res = h.result(timeout=300)
+            snap = router.metrics_snapshot()
+        finally:
+            router.shutdown()
+        assert snap["disagg"]["handoffs"] == 1
+        tree = rec.trace(h.trace_id)
+        root = _assert_connected(tree, h.trace_id)
+        assert root["name"] == "router.request"
+        names = {s["name"] for s in tree}
+        assert {"disagg.handoff", "transfer.export", "transfer.verify",
+                "transfer.ingest", "serving.prefill",
+                "serving.decode"} <= names
+        # The Chrome export of this multi-replica trace is valid
+        # Perfetto trace-event JSON with every span present.
+        doc = json.loads(json.dumps(chrome_trace(tree)))
+        assert len(doc["traceEvents"]) == len(tree)
+        assert all(ev["ph"] == "X" and "ts" in ev and "dur" in ev
+                   for ev in doc["traceEvents"])
+        assert len(res.tokens) == 6
+
+    def test_trace_endpoint(self, rec):
+        tid = spans.mint_trace_id()
+        rec.end(rec.begin("serving.request", trace_id=tid))
+        with MetricsServer(port=0) as srv:
+            got = json.loads(urllib.request.urlopen(
+                srv.url + f"/trace/{tid}", timeout=10).read())
+            assert got["trace_id"] == tid
+            assert [s["name"] for s in got["spans"]] == [
+                "serving.request"]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    srv.url + "/trace/0000000000000000", timeout=10)
+            assert ei.value.code == 404
+
+    def test_cli_waterfall_and_chrome(self, tmp_path, capsys):
+        path = str(tmp_path / "trace.jsonl")
+        r = SpanRecorder(path)
+        tid = spans.mint_trace_id()
+        root = r.begin("serving.request", trace_id=tid)
+        r.end(r.begin("serving.prefill", trace_id=tid,
+                      parent_id=root))
+        r.end(root)
+        r.close()
+        out_chrome = str(tmp_path / "chrome.json")
+        assert spans.main([path, "--chrome", out_chrome]) == 0
+        text = capsys.readouterr().out
+        assert f"trace {tid}" in text and "serving.prefill" in text
+        with open(out_chrome) as f:
+            doc = json.load(f)
+        assert len(doc["traceEvents"]) == 2
+        assert spans.main([path, "--anatomy"]) == 0
+        assert "prefill" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# Record/replay round-trip
+# ---------------------------------------------------------------------------
+
+class TestReqlog:
+    def _shared_prefix_prompts(self):
+        rs = np.random.RandomState(7)
+        head = rs.randint(0, VOCAB, (2 * reqlog.DEFAULT_BLOCK,))
+        mk = lambda tail_n, seed: np.concatenate(
+            [head, np.random.RandomState(seed).randint(
+                0, VOCAB, (tail_n,))])
+        return [mk(reqlog.DEFAULT_BLOCK + 3, 1), mk(5, 2),
+                rs.randint(0, VOCAB, (reqlog.DEFAULT_BLOCK + 1,))]
+
+    def test_roundtrip_counts_budgets_and_groups(self, tmp_path):
+        path = str(tmp_path / "requests.jsonl")
+        log = reqlog.RequestLog(path)
+        prompts = self._shared_prefix_prompts()
+        for i, p in enumerate(prompts):
+            log.record(p, 8 + i, tenant=f"t{i % 2}", priority=i,
+                       trace_id=f"{i:016x}")
+        log.close()
+        header, records = reqlog.load(path)
+        assert header["reqlog"] == reqlog.SCHEMA
+        assert header["block"] == reqlog.DEFAULT_BLOCK
+        assert len(records) == len(prompts) == log.count
+        for i, (p, rec_) in enumerate(zip(prompts, records)):
+            assert rec_["prompt_len"] == len(p)
+            assert rec_["max_new"] == 8 + i
+            assert rec_["tenant"] == f"t{i % 2}"
+            assert rec_["priority"] == i
+            assert rec_["trace_id"] == f"{i:016x}"
+        assert records[0]["t"] <= records[1]["t"] <= records[2]["t"]
+
+    def test_synthesis_preserves_prefix_structure(self, tmp_path):
+        """The acceptance property: record -> synthesize -> re-chain
+        reproduces the prefix-group structure EXACTLY (same sharing
+        topology, even though digest values differ), and synthesized
+        lengths match the recorded ones."""
+        path = str(tmp_path / "requests.jsonl")
+        log = reqlog.RequestLog(path)
+        prompts = self._shared_prefix_prompts()
+        for p in prompts:
+            log.record(p, 8)
+        log.close()
+        _, records = reqlog.load(path)
+        synth = [reqlog.synthesize_prompt(r, VOCAB) for r in records]
+        assert [len(s) for s in synth] == [len(p) for p in prompts]
+        resynth_records = [
+            {"prefix": reqlog.prefix_chain(s), "prompt_len": len(s)}
+            for s in synth]
+        assert (reqlog.prefix_pattern(resynth_records)
+                == reqlog.prefix_pattern(records))
+        # Shared recorded prefixes ARE shared synthesized prefixes:
+        # prompts 0 and 1 agree on their first two blocks, 2 differs.
+        b = reqlog.DEFAULT_BLOCK
+        assert np.array_equal(synth[0][:2 * b], synth[1][:2 * b])
+        assert not np.array_equal(synth[2][:b], synth[0][:b])
+
+    def test_engine_submit_records_client_arrivals_only(
+            self, lm, tmp_path, rec):
+        """HVD_REQLOG semantics through `install`: every client entry
+        records one line; the internal migration leg (engine.submit
+        with a minted trace) records NOTHING extra."""
+        model, params = lm
+        path = str(tmp_path / "requests.jsonl")
+        prev = reqlog.install(reqlog.RequestLog(path))
+        try:
+            with ServingEngine(model, params, num_slots=2,
+                               max_queue=4) as eng:
+                h1 = eng.submit(_prompts(1, seed=9)[0], 4)
+                h1.result(timeout=300)
+                # Internal leg: trace_id supplied => no record.
+                h2 = eng.submit(_prompts(1, seed=10)[0], 4,
+                                trace_id=h1.trace_id)
+                h2.result(timeout=300)
+            log = reqlog.get()
+            log.close()
+        finally:
+            reqlog.install(prev)
+        _, records = reqlog.load(path)
+        assert len(records) == 1
+        assert records[0]["trace_id"] == h1.trace_id
+
+    def test_load_refuses_newer_schema(self, tmp_path):
+        p = tmp_path / "future.jsonl"
+        p.write_text(json.dumps({"reqlog": reqlog.SCHEMA + 1,
+                                 "t0": 0.0, "block": 16}) + "\n")
+        with pytest.raises(ValueError, match="schema"):
+            reqlog.load(str(p))
+        (tmp_path / "empty.jsonl").write_text("")
+        with pytest.raises(ValueError, match="empty"):
+            reqlog.load(str(tmp_path / "empty.jsonl"))
